@@ -1,0 +1,365 @@
+"""horovod_tpu — a TPU-native data-parallel training framework with the
+capabilities of Horovod (reference: sj6077/horovod), rebuilt on
+JAX/XLA/Pallas.
+
+Public surface parity (reference: horovod/torch/__init__.py,
+horovod/common/basics.py ``HorovodBasics``): ``init``, ``shutdown``,
+``rank``/``size``/``local_rank``/..., eager collectives
+(``allreduce``/``allgather``/``broadcast``/``alltoall``/
+``reducescatter`` + async/grouped variants), ``DistributedOptimizer``,
+``Compression``, ``ProcessSet``, elastic training, plus the SPMD layer
+(``horovod_tpu.spmd``) that is the TPU-idiomatic hot path inside
+jit/shard_map.
+
+Typical JAX use::
+
+    import horovod_tpu as hvt
+    hvt.init()
+    mesh = hvt.world_mesh()
+    tx = hvt.DistributedOptimizer(optax.sgd(0.1), axis_name="world")
+    # ... jit a shard_map train step over `mesh`; gradients are
+    # bucket-fused and psum'd over ICI inside the compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from . import comm, core
+from .api import functions as _functions
+from .api import optimizer as _optimizer
+from .api.handles import manager as _handle_manager
+from .comm import eager as _eager
+from .comm import spmd
+from .comm.compression import Compression
+from .comm.reduce_ops import Adasum, Average, Max, Min, Product, ReduceOp, Sum
+from .core import (
+    Config,
+    HorovodInternalError,
+    HorovodTpuError,
+    HostsUpdatedInterrupt,
+    ProcessSet,
+    add_process_set,
+    remove_process_set,
+)
+from .core import state as _state
+from .version import __version__
+
+# ---------------------------------------------------------------------------
+# lifecycle (parity: horovod_init / horovod_shutdown / HorovodBasics)
+# ---------------------------------------------------------------------------
+
+def init(config: Optional[Config] = None):
+    """Initialize horovod_tpu (idempotent)."""
+    return _state.init(config)
+
+
+def shutdown():
+    _state.shutdown()
+
+
+def is_initialized() -> bool:
+    return _state.initialized()
+
+
+def rank() -> int:
+    return _state.require_init("rank()").rank
+
+
+def size() -> int:
+    return _state.require_init("size()").size
+
+
+def local_rank() -> int:
+    return _state.require_init("local_rank()").local_rank
+
+
+def local_size() -> int:
+    return _state.require_init("local_size()").local_size
+
+
+def cross_rank() -> int:
+    return _state.require_init("cross_rank()").cross_rank
+
+
+def cross_size() -> int:
+    return _state.require_init("cross_size()").cross_size
+
+
+def num_devices() -> int:
+    """Total accelerator devices in the job (devices ≠ ranks on TPU:
+    one process drives many chips)."""
+    return _state.require_init("num_devices()").topology.num_devices
+
+
+def local_devices():
+    return jax.local_devices()
+
+
+def world_mesh():
+    """The flat 1-D device mesh (axis ``world``) for SPMD programs."""
+    return _state.require_init("world_mesh()").topology.world_mesh()
+
+
+def hierarchical_mesh():
+    """(dcn, ici) mesh separating cross-host from intra-slice links."""
+    return _state.require_init("hierarchical_mesh()").topology.hierarchical_mesh()
+
+
+def mesh(axis_names, shape):
+    """Arbitrary N-D mesh, e.g. ``hvt.mesh(("dp","tp"), (4, 2))``."""
+    return _state.require_init("mesh()").topology.nd_mesh(
+        tuple(axis_names), tuple(shape)
+    )
+
+
+# ---------------------------------------------------------------------------
+# build/runtime feature probes (parity: basics.py mpi_built/nccl_built/...)
+# ---------------------------------------------------------------------------
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def nccl_built() -> int:
+    return 0
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    """This framework *is* the XLA backend."""
+    return True
+
+
+def ici_built() -> bool:
+    """True when a TPU (ICI-connected) backend is present."""
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# eager collectives (parity: horovod/torch/mpi_ops.py surface)
+# ---------------------------------------------------------------------------
+
+def allreduce(
+    tensor,
+    *,
+    op=None,
+    average=None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    compression=Compression.none,
+    process_set=None,
+    name: Optional[str] = None,
+):
+    _state.require_init("allreduce")
+    return _eager.allreduce(
+        tensor,
+        op=op,
+        average=average,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+        compression=compression,
+        process_set=process_set,
+    )
+
+
+def grouped_allreduce(tensors, *, op=None, average=None,
+                      compression=Compression.none, process_set=None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0, name=None):
+    """Reduce a list of tensors as one fused unit (parity:
+    hvd.grouped_allreduce / group_table.cc).
+
+    Sum/Average fuse into one flat wire buffer; Min/Max/Product/Adasum
+    keep per-tensor semantics (matching spmd.grouped_allreduce).
+    """
+    _state.require_init("grouped_allreduce")
+    from .comm.packing import pack_flat, unpack_flat
+    from .comm.reduce_ops import ReduceOp, normalize_op
+
+    rop = normalize_op(op, average)
+    tensors = list(tensors)
+    if not tensors:
+        return []
+    kwargs = dict(
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        compression=compression, process_set=process_set,
+    )
+    if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        return [_eager.allreduce(t, op=rop, **kwargs) for t in tensors]
+    flat, specs = pack_flat(tensors)
+    red = _eager.allreduce(flat, op=rop, **kwargs)
+    return unpack_flat(red, specs)
+
+
+def allgather(tensor, *, process_set=None, name: Optional[str] = None):
+    _state.require_init("allgather")
+    return _eager.allgather(tensor, process_set=process_set)
+
+
+def broadcast(tensor, root_rank: int = 0, *, process_set=None,
+              name: Optional[str] = None):
+    _state.require_init("broadcast")
+    return _eager.broadcast(tensor, root_rank=root_rank, process_set=process_set)
+
+
+def alltoall(tensor, splits=None, *, process_set=None,
+             name: Optional[str] = None):
+    _state.require_init("alltoall")
+    return _eager.alltoall(tensor, splits, process_set=process_set)
+
+
+def reducescatter(tensor, *, op=None, process_set=None,
+                  name: Optional[str] = None):
+    _state.require_init("reducescatter")
+    return _eager.reducescatter(tensor, op=op, process_set=process_set)
+
+
+def barrier(*, process_set=None):
+    _state.require_init("barrier")
+    return _eager.barrier(process_set=process_set)
+
+
+# --- async variants (parity: *_async + synchronize/poll; the XLA runtime
+# is natively async, so handles wrap undelivered arrays) ---
+
+def allreduce_async(tensor, *, op=None, average=None, name=None, **kw):
+    out = allreduce(tensor, op=op, average=average, **kw)
+    return _handle_manager().allocate(out)
+
+
+def allgather_async(tensor, *, name=None, **kw):
+    return _handle_manager().allocate(allgather(tensor, **kw))
+
+
+def broadcast_async(tensor, root_rank: int = 0, *, name=None, **kw):
+    return _handle_manager().allocate(broadcast(tensor, root_rank, **kw))
+
+
+def alltoall_async(tensor, splits=None, *, name=None, **kw):
+    return _handle_manager().allocate(alltoall(tensor, splits, **kw))
+
+
+def reducescatter_async(tensor, *, op=None, name=None, **kw):
+    return _handle_manager().allocate(reducescatter(tensor, op=op, **kw))
+
+
+def synchronize(handle: int):
+    """Block until an async op completes and return its result."""
+    return _handle_manager().synchronize(handle)
+
+
+def poll(handle: int) -> bool:
+    return _handle_manager().poll(handle)
+
+
+def start_timeline(filename: str, mark_cycles: bool = False):
+    """Begin writing a Chrome-trace timeline (parity: hvd.start_timeline)."""
+    st = _state.require_init("start_timeline")
+    from .obs.timeline import Timeline
+
+    if st.timeline is not None:
+        st.timeline.close()
+    st.timeline = Timeline(filename, st.rank, mark_cycles=mark_cycles)
+    return st.timeline
+
+
+def stop_timeline():
+    """Stop and flush the timeline (parity: hvd.stop_timeline)."""
+    st = _state.require_init("stop_timeline")
+    if st.timeline is not None:
+        st.timeline.close()
+        st.timeline = None
+
+
+def join(device=None) -> int:
+    """Signal this rank has no more work this epoch (uneven final
+    batches; parity: hvd.join / EnqueueJoin).
+
+    All ranks must eventually call ``join``; returns the highest rank
+    that joined last.  The dynamic form (other ranks continuing
+    collectives while some have joined) is provided by the eager
+    mini-controller; the barrier form covers the common
+    end-of-epoch use.
+    """
+    st = _state.require_init("join")
+    if st.size == 1:
+        return 0
+    import jax.numpy as jnp
+
+    last = _eager.allreduce(
+        jnp.asarray(st.rank, jnp.int32), op=Max
+    )
+    return int(last)
+
+
+# ---------------------------------------------------------------------------
+# higher-level API
+# ---------------------------------------------------------------------------
+
+DistributedOptimizer = _optimizer.DistributedOptimizer
+allreduce_gradients = _optimizer.allreduce_gradients
+broadcast_parameters = _functions.broadcast_parameters
+broadcast_optimizer_state = _functions.broadcast_optimizer_state
+broadcast_object = _functions.broadcast_object
+allgather_object = _functions.allgather_object
+
+__all__ = [
+    "__version__",
+    "init", "shutdown", "is_initialized",
+    "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
+    "num_devices", "local_devices", "world_mesh", "hierarchical_mesh", "mesh",
+    "allreduce", "grouped_allreduce", "allgather", "broadcast", "alltoall",
+    "reducescatter", "barrier", "join",
+    "allreduce_async", "allgather_async", "broadcast_async", "alltoall_async",
+    "reducescatter_async", "synchronize", "poll",
+    "start_timeline", "stop_timeline",
+    "DistributedOptimizer", "allreduce_gradients",
+    "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
+    "allgather_object",
+    "Compression", "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max",
+    "Product",
+    "ProcessSet", "add_process_set", "remove_process_set",
+    "Config", "HorovodTpuError", "HorovodInternalError",
+    "HostsUpdatedInterrupt",
+    "spmd", "comm", "core",
+    "mpi_enabled", "mpi_built", "mpi_threads_supported", "gloo_enabled",
+    "gloo_built", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
+    "rocm_built", "xla_built", "ici_built",
+]

@@ -1,0 +1,105 @@
+"""State-distribution helpers.
+
+Parity surface: ``horovod/torch/functions.py`` —
+``broadcast_parameters``, ``broadcast_optimizer_state``,
+``broadcast_object`` — plus ``allgather_object``, the utilities every
+Horovod training script calls once at startup to fan rank 0's state out
+to the world (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm import eager
+from ..core import state as core_state
+
+
+def broadcast_parameters(params, root_rank: int = 0, process_set=None):
+    """Broadcast a pytree of arrays from ``root_rank`` to all ranks.
+
+    Returns the broadcast tree (functional, unlike the reference's
+    in-place torch version — JAX arrays are immutable).
+    """
+    core_state.require_init("broadcast_parameters")
+    return jax.tree_util.tree_map(
+        lambda t: eager.broadcast(
+            jnp.asarray(t), root_rank=root_rank, process_set=process_set
+        ),
+        params,
+    )
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0, process_set=None):
+    """Broadcast optimizer state (any pytree; non-array leaves go via
+    ``broadcast_object``)."""
+    core_state.require_init("broadcast_optimizer_state")
+
+    def bcast_leaf(t):
+        if isinstance(t, (jax.Array, np.ndarray)) or jnp.isscalar(t):
+            return eager.broadcast(
+                jnp.asarray(t), root_rank=root_rank, process_set=process_set
+            )
+        return broadcast_object(t, root_rank=root_rank, process_set=process_set)
+
+    return jax.tree_util.tree_map(bcast_leaf, opt_state)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, process_set=None) -> Any:
+    """Pickle on root, broadcast size then payload, unpickle everywhere.
+
+    Parity: ``horovod/torch/functions.py broadcast_object`` (same
+    two-phase size/payload wire protocol).
+    """
+    core_state.require_init("broadcast_object")
+    st = core_state.global_state()
+    if st.size == 1:
+        return obj
+
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+
+    size = eager.broadcast(
+        jnp.asarray(payload.size, jnp.int64),
+        root_rank=root_rank,
+        process_set=process_set,
+    )
+    n = int(size)
+    local = payload if st.rank == root_rank else np.zeros((n,), np.uint8)
+    wire = eager.broadcast(
+        jnp.asarray(local[:n]), root_rank=root_rank, process_set=process_set
+    )
+    return pickle.loads(np.asarray(wire).tobytes())
+
+
+def allgather_object(obj: Any, process_set=None):
+    """Gather a picklable object from every rank; returns a list ordered
+    by rank (parity: hvd.allgather_object)."""
+    core_state.require_init("allgather_object")
+    st = core_state.global_state()
+    if st.size == 1:
+        return [obj]
+
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+    gathered_sizes = np.asarray(
+        eager.allgather(
+            jnp.asarray([payload.size], jnp.int64), process_set=process_set
+        )
+    )
+    blob = np.asarray(
+        eager.allgather(jnp.asarray(payload), process_set=process_set)
+    ).tobytes()
+    out, off = [], 0
+    for s in gathered_sizes:
+        out.append(pickle.loads(blob[off : off + int(s)]))
+        off += int(s)
+    return out

@@ -1,0 +1,66 @@
+"""Async handles for eager collectives.
+
+Parity surface: the handle table of the reference torch binding
+(``horovod/torch/handle_manager.cc`` + ``synchronize``/``poll`` in
+horovod/torch/mpi_ops.py).
+
+On TPU the XLA runtime is already asynchronous: every jax op returns a
+future-like ``jax.Array`` immediately and blocks only when the host
+reads it.  So an async handle is just the undelivered array plus a
+completion probe, and ``synchronize`` is ``block_until_ready`` — the
+background-thread machinery of the reference collapses into the runtime.
+The mini-controller (horovod_tpu.eager) plugs in here when cross-process
+enqueue-order negotiation is enabled.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+
+class HandleManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._results: Dict[int, Any] = {}
+
+    def allocate(self, value) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._results[h] = value
+            return h
+
+    def synchronize(self, handle: int):
+        with self._lock:
+            if handle not in self._results:
+                raise ValueError(f"unknown or already-synchronized handle {handle}")
+            value = self._results.pop(handle)
+        if callable(value):
+            value = value()
+        return jax.block_until_ready(value)
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            value = self._results.get(handle)
+        if value is None:
+            return True  # unknown / already-synchronized handles are done
+        if callable(value):
+            return False
+        # value may be a pytree (e.g. alltoall's (tensor, splits) pair):
+        # done only when every array leaf has landed.
+        for leaf in jax.tree_util.tree_leaves(value):
+            is_ready = getattr(leaf, "is_ready", None)
+            if is_ready is not None and not is_ready():
+                return False
+        return True
+
+
+_manager = HandleManager()
+
+
+def manager() -> HandleManager:
+    return _manager

@@ -1,0 +1,24 @@
+from . import eager, spmd
+from .adasum import adasum_reduce, adasum_reduce_reference
+from .compression import Compression
+from .fusion import BucketPlan, fused_tree_allreduce, plan_buckets, plan_for_tree
+from .reduce_ops import Adasum, Average, Max, Min, Product, ReduceOp, Sum
+
+__all__ = [
+    "eager",
+    "spmd",
+    "adasum_reduce",
+    "adasum_reduce_reference",
+    "Compression",
+    "BucketPlan",
+    "fused_tree_allreduce",
+    "plan_buckets",
+    "plan_for_tree",
+    "ReduceOp",
+    "Average",
+    "Sum",
+    "Adasum",
+    "Min",
+    "Max",
+    "Product",
+]

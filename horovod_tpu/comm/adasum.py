@@ -1,0 +1,86 @@
+"""Adasum: scale-invariant gradient combination, expressed in XLA.
+
+Parity surface: ``horovod/common/ops/adasum/adasum.h``
+(``Adasum<Communicator_type>::DispatchFusedAllreduce`` — recursive
+vector-halving distance-doubling with dot-product correction) and the
+``op=hvd.Adasum`` argument.
+
+The pairwise rule for two gradients a, b is
+
+    adasum(a, b) = (1 - a·b / (2 a·a)) a + (1 - a·b / (2 b·b)) b
+
+which is symmetric, so both partners of an exchange compute identical
+results.  The reference uses vector-halving distance-doubling (VHDD) to
+halve wire bytes per hop on low-bandwidth fabrics; on TPU the ICI links
+are fast and the latency of 2× the hops dominates, so we use plain
+recursive distance-doubling over full vectors with ``lax.ppermute`` —
+log2(n) hops, each a single neighbor exchange that XLA schedules on ICI.
+
+Requires a power-of-two axis size (as the reference's recursive
+algorithm effectively does per node group); callers fall back to
+averaging otherwise.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pairwise_adasum(a, b):
+    af = a.astype(jnp.float32).reshape(-1)
+    bf = b.astype(jnp.float32).reshape(-1)
+    ab = jnp.dot(af, bf)
+    aa = jnp.dot(af, af)
+    bb = jnp.dot(bf, bf)
+    ca = jnp.where(aa > 0, ab / (2.0 * aa), 0.0)
+    cb = jnp.where(bb > 0, ab / (2.0 * bb), 0.0)
+    out = (1.0 - ca) * af + (1.0 - cb) * bf
+    return out.reshape(a.shape).astype(a.dtype)
+
+
+def adasum_reduce(x, axis_name: str, axis_size: int):
+    """Adasum-combine ``x`` across ``axis_name`` inside shard_map/jit.
+
+    ``axis_size`` must be a power of two ≥ 1.  Returns the combined
+    tensor, identical on every participant.
+    """
+    if axis_size & (axis_size - 1):
+        raise ValueError(
+            f"Adasum requires a power-of-two world size, got {axis_size}"
+        )
+    v = x
+    dist = 1
+    while dist < axis_size:
+        # Pairwise exchange with the partner at XOR distance `dist`.
+        perm = [(j, j ^ dist) for j in range(axis_size)]
+        other = lax.ppermute(v, axis_name, perm)
+        v = _pairwise_adasum(v, other)
+        dist *= 2
+    return v
+
+
+def adasum_reduce_reference(tensors):
+    """Pure-numpy reference for tests: sequential recursive doubling over a
+    list of per-rank tensors; returns the combined tensor.
+    """
+    import numpy as np
+
+    n = len(tensors)
+    assert n & (n - 1) == 0
+    vals = [np.asarray(t, dtype=np.float64) for t in tensors]
+    dist = 1
+    while dist < n:
+        new = list(vals)
+        for j in range(n):
+            a, b = vals[j], vals[j ^ dist]
+            ab = float((a * b).sum())
+            aa = float((a * a).sum())
+            bb = float((b * b).sum())
+            ca = ab / (2 * aa) if aa > 0 else 0.0
+            cb = ab / (2 * bb) if bb > 0 else 0.0
+            new[j] = (1 - ca) * a + (1 - cb) * b
+        vals = new
+        dist *= 2
+    return vals[0]

@@ -1,0 +1,132 @@
+"""Gradient compression for the wire.
+
+Parity surface: ``horovod/torch/compression.py`` /
+``horovod/tensorflow/compression.py`` — the pluggable ``Compression``
+namespace with ``none`` and ``fp16`` compressors exposing
+``compress(tensor) -> (tensor, ctx)`` / ``decompress(tensor, ctx)``.
+
+TPU-native notes: compressors are pure jax functions, so they fuse into
+the surrounding XLA program (the cast rides the same HBM pass as the
+bucket flatten).  ``bf16`` is added because bfloat16 is the TPU wire
+format of choice (same 2× saving as fp16, no range loss), and ``int8``
+implements EQuARX-style quantized allreduce (PAPERS.md) with per-chunk
+scales.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface: compress before the collective, decompress after."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast float tensors to fp16 on the wire, back to original dtype after."""
+
+    @staticmethod
+    def compress(tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            tensor = tensor.astype(jnp.float16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            tensor = tensor.astype(ctx)
+        return tensor
+
+
+class BF16Compressor(Compressor):
+    """bfloat16 wire format — the TPU-idiomatic 2× compression."""
+
+    @staticmethod
+    def compress(tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            tensor = tensor.astype(jnp.bfloat16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            tensor = tensor.astype(ctx)
+        return tensor
+
+
+class Int8Compressor(Compressor):
+    """Block-scaled int8 quantization (EQuARX-style, PAPERS.md).
+
+    Tensors are quantized in chunks of ``BLOCK`` elements with a per-chunk
+    absmax scale carried alongside in fp32.  4× wire saving for the
+    payload; the scales add 4/BLOCK bytes/element.  Intended for the
+    fused-bucket path where tensors are large and flat.
+    """
+
+    BLOCK = 1024
+
+    @staticmethod
+    def compress(tensor):
+        if not jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor, None
+        orig_dtype = tensor.dtype
+        orig_shape = tensor.shape
+        flat = tensor.reshape(-1)
+        n = flat.shape[0]
+        block = Int8Compressor.BLOCK
+        pad = (-n) % block
+        flat = jnp.pad(flat, (0, pad))
+        chunks = flat.reshape(-1, block).astype(jnp.float32)
+        scale = jnp.max(jnp.abs(chunks), axis=1, keepdims=True) / 127.0
+        safe = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.clip(jnp.round(chunks / safe), -127, 127).astype(jnp.int8)
+        return q, (orig_dtype, orig_shape, n, scale)
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        orig_dtype, orig_shape, n, scale = ctx
+        deq = tensor.astype(jnp.float32) * scale
+        return deq.reshape(-1)[:n].reshape(orig_shape).astype(orig_dtype)
+
+
+class Compression:
+    """Namespace matching the reference API: ``Compression.none`` etc."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor
+    int8 = Int8Compressor
+
+    @staticmethod
+    def from_name(name: str):
+        try:
+            return {
+                "none": NoneCompressor,
+                "fp16": FP16Compressor,
+                "bf16": BF16Compressor,
+                "int8": Int8Compressor,
+            }[name]
+        except KeyError:
+            raise ValueError(f"unknown compression {name!r}") from None

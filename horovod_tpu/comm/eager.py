@@ -1,0 +1,363 @@
+"""Eager process-level collectives: one process = one Horovod rank.
+
+Parity surface: the synchronous eager API of the reference
+(hvd.allreduce/allgather/broadcast/alltoall/reducescatter on concrete
+tensors, horovod/torch/mpi_ops.py + the enqueue path in
+horovod/common/operations.cc).
+
+TPU-native design: each participating process contributes its local
+tensor; we assemble a global ``jax.Array`` sharded one-shard-per-process
+over the process mesh (``Topology.proc_mesh``), run a *jitted*
+``shard_map`` collective over it (XLA moves the bytes over ICI/DCN), and
+return the locally-addressable result.  The XLA runtime's async dispatch
+plays the role of the reference's background thread: the returned array
+is a future, and blocking happens only at ``synchronize``.
+
+Ordering contract (same as any SPMD system): all member processes must
+issue the same sequence of eager collectives.  The reference relaxes
+this with its controller negotiation; horovod_tpu restores that
+flexibility for the *async* API via the eager mini-controller
+(horovod_tpu.eager), which re-orders enqueues into an agreed schedule
+before they reach this layer.
+
+Single-process mode (P == 1, any number of local devices) degenerates to
+local math, so the same user program runs unmodified from a laptop to a
+pod — collectives over local devices belong to the SPMD layer instead.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import state as core_state
+from ..core.topology import PROC_AXIS
+from . import spmd
+from .compression import NoneCompressor
+from .reduce_ops import ReduceOp, normalize_op
+
+
+# --------------------------------------------------------------------------
+# plumbing
+# --------------------------------------------------------------------------
+
+def _resolve_process_set(process_set):
+    st = core_state.require_init("eager collectives")
+    if process_set is None:
+        return st, st.process_set_table.global_process_set
+    if isinstance(process_set, int):
+        return st, st.process_set_table.get(process_set)
+    return st, process_set
+
+
+def _local_device(mesh: Mesh) -> jax.Device:
+    for d in mesh.devices.flat:
+        if d.process_index == jax.process_index():
+            return d
+    raise RuntimeError("calling process is not a member of this process set")
+
+
+def _stack_global(x, mesh: Mesh):
+    """Global (P, *shape) array, shard p = process p's tensor."""
+    p = mesh.devices.size
+    sharding = NamedSharding(mesh, P(PROC_AXIS))
+    local = jax.device_put(x[None], _local_device(mesh))
+    return jax.make_array_from_single_device_arrays(
+        (p,) + tuple(x.shape), sharding, [local]
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(kind: str, mesh: Mesh, static: Tuple):
+    """Compiled collective over the process mesh. jit's own cache handles
+    shapes/dtypes; this cache handles (mesh, op-kind, static config) —
+    the analog of the reference's lazily-created per-(process set, op)
+    communicators.
+    """
+    if kind == "allreduce":
+        (rop, compression) = static
+
+        def fn(stacked, prescale, postscale):
+            def body(shard, pre, post):
+                x = shard[0]
+                x = x * pre.astype(x.dtype)
+                out = spmd.allreduce(
+                    x, axis_name=PROC_AXIS, op=rop, compression=compression
+                )
+                return out * post.astype(out.dtype)
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(PROC_AXIS), P(), P()),
+                out_specs=P(),
+                check_vma=False,
+            )(stacked, prescale, postscale)
+
+        return jax.jit(fn)
+
+    if kind == "allgather":
+
+        def fn(stacked):
+            def body(shard):
+                return lax.all_gather(shard, PROC_AXIS, tiled=True)
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(PROC_AXIS),),
+                out_specs=P(),
+                check_vma=False,
+            )(stacked)
+
+        return jax.jit(fn)
+
+    if kind == "broadcast":
+        (root_rank,) = static
+
+        def fn(stacked):
+            def body(shard):
+                return spmd.broadcast(
+                    shard[0], root_rank=root_rank, axis_name=PROC_AXIS
+                )
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(PROC_AXIS),),
+                out_specs=P(),
+                check_vma=False,
+            )(stacked)
+
+        return jax.jit(fn)
+
+    if kind == "reducescatter":
+        (rop,) = static
+
+        def fn(stacked):
+            def body(shard):
+                out = spmd.reducescatter(
+                    shard[0], axis_name=PROC_AXIS, op=rop
+                )
+                return out[None]
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(PROC_AXIS),),
+                out_specs=P(PROC_AXIS),
+                check_vma=False,
+            )(stacked)
+
+        return jax.jit(fn)
+
+    if kind == "alltoall":
+
+        def fn(stacked):
+            # stacked: (P, P, chunk, ...) — dim1 indexes destination.
+            def body(shard):
+                # shard: (1, P, chunk, ...) → exchange → (1, P, chunk, ...)
+                # where out[0, j] is the chunk rank j sent to this rank.
+                x = shard[0]
+                out = lax.all_to_all(
+                    x, PROC_AXIS, split_axis=0, concat_axis=0, tiled=True
+                )
+                return out[None]
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(PROC_AXIS),),
+                out_specs=P(PROC_AXIS),
+                check_vma=False,
+            )(stacked)
+
+        return jax.jit(fn)
+
+    raise ValueError(kind)
+
+
+def _fetch(global_out):
+    """Locally-addressable replica of a fully-replicated output."""
+    return global_out.addressable_data(0)
+
+
+# --------------------------------------------------------------------------
+# public eager ops
+# --------------------------------------------------------------------------
+
+def allreduce(
+    tensor,
+    *,
+    op: Optional[ReduceOp] = None,
+    average: Optional[bool] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    compression=NoneCompressor,
+    process_set=None,
+):
+    rop = normalize_op(op, average)
+    st, ps = _resolve_process_set(process_set)
+    x = jnp.asarray(tensor)
+    mesh = ps.proc_mesh()
+    p = mesh.devices.size
+    if p == 1:
+        out = x * jnp.asarray(prescale_factor, x.dtype)
+        # averaging / sum over one participant is identity
+        return out * jnp.asarray(postscale_factor, out.dtype)
+    stacked = _stack_global(x, mesh)
+    fn = _jitted("allreduce", mesh, (rop, compression))
+    out = fn(
+        stacked,
+        jnp.asarray(prescale_factor, jnp.float32),
+        jnp.asarray(postscale_factor, jnp.float32),
+    )
+    return _fetch(out)
+
+
+def _exchange_dim0_sizes(dim0: int, mesh: Mesh) -> np.ndarray:
+    """The allgather size-negotiation step (parity: the size table logic
+    in horovod/common/ops/collective_operations.cc AllgatherOp)."""
+    stacked = _stack_global(jnp.asarray(dim0, jnp.int32), mesh)
+    fn = _jitted("allgather", mesh, ())
+    return np.asarray(_fetch(fn(stacked)))
+
+
+def allgather(tensor, *, process_set=None):
+    """Concatenate per-rank tensors along dim 0; ranks may differ in dim 0
+    (sizes are negotiated first, like the reference's allgather).
+    """
+    st, ps = _resolve_process_set(process_set)
+    x = jnp.asarray(tensor)
+    mesh = ps.proc_mesh()
+    p = mesh.devices.size
+    if p == 1:
+        return x
+    sizes = _exchange_dim0_sizes(x.shape[0], mesh)
+    maxd = int(sizes.max())
+    padded = (
+        x
+        if x.shape[0] == maxd
+        else jnp.pad(x, [(0, maxd - x.shape[0])] + [(0, 0)] * (x.ndim - 1))
+    )
+    stacked = _stack_global(padded, mesh)
+    gathered = _fetch(_jitted("allgather", mesh, ())(stacked))
+    # gathered: (P, maxd, ...); trim each rank's block to its size.
+    if all(int(s) == maxd for s in sizes):
+        return gathered.reshape((p * maxd,) + gathered.shape[2:])
+    parts = [gathered[r, : int(sizes[r])] for r in range(p)]
+    return jnp.concatenate(parts, axis=0)
+
+
+def broadcast(tensor, *, root_rank: int = 0, process_set=None):
+    st, ps = _resolve_process_set(process_set)
+    x = jnp.asarray(tensor)
+    mesh = ps.proc_mesh()
+    if mesh.devices.size == 1:
+        return x
+    # root_rank is a *global* rank (reference semantics); translate to
+    # the set-relative index the proc-mesh axis uses.
+    root_in_set = ps.rank_in_set(root_rank)
+    if root_in_set < 0:
+        raise ValueError(
+            f"root_rank {root_rank} is not a member of process set "
+            f"{ps.process_set_id} (ranks {ps.ranks})"
+        )
+    stacked = _stack_global(x, mesh)
+    out = _jitted("broadcast", mesh, (root_in_set,))(stacked)
+    return _fetch(out)
+
+
+def alltoall(tensor, splits=None, *, process_set=None):
+    """Distribute dim-0 slices to every rank.
+
+    Returns the received tensor when ``splits`` is None (equal splits),
+    or ``(received_tensor, received_splits)`` when ``splits`` is given —
+    matching the reference's return convention
+    (horovod/torch/mpi_ops.py alltoall).
+
+    ``splits[i]`` rows go to rank i.  Variable splits are handled by
+    padding each chunk to the negotiated max and trimming after the
+    wire exchange.
+    """
+    st, ps = _resolve_process_set(process_set)
+    x = jnp.asarray(tensor)
+    mesh = ps.proc_mesh()
+    p = mesh.devices.size
+    return_splits = splits is not None
+    if splits is None:
+        if x.shape[0] % p:
+            raise ValueError(
+                f"alltoall dim0 {x.shape[0]} not divisible by size {p}"
+            )
+        splits = np.full((p,), x.shape[0] // p, np.int32)
+    splits = np.asarray(splits, np.int32)
+    if splits.shape != (p,) or int(splits.sum()) != x.shape[0]:
+        raise ValueError("splits must be a (size,) vector summing to dim0")
+    if p == 1:
+        return (x, jnp.asarray(splits)) if return_splits else x
+
+    # Negotiate the split matrix: row r = rank r's send splits.
+    split_matrix = np.asarray(
+        allgather(jnp.asarray(splits)[None], process_set=ps)
+    ).reshape(p, p)
+    recv_splits = split_matrix[:, ps.rank_in_set(st.rank)]
+    max_chunk = int(split_matrix.max())
+
+    offsets = np.concatenate([[0], np.cumsum(splits)[:-1]])
+    chunks = [
+        jnp.pad(
+            x[int(o) : int(o + s)],
+            [(0, max_chunk - int(s))] + [(0, 0)] * (x.ndim - 1),
+        )
+        for o, s in zip(offsets, splits)
+    ]
+    send = jnp.stack(chunks)  # (P, max_chunk, ...)
+    stacked = _stack_global(send, mesh)
+    # local shard of the (P, P, max_chunk, ...) output: (1, P, max_chunk, ...)
+    out = _fetch(_jitted("alltoall", mesh, ())(stacked))[0]
+    parts = [out[r, : int(recv_splits[r])] for r in range(p)]
+    result = jnp.concatenate(parts, axis=0)
+    return (result, jnp.asarray(recv_splits)) if return_splits else result
+
+
+def reducescatter(tensor, *, op=None, process_set=None):
+    """Reduce across ranks, return this rank's dim-0 shard.
+
+    Divisible dim 0 uses a true ``psum_scatter`` (each rank receives only
+    its 1/P of the wire bytes).  Uneven dim 0 follows the reference rule
+    — the first ``dim0 % size`` ranks receive one extra row — via the
+    allreduce-then-slice fallback (XLA's scatter needs equal shards).
+    """
+    rop = normalize_op(op, None)
+    st, ps = _resolve_process_set(process_set)
+    x = jnp.asarray(tensor)
+    p = ps.size
+    if p == 1:
+        return x
+    if x.shape[0] % p == 0:
+        mesh = ps.proc_mesh()
+        stacked = _stack_global(x, mesh)
+        out = _fetch(_jitted("reducescatter", mesh, (rop,))(stacked))[0]
+        return out
+    reduced = allreduce(x, op=rop, process_set=ps)
+    r = ps.rank_in_set(st.rank)
+    base, extra = divmod(x.shape[0], p)
+    start = r * base + min(r, extra)
+    length = base + (1 if r < extra else 0)
+    return reduced[start : start + length]
+
+
+def barrier(*, process_set=None):
+    """Block until every member reaches the barrier (parity: hvd.barrier)."""
+    st, ps = _resolve_process_set(process_set)
+    if ps.size == 1:
+        return
+    out = allreduce(jnp.zeros((), jnp.int32), op=ReduceOp.SUM, process_set=ps)
+    jax.block_until_ready(out)

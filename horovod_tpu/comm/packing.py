@@ -1,0 +1,35 @@
+"""Shared flat-buffer pack/unpack used by every fused collective path
+(the memcpy-in/out of the reference's fusion buffer,
+horovod/common/ops/collective_operations.cc MemcpyInFusionBuffer /
+MemcpyOutFusionBuffer — here expressed as XLA concat/slice that fuse
+into the surrounding program)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+def pack_flat(tensors: Sequence[Any]):
+    """Concatenate tensors into one flat buffer in the promoted dtype.
+
+    Returns (flat, specs) where specs = [(shape, dtype, size), ...] in
+    input order.
+    """
+    tensors = [jnp.asarray(t) for t in tensors]
+    if not tensors:
+        raise ValueError("pack_flat requires at least one tensor")
+    compute_dtype = jnp.result_type(*[t.dtype for t in tensors])
+    flat = jnp.concatenate([t.reshape(-1).astype(compute_dtype) for t in tensors])
+    specs = [(tuple(t.shape), t.dtype, t.size) for t in tensors]
+    return flat, specs
+
+
+def unpack_flat(flat, specs) -> List[Any]:
+    """Inverse of pack_flat: slice, reshape, and cast back."""
+    outs, off = [], 0
+    for shape, dtype, size in specs:
+        outs.append(flat[off : off + size].reshape(shape).astype(dtype))
+        off += size
+    return outs

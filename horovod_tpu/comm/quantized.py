@@ -1,0 +1,85 @@
+"""Quantized (int8-wire) allreduce — EQuARX-style (PAPERS.md:
+"EQuARX: Efficient Quantized AllReduce in XLA", arXiv:2506.17615).
+
+A plain ``psum`` cannot carry block-quantized int8: summing codes
+quantized against different per-rank scales is meaningless and int8
+accumulation overflows.  EQuARX therefore quantizes *per hop* inside
+the collective.  At the JAX level we express the same structure as the
+two-phase allreduce XLA itself uses:
+
+  1. **reduce-scatter phase** — ``all_to_all`` the int8-quantized
+     shards (each rank's chunk c quantized with that rank's scale,
+     scales ride alongside as fp32 per-block sidecars), then each rank
+     dequantizes the N received chunks and sums them in fp32 — wire
+     bytes: 1 B/elt instead of 4 (plus 4/BLOCK scale overhead);
+  2. **allgather phase** — the reduced chunk is re-quantized and
+     ``all_gather``-ed, again 1 B/elt on the wire.
+
+Total wire ≈ 2·(N-1)/N bytes/elt vs 8·(N-1)/N for fp32 psum — the same
+~4× saving as EQuARX, with one quantization error per phase (two total),
+matching the paper's error model.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+BLOCK = 512
+
+
+def _quantize(x):
+    """x: (..., k) fp32 → int8 codes + fp32 per-block scales."""
+    n = x.shape[-1]
+    pad = (-n) % BLOCK
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    blocks = x.reshape(x.shape[:-1] + (-1, BLOCK))
+    scale = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True) / 127.0
+    safe = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(blocks / safe), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def quantized_allreduce(tensor, *, axis_name: str, average: bool = False):
+    """int8-wire allreduce of a float tensor inside shard_map/jit.
+
+    The tensor is flattened and padded so each participant owns an
+    equal chunk.  Returns fp32 (caller casts back).
+    """
+    n_ranks = lax.axis_size(axis_name)
+    orig_shape = tensor.shape
+    orig_dtype = tensor.dtype
+    flat = tensor.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    chunk = -(-n // n_ranks)  # ceil
+    pad = chunk * n_ranks - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    chunks = flat.reshape(n_ranks, chunk)
+
+    # Phase 1: reduce-scatter with int8 wire.
+    q, scale = _quantize(chunks)               # (N, chunk/B, B) int8 + scales
+    q_recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                            tiled=True)
+    s_recv = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
+                            tiled=True)
+    # q_recv: (N, chunk/B, B) — contribution of every rank to MY chunk.
+    deq = q_recv.astype(jnp.float32) * s_recv
+    reduced = jnp.sum(deq, axis=0)             # (chunk/B, B) fp32
+
+    # Phase 2: allgather with int8 wire.
+    scale2 = jnp.max(jnp.abs(reduced), axis=-1, keepdims=True) / 127.0
+    safe2 = jnp.where(scale2 == 0, 1.0, scale2)
+    q2 = jnp.clip(jnp.round(reduced / safe2), -127, 127).astype(jnp.int8)
+    q_all = lax.all_gather(q2, axis_name)      # (N, chunk/B, B)
+    s_all = lax.all_gather(scale2.astype(jnp.float32), axis_name)
+    deq_all = (q_all.astype(jnp.float32) * s_all).reshape(n_ranks, -1)
+    # trim per-chunk block padding before concatenating ranks' chunks
+    out = deq_all[:, :chunk].reshape(-1)[:n]
+
+    if average:
+        out = out / n_ranks
+    return out.reshape(orig_shape).astype(
+        orig_dtype if jnp.issubdtype(orig_dtype, jnp.floating) else jnp.float32
+    )

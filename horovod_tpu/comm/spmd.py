@@ -1,0 +1,297 @@
+"""SPMD collectives: the jit-traceable hot path.
+
+These functions run *inside* ``jax.shard_map`` / ``pjit`` over a mesh
+axis.  They are the TPU-native re-expression of the reference's
+collective op implementations (horovod/common/ops/nccl_operations.cc
+``NCCLAllreduce::Execute`` etc.) — but where the reference dispatches
+NCCL calls from a background thread, here the collective is part of the
+compiled program: XLA lowers ``psum``/``all_gather``/``ppermute``/
+``all_to_all``/``psum_scatter`` to ICI ring/torus transfers, schedules
+them asynchronously, and overlaps them with compute.  Program order
+replaces the controller (SURVEY.md §7.0): there is no negotiation phase
+because every device runs the same program.
+
+Process-set scoping maps to ``axis_index_groups`` (members form one
+group, non-members sit in singleton groups), replacing the per-set
+communicators of horovod/common/process_set.cc.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .adasum import adasum_reduce
+from .compression import Compression, NoneCompressor
+from .reduce_ops import ReduceOp, normalize_op
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a bound mesh axis at trace time."""
+    return lax.axis_size(axis_name)
+
+
+def rank(axis_name: str):
+    """This participant's index along ``axis_name`` (traced value)."""
+    return lax.axis_index(axis_name)
+
+
+def _group_size(axis_name: str, groups) -> int:
+    if groups is None:
+        return axis_size(axis_name)
+    return len(groups[0])
+
+
+def _is_int8(compression) -> bool:
+    from .compression import Int8Compressor
+
+    return (
+        compression is Int8Compressor
+        or isinstance(compression, Int8Compressor)
+        or (isinstance(compression, type) and issubclass(compression, Int8Compressor))
+    )
+
+
+def _require_equal_groups(groups, op_name: str):
+    """XLA requires equal-size replica groups for gather/scatter-shaped
+    collectives; ProcessSet.device_groups() can produce unequal groups
+    (member group + remainder), which only psum/pmin/pmax accept."""
+    if groups is not None and len({len(g) for g in groups}) > 1:
+        raise ValueError(
+            f"{op_name} requires equal-size axis_index_groups; got sizes "
+            f"{[len(g) for g in groups]}. Scope {op_name} to a process set "
+            "whose non-members also form equal-size groups, or use the "
+            "eager layer (per-set sub-mesh) instead."
+        )
+
+
+def allreduce(
+    tensor,
+    *,
+    axis_name: str,
+    op: Optional[ReduceOp] = None,
+    average: Optional[bool] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    compression=NoneCompressor,
+    groups: Optional[List[List[int]]] = None,
+):
+    """Allreduce inside jit. Parity: EnqueueTensorAllreduce + NCCLAllreduce.
+
+    ``groups`` is an ``axis_index_groups`` partition (from
+    ``ProcessSet.device_groups()``) scoping the reduction.
+    """
+    rop = normalize_op(op, average)
+    n = _group_size(axis_name, groups)
+
+    if prescale_factor != 1.0:
+        tensor = tensor * jnp.asarray(prescale_factor, tensor.dtype)
+
+    if rop == ReduceOp.ADASUM:
+        if groups is not None:
+            raise NotImplementedError(
+                "Adasum over process-set groups is not supported in-jit; "
+                "use the global set"
+            )
+        wire, ctx = compression.compress(tensor)
+        out = adasum_reduce(wire, axis_name, n)
+        out = compression.decompress(out, ctx)
+    elif rop in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        if _is_int8(compression) and jnp.issubdtype(
+            tensor.dtype, jnp.floating
+        ):
+            # int8 codes cannot ride psum (per-rank scales, overflow);
+            # route to the EQuARX-style two-phase quantized allreduce.
+            if groups is not None:
+                raise NotImplementedError(
+                    "int8 compression over process-set groups is not "
+                    "supported; use the global set"
+                )
+            from .quantized import quantized_allreduce
+
+            out = quantized_allreduce(
+                tensor, axis_name=axis_name,
+                average=(rop == ReduceOp.AVERAGE),
+            ).astype(tensor.dtype)
+        else:
+            wire, ctx = compression.compress(tensor)
+            out = lax.psum(wire, axis_name, axis_index_groups=groups)
+            out = compression.decompress(out, ctx)
+            if rop == ReduceOp.AVERAGE:
+                if jnp.issubdtype(out.dtype, jnp.integer):
+                    out = out // n
+                else:
+                    out = out / n
+    elif rop == ReduceOp.MIN:
+        out = lax.pmin(tensor, axis_name, axis_index_groups=groups)
+    elif rop == ReduceOp.MAX:
+        out = lax.pmax(tensor, axis_name, axis_index_groups=groups)
+    elif rop == ReduceOp.PRODUCT:
+        _require_equal_groups(groups, "allreduce(op=Product)")
+        gathered = lax.all_gather(tensor, axis_name, axis_index_groups=groups)
+        out = jnp.prod(gathered, axis=0)
+    else:
+        raise ValueError(f"unsupported op {rop}")
+
+    if postscale_factor != 1.0:
+        out = out * jnp.asarray(postscale_factor, out.dtype)
+    return out
+
+
+def grouped_allreduce(
+    tensors: Sequence,
+    *,
+    axis_name: str,
+    op: Optional[ReduceOp] = None,
+    average: Optional[bool] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    compression=NoneCompressor,
+    groups: Optional[List[List[int]]] = None,
+):
+    """One fused collective for a list of tensors.
+
+    Parity: hvd.grouped_allreduce / horovod/common/group_table.cc — the
+    group is always executed as a unit.  Here that means: flatten all
+    members into one flat buffer (one wire cast, one psum) and unpack,
+    exactly what FusionBufferManager does for a fused Response.
+    Falls back to per-tensor ops for reductions that don't fuse (min/max/
+    product/adasum keep per-tensor semantics).
+    """
+    rop = normalize_op(op, average)
+    tensors = list(tensors)
+    if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE) or not tensors:
+        return [
+            allreduce(
+                t,
+                axis_name=axis_name,
+                op=rop,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                compression=compression,
+                groups=groups,
+            )
+            for t in tensors
+        ]
+
+    from .packing import pack_flat, unpack_flat
+
+    flat, specs = pack_flat(tensors)
+    red = allreduce(
+        flat,
+        axis_name=axis_name,
+        op=rop,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+        compression=compression,
+        groups=groups,
+    )
+    return unpack_flat(red, specs)
+
+
+def allgather(
+    tensor,
+    *,
+    axis_name: str,
+    groups: Optional[List[List[int]]] = None,
+):
+    """Concatenate per-participant tensors along dim 0.
+
+    Parity: EnqueueTensorAllgather / NCCLAllgather.  The reference
+    negotiates per-rank first-dim sizes; in SPMD every participant has
+    the same shape by construction (the dynamic-shape path lives in the
+    eager layer, horovod_tpu.comm.eager).
+    """
+    _require_equal_groups(groups, "allgather")
+    return lax.all_gather(
+        tensor, axis_name, axis_index_groups=groups, tiled=True
+    )
+
+
+def broadcast(
+    tensor,
+    *,
+    root_rank: int,
+    axis_name: str,
+    groups: Optional[List[List[int]]] = None,
+):
+    """Every participant gets root's value.
+
+    Implemented as select + psum: contribute zeros unless we are root.
+    XLA lowers this to a broadcast-from-root on ICI (and folds the
+    select); avoids all_gather's N× memory.
+    """
+    idx = lax.axis_index(axis_name)
+    contrib = jnp.where(idx == root_rank, tensor, jnp.zeros_like(tensor))
+    if jnp.issubdtype(tensor.dtype, jnp.bool_):
+        return lax.psum(
+            contrib.astype(jnp.int8), axis_name, axis_index_groups=groups
+        ).astype(jnp.bool_)
+    return lax.psum(contrib, axis_name, axis_index_groups=groups)
+
+
+def alltoall(
+    tensor,
+    *,
+    axis_name: str,
+    groups: Optional[List[List[int]]] = None,
+):
+    """Equal-split all-to-all along dim 0 (Ulysses building block).
+
+    Parity: EnqueueTensorAlltoall.  dim0 must be divisible by the group
+    size; the variable-``splits`` form is provided by the eager layer.
+    """
+    _require_equal_groups(groups, "alltoall")
+    n = _group_size(axis_name, groups)
+    if tensor.shape[0] % n:
+        raise ValueError(
+            f"alltoall dim0 {tensor.shape[0]} not divisible by group size {n}"
+        )
+    return lax.all_to_all(
+        tensor,
+        axis_name,
+        split_axis=0,
+        concat_axis=0,
+        axis_index_groups=groups,
+        tiled=True,
+    )
+
+
+def reducescatter(
+    tensor,
+    *,
+    axis_name: str,
+    op: Optional[ReduceOp] = None,
+    groups: Optional[List[List[int]]] = None,
+):
+    """Reduce then scatter along dim 0 (ZeRO building block).
+
+    Parity: EnqueueTensorReducescatter.  dim0 must be divisible by the
+    group size.
+    """
+    rop = normalize_op(op, None)
+    if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("reducescatter supports Sum and Average")
+    _require_equal_groups(groups, "reducescatter")
+    n = _group_size(axis_name, groups)
+    if tensor.shape[0] % n:
+        raise ValueError(
+            f"reducescatter dim0 {tensor.shape[0]} not divisible by {n}"
+        )
+    out = lax.psum_scatter(
+        tensor, axis_name, axis_index_groups=groups, tiled=True
+    )
+    if rop == ReduceOp.AVERAGE:
+        if jnp.issubdtype(out.dtype, jnp.integer):
+            out = out // n
+        else:
+            out = out / n
+    return out
+
+
+def barrier(axis_name: str):
+    """Synchronize all participants (parity: hvd.barrier)."""
+    return lax.psum(jnp.zeros((), jnp.int32), axis_name)

@@ -1,0 +1,44 @@
+from .config import Config
+from .exceptions import (
+    HorovodInternalError,
+    HorovodTpuError,
+    HostsUpdatedInterrupt,
+    NotInitializedError,
+    StallError,
+)
+from .process_set import ProcessSet, ProcessSetTable
+from .state import (
+    GlobalState,
+    add_process_set,
+    global_state,
+    init,
+    initialized,
+    remove_process_set,
+    require_init,
+    shutdown,
+)
+from .topology import DCN_AXIS, ICI_AXIS, PROC_AXIS, WORLD_AXIS, Topology
+
+__all__ = [
+    "Config",
+    "HorovodInternalError",
+    "HorovodTpuError",
+    "HostsUpdatedInterrupt",
+    "NotInitializedError",
+    "StallError",
+    "ProcessSet",
+    "ProcessSetTable",
+    "GlobalState",
+    "add_process_set",
+    "global_state",
+    "init",
+    "initialized",
+    "remove_process_set",
+    "require_init",
+    "shutdown",
+    "Topology",
+    "WORLD_AXIS",
+    "DCN_AXIS",
+    "ICI_AXIS",
+    "PROC_AXIS",
+]

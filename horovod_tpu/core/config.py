@@ -1,0 +1,150 @@
+"""Environment-variable configuration, mirroring the reference's 3-layer
+config scheme (env vars as source of truth; launcher flags mirror them;
+see SURVEY.md §5.6).
+
+Every knob reads ``HVTPU_<NAME>`` first and falls back to the reference's
+``HOROVOD_<NAME>`` spelling so existing Horovod launch scripts keep working
+(reference: horovod/common/operations.cc env parsing in
+``InitializeHorovodOnce``; horovod/runner/launch.py flag->env mirroring).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env(name: str, default=None):
+    """HVTPU_x, falling back to HOROVOD_x, falling back to default."""
+    for prefix in ("HVTPU_", "HOROVOD_"):
+        v = os.environ.get(prefix + name)
+        if v is not None:
+            return v
+    return default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = _env(name)
+    return int(v) if v not in (None, "") else default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = _env(name)
+    return float(v) if v not in (None, "") else default
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = _env(name)
+    if v in (None, ""):
+        return default
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    v = _env(name)
+    return v if v not in (None, "") else default
+
+
+@dataclasses.dataclass
+class Config:
+    """Runtime configuration snapshot, read once at ``init()``.
+
+    Field-by-field parity with the reference env namespace
+    (HOROVOD_FUSION_THRESHOLD, HOROVOD_CYCLE_TIME, HOROVOD_CACHE_CAPACITY,
+    HOROVOD_STALL_CHECK_*, HOROVOD_TIMELINE*, HOROVOD_AUTOTUNE*,
+    HOROVOD_ELASTIC_*, HOROVOD_RANK/SIZE/... — SURVEY.md §5.6).
+    """
+
+    # --- fusion / cycle (FusionBufferManager + BackgroundThreadLoop knobs) ---
+    fusion_threshold_bytes: int = 64 * 1024 * 1024
+    cycle_time_ms: float = 1.0
+    cache_capacity: int = 1024
+    batch_d2d_memcopies: bool = True
+
+    # --- wire format / reduction ---
+    # "none" | "fp16" | "bf16" | "int8"  (int8 = EQuARX-style quantized wire)
+    compression: str = "none"
+    adasum: bool = False
+
+    # --- timeline / tracing ---
+    timeline_filename: Optional[str] = None
+    timeline_mark_cycles: bool = False
+
+    # --- stall inspector ---
+    stall_check_disable: bool = False
+    stall_check_time_seconds: float = 60.0
+    stall_shutdown_time_seconds: float = 0.0  # 0 = never abort
+
+    # --- autotune ---
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+
+    # --- logging ---
+    log_level: str = "warning"
+
+    # --- process topology (set by the launcher, like HOROVOD_RANK/SIZE) ---
+    rank: int = 0
+    size: int = 1
+    local_rank: int = 0
+    local_size: int = 1
+    cross_rank: int = 0
+    cross_size: int = 1
+
+    # --- coordination service (replaces the Gloo HTTP rendezvous KV) ---
+    coordinator_addr: Optional[str] = None
+    coordinator_port: int = 0
+
+    # --- controller (eager mini-controller) transport ---
+    controller_addr: Optional[str] = None
+    controller_port: int = 0
+
+    # --- elastic ---
+    elastic: bool = False
+    elastic_timeout: float = 600.0
+    elastic_discovery_interval: float = 1.0
+
+    @staticmethod
+    def from_env() -> "Config":
+        fusion_mb = _env_str("FUSION_THRESHOLD_MB")
+        if fusion_mb is not None:
+            fusion_bytes = int(float(fusion_mb) * 1024 * 1024)
+        else:
+            fusion_bytes = _env_int("FUSION_THRESHOLD", 64 * 1024 * 1024)
+        return Config(
+            fusion_threshold_bytes=fusion_bytes,
+            cycle_time_ms=_env_float("CYCLE_TIME", 1.0),
+            cache_capacity=_env_int("CACHE_CAPACITY", 1024),
+            batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),
+            compression=_env_str("COMPRESSION", "none"),
+            adasum=_env_bool("ADASUM", False),
+            timeline_filename=_env_str("TIMELINE"),
+            timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
+            stall_check_disable=_env_bool("STALL_CHECK_DISABLE", False),
+            stall_check_time_seconds=_env_float("STALL_CHECK_TIME_SECONDS", 60.0),
+            stall_shutdown_time_seconds=_env_float(
+                "STALL_SHUTDOWN_TIME_SECONDS", 0.0
+            ),
+            autotune=_env_bool("AUTOTUNE", False),
+            autotune_log=_env_str("AUTOTUNE_LOG"),
+            autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),
+            autotune_steps_per_sample=_env_int("AUTOTUNE_STEPS_PER_SAMPLE", 10),
+            log_level=_env_str("LOG_LEVEL", "warning"),
+            rank=_env_int("RANK", 0),
+            size=_env_int("SIZE", 1),
+            local_rank=_env_int("LOCAL_RANK", 0),
+            local_size=_env_int("LOCAL_SIZE", 1),
+            cross_rank=_env_int("CROSS_RANK", 0),
+            cross_size=_env_int("CROSS_SIZE", 1),
+            coordinator_addr=_env_str("COORDINATOR_ADDR"),
+            coordinator_port=_env_int("COORDINATOR_PORT", 0),
+            controller_addr=_env_str("CONTROLLER_ADDR"),
+            controller_port=_env_int("CONTROLLER_PORT", 0),
+            elastic=_env_bool("ELASTIC", False),
+            elastic_timeout=_env_float("ELASTIC_TIMEOUT", 600.0),
+            elastic_discovery_interval=_env_float(
+                "ELASTIC_DISCOVERY_INTERVAL", 1.0
+            ),
+        )

@@ -1,0 +1,45 @@
+"""Exception types for horovod_tpu.
+
+Parity surface: the reference's ``horovod/common/exceptions.py``
+(``HorovodInternalError``, ``HostsUpdatedInterrupt``) — the two exception
+types the elastic training loop catches to trigger state restore / re-init.
+"""
+
+
+class HorovodTpuError(Exception):
+    """Base class for all horovod_tpu errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """A collective operation failed (device loss, comm failure, desync).
+
+    Elastic training loops catch this, roll back to the last committed
+    state, re-initialize, and continue (see ``horovod_tpu.elastic``).
+    """
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """The set of participating hosts/slices changed (elastic membership).
+
+    Raised at a commit boundary after the worker-notification service flags
+    a membership change; the training loop re-initializes with the new
+    world without rolling back state.
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(HorovodTpuError):
+    """An API requiring ``horovod_tpu.init()`` was called before init."""
+
+    def __init__(self, name: str = "operation"):
+        super().__init__(
+            f"horovod_tpu has not been initialized; call horovod_tpu.init() "
+            f"before using {name}."
+        )
+
+
+class StallError(HorovodTpuError):
+    """The stall inspector declared a rank permanently missing."""

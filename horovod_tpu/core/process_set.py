@@ -1,0 +1,174 @@
+"""Process sets: named subsets of ranks with their own communicators.
+
+Parity surface: the reference's ``horovod/common/process_set.cc``
+(``ProcessSetTable``) and ``horovod/common/process_sets.py`` — named rank
+subsets, each with its own controller + communicator, addressed by id in
+every collective (``process_set_id`` argument).
+
+TPU-native mapping (SURVEY.md §5.8): a process set is a **sub-mesh**.
+Instead of lazily creating an NCCL communicator per (process set, device)
+pair, we lazily build:
+
+* an eager sub-mesh over the member processes' devices (the data plane
+  for eager collectives restricted to the set), and
+* ``axis_index_groups`` partitions for in-jit SPMD reductions, so a
+  single compiled program can reduce within the set while non-members
+  sit in singleton groups.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+from jax.sharding import Mesh
+
+from .topology import PROC_AXIS, Topology
+
+
+class ProcessSet:
+    """A subset of ranks (processes) that collectives can be scoped to.
+
+    ``ranks=None`` denotes the global set (all ranks).
+    """
+
+    def __init__(self, ranks: Optional[Sequence[int]] = None):
+        self.ranks: Optional[List[int]] = (
+            sorted(set(ranks)) if ranks is not None else None
+        )
+        self.process_set_id: Optional[int] = None
+        self._topology: Optional[Topology] = None
+        self._lock = threading.Lock()
+        self._proc_mesh: Optional[Mesh] = None
+
+    def _bind(self, process_set_id: int, topology: Topology, world_size: int):
+        self.process_set_id = process_set_id
+        self._topology = topology
+        if self.ranks is None:
+            self.ranks = list(range(world_size))
+
+    @property
+    def size(self) -> int:
+        assert self.ranks is not None
+        return len(self.ranks)
+
+    def rank_in_set(self, global_rank: int) -> int:
+        """Position of ``global_rank`` within the set (-1 if absent)."""
+        assert self.ranks is not None
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            return -1
+
+    def included(self, global_rank: int) -> bool:
+        return self.rank_in_set(global_rank) >= 0
+
+    def proc_mesh(self) -> Mesh:
+        """One-device-per-member-process mesh (eager data plane).
+
+        Lazily created and cached, mirroring the reference's lazy NCCL
+        communicator creation per process set.
+        """
+        assert self._topology is not None and self.ranks is not None
+        with self._lock:
+            if self._proc_mesh is None:
+                devs = [self._topology.process_device(r) for r in self.ranks]
+                self._proc_mesh = Mesh(
+                    np.asarray(devs, dtype=object), (PROC_AXIS,)
+                )
+            return self._proc_mesh
+
+    def device_groups(self) -> Optional[List[List[int]]]:
+        """``axis_index_groups`` partition of the world-mesh axis.
+
+        Member processes' devices form one group; non-member devices are
+        grouped into equal-size chunks when the counts divide evenly
+        (XLA requires equal-size replica groups for gather/scatter-shaped
+        collectives), falling back to singleton groups otherwise — which
+        psum/pmin/pmax accept but spmd.allgather/alltoall/reducescatter
+        reject with a clear error.  Non-members' results are their own
+        values and are expected to be unused.  Returns None for the
+        global set.
+        """
+        assert self._topology is not None and self.ranks is not None
+        devices = self._topology.devices
+        if len(self.ranks) == len({d.process_index for d in devices}):
+            return None
+        member = [
+            i for i, d in enumerate(devices) if d.process_index in self.ranks
+        ]
+        others = [
+            i for i, d in enumerate(devices)
+            if d.process_index not in self.ranks
+        ]
+        m = len(member)
+        if m and len(others) % m == 0:
+            rest = [others[i : i + m] for i in range(0, len(others), m)]
+        else:
+            rest = [[i] for i in others]
+        return [member] + rest
+
+    def __repr__(self):
+        return (
+            f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+        )
+
+
+class ProcessSetTable:
+    """Registry of process sets; id 0 is always the global set.
+
+    Parity: ``ProcessSetTable`` in horovod/common/process_set.cc and the
+    Python-side registry in horovod/common/process_sets.py.
+    """
+
+    def __init__(self, topology: Topology, world_size: int):
+        self._lock = threading.Lock()
+        self._topology = topology
+        self._world_size = world_size
+        self._table: Dict[int, ProcessSet] = {}
+        self._next_id = 0
+        self.global_process_set = ProcessSet(None)
+        self._register(self.global_process_set)
+
+    def _register(self, ps: ProcessSet) -> int:
+        psid = self._next_id
+        self._next_id += 1
+        ps._bind(psid, self._topology, self._world_size)
+        self._table[psid] = ps
+        return psid
+
+    def add(self, ps: ProcessSet) -> int:
+        with self._lock:
+            if ps.ranks is not None:
+                bad = [r for r in ps.ranks if not 0 <= r < self._world_size]
+                if bad:
+                    raise ValueError(
+                        f"ranks {bad} out of range for world size "
+                        f"{self._world_size}"
+                    )
+                for existing in self._table.values():
+                    if existing.ranks == sorted(set(ps.ranks)):
+                        raise ValueError(
+                            f"a process set with ranks {ps.ranks} already "
+                            f"exists (id {existing.process_set_id})"
+                        )
+            return self._register(ps)
+
+    def remove(self, psid: int):
+        with self._lock:
+            if psid == 0:
+                raise ValueError("cannot remove the global process set")
+            if psid not in self._table:
+                raise ValueError(f"unknown process set id {psid}")
+            del self._table[psid]
+
+    def get(self, psid: int) -> ProcessSet:
+        with self._lock:
+            if psid not in self._table:
+                raise ValueError(f"unknown process set id {psid}")
+            return self._table[psid]
+
+    def ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._table)

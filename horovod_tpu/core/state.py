@@ -1,0 +1,202 @@
+"""Process-wide global state, the analog of the reference's
+``HorovodGlobalState`` (horovod/common/operations.cc) plus the init /
+shutdown choreography of ``InitializeHorovodOnce`` / ``horovod_init``.
+
+Key design departure (SURVEY.md §7.0): on the jitted SPMD path there is
+no background controller thread — program order *is* the coordination.
+``init()`` therefore only (1) joins the JAX coordination service when a
+multi-process launch is detected (replacing the MPI/Gloo rendezvous),
+(2) snapshots config from env, and (3) builds the topology / process-set
+table.  The eager mini-controller (horovod_tpu.eager) is started lazily
+on first use of the async eager API.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Optional
+
+import jax
+
+from .config import Config
+from .exceptions import NotInitializedError
+from .process_set import ProcessSet, ProcessSetTable
+from .topology import Topology
+
+
+class GlobalState:
+    def __init__(self):
+        self.initialized = False
+        self.config: Optional[Config] = None
+        self.topology: Optional[Topology] = None
+        self.process_set_table: Optional[ProcessSetTable] = None
+        self.rank = 0
+        self.size = 1
+        self.local_rank = 0
+        self.local_size = 1
+        self.cross_rank = 0
+        self.cross_size = 1
+        self.distributed_initialized_by_us = False
+        # Lazily-started eager mini-controller (horovod_tpu.eager).
+        self.controller = None
+        # Timeline writer (horovod_tpu.obs.timeline), if enabled.
+        self.timeline = None
+        # Autotuner (horovod_tpu.obs.autotune), if enabled.
+        self.autotuner = None
+
+
+_state = GlobalState()
+_init_lock = threading.Lock()
+
+
+def _coordination_client_active() -> bool:
+    """True if jax.distributed is already initialized, checked WITHOUT
+    triggering XLA backend initialization (jax.process_count() would)."""
+    try:
+        from jax._src import distributed as _jd
+
+        return _jd.global_state.client is not None
+    except Exception:
+        return False
+
+
+def global_state() -> GlobalState:
+    return _state
+
+
+def initialized() -> bool:
+    return _state.initialized
+
+
+def require_init(name: str = "this operation") -> GlobalState:
+    if not _state.initialized:
+        raise NotInitializedError(name)
+    return _state
+
+
+def init(config: Optional[Config] = None) -> GlobalState:
+    """Initialize horovod_tpu (idempotent, like ``InitializeHorovodOnce``)."""
+    with _init_lock:
+        if _state.initialized:
+            return _state
+        cfg = config or Config.from_env()
+
+        # Multi-process launch (set up by hvtpurun, like HOROVOD_RANK/SIZE
+        # env from the reference launcher): join the JAX coordination
+        # service — the TPU-native replacement for the Gloo HTTP
+        # rendezvous KV store (horovod/runner/http/http_server.py).
+        # NOTE: this must happen before anything touches the XLA backend
+        # (jax.devices()/process_count() would lock in a local-only view),
+        # so membership is decided from config + coordination-client
+        # state alone.
+        if cfg.size > 1 and not _coordination_client_active():
+            if not cfg.coordinator_addr:
+                raise ValueError(
+                    "HVTPU_SIZE > 1 but HVTPU_COORDINATOR_ADDR is unset; "
+                    "launch with hvtpurun or set coordinator env vars"
+                )
+            jax.distributed.initialize(
+                coordinator_address=(
+                    f"{cfg.coordinator_addr}:{cfg.coordinator_port}"
+                ),
+                num_processes=cfg.size,
+                process_id=cfg.rank,
+            )
+            _state.distributed_initialized_by_us = True
+
+        _state.config = cfg
+        _state.rank = jax.process_index()
+        _state.size = jax.process_count()
+        # local/cross topology comes from the launcher when present;
+        # single-host default is local == world.
+        if cfg.size > 1:
+            _state.local_rank = cfg.local_rank
+            _state.local_size = cfg.local_size
+            _state.cross_rank = cfg.cross_rank
+            _state.cross_size = cfg.cross_size
+        else:
+            _state.local_rank = _state.rank
+            _state.local_size = _state.size
+            _state.cross_rank = 0
+            _state.cross_size = 1
+
+        _state.topology = Topology()
+        _state.process_set_table = ProcessSetTable(
+            _state.topology, _state.size
+        )
+
+        if cfg.timeline_filename:
+            from ..obs.timeline import Timeline
+
+            _state.timeline = Timeline(
+                cfg.timeline_filename,
+                _state.rank,
+                mark_cycles=cfg.timeline_mark_cycles,
+            )
+        if cfg.autotune:
+            from ..obs.autotune import Autotuner
+
+            _state.autotuner = Autotuner(cfg)
+
+        _state.initialized = True
+        atexit.register(_shutdown_at_exit)
+        return _state
+
+
+def shutdown():
+    """Tear down (parity: ``horovod_shutdown``)."""
+    with _init_lock:
+        if not _state.initialized:
+            return
+        if _state.controller is not None:
+            try:
+                _state.controller.stop()
+            except Exception:
+                pass
+            _state.controller = None
+        if _state.timeline is not None:
+            try:
+                _state.timeline.close()
+            except Exception:
+                pass
+            _state.timeline = None
+        _state.autotuner = None
+        if _state.distributed_initialized_by_us:
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            _state.distributed_initialized_by_us = False
+        _state.initialized = False
+        _state.config = None
+        _state.topology = None
+        _state.process_set_table = None
+        _state.rank, _state.size = 0, 1
+        _state.local_rank, _state.local_size = 0, 1
+        _state.cross_rank, _state.cross_size = 0, 1
+
+
+def _shutdown_at_exit():
+    try:
+        shutdown()
+    except Exception:
+        pass
+
+
+def add_process_set(ps) -> ProcessSet:
+    st = require_init("add_process_set")
+    if not isinstance(ps, ProcessSet):
+        ps = ProcessSet(ps)
+    st.process_set_table.add(ps)
+    return ps
+
+
+def remove_process_set(ps) -> bool:
+    st = require_init("remove_process_set")
+    psid = ps.process_set_id if isinstance(ps, ProcessSet) else int(ps)
+    try:
+        st.process_set_table.remove(psid)
+        return True
+    except ValueError:
+        return False

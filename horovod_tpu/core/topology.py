@@ -1,0 +1,149 @@
+"""Device topology and mesh construction.
+
+TPU-native replacement for the reference's communicator plumbing
+(horovod/common/mpi/mpi_context.cc ``MPIContext::Initialize`` building
+global/local/cross MPI communicators; NCCL comm creation in
+horovod/common/ops/nccl_operations.cc ``NCCLOpContext::InitNCCLComm``).
+
+On TPU there is no NCCL ring setup: collectives lower to XLA ops over the
+ICI torus, and "communicator creation" becomes "mesh construction".  This
+module builds and caches the meshes everything else shards over:
+
+* the **world mesh** — one axis over every device in the job (ICI order,
+  with DCN-aware ordering for multi-host slices);
+* the **hierarchical mesh** — ``("dcn", "ici")`` axes separating
+  cross-host (slow) from intra-slice (fast) links, the analog of the
+  reference's hierarchical allreduce (``NCCLHierarchicalAllreduce``);
+* the **process mesh** — one device per participating process, which is
+  the data plane for eager Horovod-style collectives (one process = one
+  Horovod rank).
+
+Like NCCL comms in the reference, meshes are created lazily and cached.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names.
+WORLD_AXIS = "world"   # flat axis over all devices
+DCN_AXIS = "dcn"       # cross-host / cross-slice (data-center network)
+ICI_AXIS = "ici"       # intra-slice interconnect
+PROC_AXIS = "proc"     # one device per process (eager data plane)
+
+
+class Topology:
+    """Lazily-built, cached mesh factory over a fixed device set."""
+
+    def __init__(self, devices: Optional[Sequence[jax.Device]] = None):
+        self._devices = list(devices) if devices is not None else None
+        self._lock = threading.Lock()
+        self._world_mesh: Optional[Mesh] = None
+        self._proc_mesh: Optional[Mesh] = None
+        self._hier_mesh: Optional[Mesh] = None
+
+    # -- device sets ---------------------------------------------------
+
+    @property
+    def devices(self):
+        if self._devices is None:
+            self._devices = list(jax.devices())
+        return self._devices
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    @property
+    def num_local_devices(self) -> int:
+        pid = jax.process_index()
+        return sum(1 for d in self.devices if d.process_index == pid)
+
+    def process_device(self, process_index: int) -> jax.Device:
+        """The representative (first) device owned by a process."""
+        for d in self.devices:
+            if d.process_index == process_index:
+                return d
+        raise ValueError(f"no device owned by process {process_index}")
+
+    # -- meshes --------------------------------------------------------
+
+    def world_mesh(self) -> Mesh:
+        """1-D mesh with axis ``world`` over every device.
+
+        Device order follows ``jax.devices()`` which XLA already orders
+        for ICI locality within a slice.
+        """
+        with self._lock:
+            if self._world_mesh is None:
+                self._world_mesh = Mesh(
+                    np.asarray(self.devices, dtype=object), (WORLD_AXIS,)
+                )
+            return self._world_mesh
+
+    def hierarchical_mesh(self) -> Mesh:
+        """2-D ``(dcn, ici)`` mesh: processes × local devices.
+
+        The ``ici`` axis stays inside a host/slice (fast links); the
+        ``dcn`` axis crosses hosts.  Collectives that reduce over ``ici``
+        first and ``dcn`` second get the reference's hierarchical
+        allreduce for free from XLA.
+        """
+        with self._lock:
+            if self._hier_mesh is None:
+                devs = self.devices
+                procs = sorted({d.process_index for d in devs})
+                per_proc = {}
+                for d in devs:
+                    per_proc.setdefault(d.process_index, []).append(d)
+                counts = {len(v) for v in per_proc.values()}
+                if len(counts) != 1:
+                    raise ValueError(
+                        "hierarchical mesh requires equal device counts per "
+                        f"process; got {sorted(counts)}"
+                    )
+                grid = np.asarray(
+                    [per_proc[p] for p in procs], dtype=object
+                )
+                self._hier_mesh = Mesh(grid, (DCN_AXIS, ICI_AXIS))
+            return self._hier_mesh
+
+    def proc_mesh(self) -> Mesh:
+        """1-D mesh with one device per process, axis ``proc``.
+
+        This is the eager data plane: Horovod rank r ↔ process r ↔ its
+        first device.  Eager collectives stack per-rank tensors along
+        this axis and reduce with a jitted ``shard_map``.
+        """
+        with self._lock:
+            if self._proc_mesh is None:
+                procs = sorted({d.process_index for d in self.devices})
+                reps = [self.process_device(p) for p in procs]
+                self._proc_mesh = Mesh(
+                    np.asarray(reps, dtype=object), (PROC_AXIS,)
+                )
+            return self._proc_mesh
+
+    def nd_mesh(self, axis_names: Tuple[str, ...], shape: Tuple[int, ...]) -> Mesh:
+        """Arbitrary N-D mesh (e.g. ``("dp","tp","sp")``) over all devices.
+
+        Uses ``mesh_utils.create_device_mesh`` so the trailing axes land
+        on physically adjacent ICI neighbors (bandwidth-heavy axes should
+        come last).
+        """
+        if int(np.prod(shape)) != self.num_devices:
+            raise ValueError(
+                f"mesh shape {shape} does not cover {self.num_devices} devices"
+            )
+        from jax.experimental import mesh_utils
+
+        try:
+            grid = mesh_utils.create_device_mesh(shape, devices=self.devices)
+        except Exception:
+            grid = np.asarray(self.devices, dtype=object).reshape(shape)
+        return Mesh(grid, axis_names)

@@ -1,0 +1,4 @@
+from .autotune import Autotuner
+from .timeline import Timeline, start_jax_profiler, stop_jax_profiler
+
+__all__ = ["Autotuner", "Timeline", "start_jax_profiler", "stop_jax_profiler"]

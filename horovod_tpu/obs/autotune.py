@@ -1,0 +1,97 @@
+"""Online autotuning of fusion threshold / cycle time.
+
+Parity surface: ``horovod/common/parameter_manager.cc``
+(``ParameterManager``) + ``horovod/common/optim/bayesian_optimization.cc``
+— enabled by ``HVTPU_AUTOTUNE=1``, scoring each sampled configuration by
+observed throughput and converging on the best, optionally logging every
+sample to ``HVTPU_AUTOTUNE_LOG`` as CSV.
+
+The reference fits a Gaussian process over (fusion threshold, cycle
+time).  Here the search space is the discrete log-grid below and the
+tuner is successive sampling with exploitation after warmup: each
+candidate gets ``autotune_steps_per_sample`` steps, scores are
+bytes/sec, and after one sweep the best candidate is pinned.  On TPU
+the eager path is the only consumer (the jit path fuses at compile
+time), so cheap-and-robust beats a GP fit; the scoring/pinning API
+matches the reference so a GP can be dropped in later.
+"""
+
+from __future__ import annotations
+
+import csv
+import time
+from typing import List, Optional, Tuple
+
+# (fusion_threshold_bytes, cycle_time_ms) candidates — log grid around
+# the reference defaults (64 MB, 1-5 ms).
+_DEFAULT_GRID: List[Tuple[int, float]] = [
+    (2 * 1024 * 1024, 1.0),
+    (8 * 1024 * 1024, 1.0),
+    (32 * 1024 * 1024, 1.0),
+    (64 * 1024 * 1024, 1.0),
+    (64 * 1024 * 1024, 2.5),
+    (128 * 1024 * 1024, 2.5),
+    (128 * 1024 * 1024, 5.0),
+]
+
+
+class Autotuner:
+    def __init__(self, config, grid: Optional[List[Tuple[int, float]]] = None):
+        self._grid = list(grid or _DEFAULT_GRID)
+        self._steps_per_sample = max(1, config.autotune_steps_per_sample)
+        self._warmup = max(0, config.autotune_warmup_samples)
+        self._log_path = config.autotune_log
+        self._scores: List[float] = []
+        self._candidate = 0
+        self._steps = 0
+        self._bytes = 0
+        self._t_start = time.monotonic()
+        self._pinned: Optional[Tuple[int, float]] = None
+        self._warmup_left = self._warmup
+        if self._log_path:
+            with open(self._log_path, "w", newline="") as f:
+                csv.writer(f).writerow(
+                    ["fusion_threshold", "cycle_time_ms", "bytes_per_sec"]
+                )
+
+    @property
+    def current(self) -> Tuple[int, float]:
+        """Active (fusion_threshold_bytes, cycle_time_ms)."""
+        if self._pinned is not None:
+            return self._pinned
+        return self._grid[self._candidate]
+
+    @property
+    def done(self) -> bool:
+        return self._pinned is not None
+
+    def record_step(self, nbytes: int):
+        """Report one training/communication step of ``nbytes`` reduced.
+
+        Drives the sampling schedule; call once per step from the eager
+        controller cycle (or a training loop).
+        """
+        if self._pinned is not None:
+            return
+        if self._warmup_left > 0:
+            self._warmup_left -= 1
+            self._t_start = time.monotonic()
+            return
+        self._steps += 1
+        self._bytes += nbytes
+        if self._steps < self._steps_per_sample:
+            return
+        elapsed = max(time.monotonic() - self._t_start, 1e-9)
+        score = self._bytes / elapsed
+        self._scores.append(score)
+        if self._log_path:
+            thr, cyc = self._grid[self._candidate]
+            with open(self._log_path, "a", newline="") as f:
+                csv.writer(f).writerow([thr, cyc, f"{score:.1f}"])
+        self._candidate += 1
+        self._steps = 0
+        self._bytes = 0
+        self._t_start = time.monotonic()
+        if self._candidate >= len(self._grid):
+            best = max(range(len(self._scores)), key=self._scores.__getitem__)
+            self._pinned = self._grid[best]

@@ -1,0 +1,153 @@
+"""Per-tensor lifecycle tracing to Chrome trace-event JSON.
+
+Parity surface: ``horovod/common/timeline.cc`` (``Timeline``,
+``TimelineController``) — enabled by ``HVTPU_TIMELINE=/path.json``
+(or the reference spelling ``HOROVOD_TIMELINE``), loadable in
+``chrome://tracing`` / Perfetto.  Phases mirror the reference's
+per-tensor states (NEGOTIATE_* → QUEUE → MEMCPY_IN_FUSION_BUFFER →
+<collective> → MEMCPY_OUT_FUSION_BUFFER), with TPU-native phase names
+where the mechanism differs (e.g. ``ICI_ALLREDUCE`` instead of
+``NCCL_ALLREDUCE``; ``TRACE``/``COMPILE`` for XLA compilation, which
+has no reference analog).
+
+For on-device detail (per-op HLO timing) ``start_jax_profiler`` wraps
+``jax.profiler`` — the TPU analog of the reference's NVTX ranges
+(horovod/common/nvtx_op_range.cc).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+# Reference-parity phase names (timeline.cc writes these as event names).
+NEGOTIATE = "NEGOTIATE"
+QUEUE = "QUEUE"
+MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER"
+ICI_ALLREDUCE = "ICI_ALLREDUCE"
+MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
+COMPILE = "COMPILE"
+CYCLE = "CYCLE"
+
+
+class Timeline:
+    """Thread-safe incremental Chrome-trace writer.
+
+    Events: ``begin(name, phase)`` / ``end(name)`` duration pairs on a
+    per-tensor track, plus ``instant`` marks and ``mark_cycle`` (the
+    reference's HOROVOD_TIMELINE_MARK_CYCLES).
+    """
+
+    def __init__(self, filename: str, rank: int = 0, mark_cycles: bool = False):
+        self._filename = filename
+        self._rank = rank
+        self._mark_cycles = mark_cycles
+        self._lock = threading.Lock()
+        self._file = open(filename, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._t0 = time.monotonic()
+        self._open_spans = {}
+        self._closed = False
+        self._emit(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": rank,
+                "args": {"name": f"hvtpu rank {rank}"},
+            }
+        )
+
+    def _now_us(self) -> float:
+        return (time.monotonic() - self._t0) * 1e6
+
+    def _emit(self, event: dict):
+        with self._lock:
+            if self._closed:
+                return
+            if not self._first:
+                self._file.write(",\n")
+            self._first = False
+            json.dump(event, self._file)
+            self._file.flush()
+
+    def begin(self, tensor_name: str, phase: str):
+        self._open_spans[tensor_name] = phase
+        self._emit(
+            {
+                "name": phase,
+                "cat": "tensor",
+                "ph": "B",
+                "ts": self._now_us(),
+                "pid": self._rank,
+                "tid": hash(tensor_name) % (1 << 31),
+                "args": {"tensor": tensor_name},
+            }
+        )
+
+    def end(self, tensor_name: str):
+        phase = self._open_spans.pop(tensor_name, None)
+        if phase is None:
+            return
+        self._emit(
+            {
+                "name": phase,
+                "cat": "tensor",
+                "ph": "E",
+                "ts": self._now_us(),
+                "pid": self._rank,
+                "tid": hash(tensor_name) % (1 << 31),
+            }
+        )
+
+    def instant(self, name: str, **args):
+        self._emit(
+            {
+                "name": name,
+                "ph": "i",
+                "s": "p",
+                "ts": self._now_us(),
+                "pid": self._rank,
+                "tid": 0,
+                "args": args,
+            }
+        )
+
+    def mark_cycle(self, cycle_index: int):
+        """Mark a controller cycle; no-op unless mark_cycles was enabled
+        (parity: HOROVOD_TIMELINE_MARK_CYCLES)."""
+        if self._mark_cycles:
+            self.instant(CYCLE, index=cycle_index)
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._file.write("\n]\n")
+            self._file.close()
+
+
+# --- jax.profiler integration (NVTX analog) -----------------------------
+
+_profiler_dir: Optional[str] = None
+
+
+def start_jax_profiler(log_dir: str):
+    """Start an on-device XLA trace (view in TensorBoard/Perfetto)."""
+    global _profiler_dir
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    _profiler_dir = log_dir
+
+
+def stop_jax_profiler():
+    global _profiler_dir
+    import jax
+
+    jax.profiler.stop_trace()
+    _profiler_dir = None

@@ -1,0 +1,43 @@
+"""Test harness: 8 virtual CPU devices, one process.
+
+This replicates the reference's localhost-as-cluster pattern
+(SURVEY.md §4: all "multi-node" CI is N processes on loopback): here the
+world is N=8 XLA CPU devices in one process, and test bodies are SPMD
+(rank-oblivious shard_map bodies), the analog of tests running under
+``horovodrun -np 8``.
+
+NOTE: this sandbox pre-imports jax via sitecustomize with the TPU
+platform pinned in env, so the CPU override must use jax.config.update
+(env vars are read too early to take effect here).
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _ensure_cpu_devices():
+    assert jax.device_count() == 8, (
+        "test harness expected 8 virtual CPU devices, got "
+        f"{jax.device_count()}"
+    )
+    yield
+
+
+@pytest.fixture()
+def hvt():
+    """Fresh-initialized horovod_tpu for a test, shut down afterwards."""
+    import horovod_tpu as hvt_mod
+
+    hvt_mod.init()
+    yield hvt_mod
+    hvt_mod.shutdown()
+
+
+@pytest.fixture(scope="session")
+def world_axis():
+    return "world"

@@ -1,0 +1,84 @@
+"""Eager API surface tests (single-process world, P=1 semantics) plus
+async-handle behavior — parity targets: horovod/torch/mpi_ops.py eager
+ops and handle_manager synchronize/poll.
+
+Multi-process eager behavior is covered by the runner-launched tests
+(test_multiprocess.py) which spawn real worker processes, the analog of
+the reference's horovodrun-под tests.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu
+
+
+class TestEagerSingleProcess:
+    def test_allreduce_identity(self, hvt):
+        x = jnp.arange(6.0).reshape(2, 3)
+        out = hvt.allreduce(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+    def test_allreduce_scales(self, hvt):
+        x = jnp.ones((4,))
+        out = hvt.allreduce(x, prescale_factor=2.0, postscale_factor=3.0)
+        np.testing.assert_allclose(np.asarray(out), np.full((4,), 6.0))
+
+    def test_grouped_allreduce(self, hvt):
+        outs = hvt.grouped_allreduce([jnp.ones((2,)), jnp.full((3,), 2.0)])
+        assert len(outs) == 2
+        np.testing.assert_allclose(np.asarray(outs[1]), np.full((3,), 2.0))
+
+    def test_allgather(self, hvt):
+        x = jnp.ones((3, 2))
+        out = hvt.allgather(x)
+        assert out.shape == (3, 2)
+
+    def test_broadcast(self, hvt):
+        x = jnp.arange(4.0)
+        out = hvt.broadcast(x, root_rank=0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+    def test_alltoall_bare_return_without_splits(self, hvt):
+        # reference convention: no splits → bare tensor
+        x = jnp.arange(6.0).reshape(6, 1)
+        out = hvt.alltoall(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+    def test_alltoall_tuple_return_with_splits(self, hvt):
+        x = jnp.arange(6.0).reshape(6, 1)
+        out, splits = hvt.alltoall(x, splits=[6])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+        assert np.asarray(splits).tolist() == [6]
+
+    def test_reducescatter(self, hvt):
+        x = jnp.ones((4, 2))
+        out = hvt.reducescatter(x)
+        assert out.shape == (4, 2)
+
+    def test_barrier_and_join(self, hvt):
+        hvt.barrier()
+        assert hvt.join() == 0
+
+    def test_async_and_synchronize(self, hvt):
+        h = hvt.allreduce_async(jnp.ones((2,)))
+        assert hvt.poll(h)
+        out = hvt.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out), np.ones((2,)))
+        with pytest.raises(ValueError):
+            hvt.synchronize(h)  # double-sync of same handle
+
+
+class TestStateDistribution:
+    def test_broadcast_parameters_roundtrip(self, hvt):
+        params = {"w": jnp.ones((2, 2)), "b": jnp.zeros((2,))}
+        out = hvt.broadcast_parameters(params, root_rank=0)
+        np.testing.assert_allclose(np.asarray(out["w"]), np.ones((2, 2)))
+
+    def test_broadcast_object(self, hvt):
+        obj = {"epoch": 3, "names": ["a", "b"]}
+        assert hvt.broadcast_object(obj, root_rank=0) == obj
+
+    def test_allgather_object(self, hvt):
+        assert hvt.allgather_object({"r": 0}) == [{"r": 0}]

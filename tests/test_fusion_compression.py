@@ -1,0 +1,135 @@
+"""Fusion bucketing + wire compression tests (parity targets:
+FusionBufferManager semantics, Compression.fp16, EQuARX-style int8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.comm import Compression, ReduceOp
+from horovod_tpu.comm.fusion import (
+    fused_tree_allreduce,
+    plan_buckets,
+    plan_for_tree,
+)
+
+AXIS = "world"
+
+
+def mesh8():
+    return Mesh(np.asarray(jax.devices(), dtype=object), (AXIS,))
+
+
+class TestBucketPlan:
+    def _leaves(self, sizes):
+        return [np.zeros((s,), np.float32) for s in sizes]
+
+    def test_deterministic_sorted_order(self):
+        names = ["b", "a", "c"]
+        plan = plan_buckets(names, self._leaves([4, 4, 4]), 1 << 30)
+        flat = [e.name for b in plan.buckets for e in b]
+        assert flat == ["a", "b", "c"]
+
+    def test_threshold_splits(self):
+        # 4 tensors of 256B with a 512B threshold → 2 buckets of 2.
+        names = list("abcd")
+        plan = plan_buckets(names, self._leaves([64] * 4), 512)
+        assert plan.num_buckets == 2
+        assert all(len(b) == 2 for b in plan.buckets)
+
+    def test_oversized_tensor_gets_own_bucket(self):
+        names = ["big", "s1", "s2"]
+        plan = plan_buckets(names, self._leaves([1024, 2, 2]), 128)
+        sizes_per_bucket = [[e.name for e in b] for b in plan.buckets]
+        assert ["big"] in sizes_per_bucket
+
+    def test_plan_for_tree_names_are_paths(self):
+        tree = {"layer1": {"w": np.zeros((2, 2), np.float32)},
+                "layer0": np.zeros((3,), np.float32)}
+        plan, _ = plan_for_tree(tree, 1 << 30)
+        names = [e.name for b in plan.buckets for e in b]
+        assert names == sorted(names)
+        assert any("layer1" in n and "w" in n for n in names)
+
+
+class TestFusedTreeAllreduce:
+    def _run(self, tree, **kw):
+        def body(t):
+            return fused_tree_allreduce(
+                t, axis_name=AXIS, threshold_bytes=kw.pop("threshold", 64),
+                **kw,
+            )
+
+        return jax.jit(
+            jax.shard_map(
+                body, mesh=mesh8(), in_specs=(P(),), out_specs=P(),
+                check_vma=False,
+            )
+        )(tree)
+
+    def test_sum_across_replicas(self):
+        tree = {"a": jnp.ones((3, 3)), "b": {"c": jnp.full((5,), 2.0)}}
+        out = self._run(tree, op=ReduceOp.SUM)
+        np.testing.assert_allclose(np.asarray(out["a"]), np.full((3, 3), 8.0))
+        np.testing.assert_allclose(np.asarray(out["b"]["c"]), np.full((5,), 16.0))
+
+    def test_average(self):
+        tree = {"a": jnp.full((4,), 3.0)}
+        out = self._run(tree, op=ReduceOp.AVERAGE)
+        np.testing.assert_allclose(np.asarray(out["a"]), np.full((4,), 3.0))
+
+    def test_mixed_dtypes_roundtrip(self):
+        tree = {"w": jnp.ones((4,), jnp.bfloat16), "b": jnp.ones((2,), jnp.float32)}
+        out = self._run(tree, op=ReduceOp.SUM)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["b"].dtype == jnp.float32
+
+    def test_compressed_bucket(self):
+        tree = {"a": jnp.full((64,), 0.125), "b": jnp.full((32,), 0.25)}
+        out = self._run(tree, op=ReduceOp.SUM, compression=Compression.bf16)
+        np.testing.assert_allclose(np.asarray(out["a"]), np.full((64,), 1.0))
+
+    def test_adasum_fused(self):
+        tree = {"a": jnp.ones((8,))}
+        out = self._run(tree, op=ReduceOp.ADASUM)
+        # identical inputs → adasum keeps the gradient
+        np.testing.assert_allclose(np.asarray(out["a"]), np.ones((8,)), rtol=1e-4)
+
+
+class TestCompressionRoundtrip:
+    @pytest.mark.parametrize("comp,tol", [
+        (Compression.fp16, 1e-3), (Compression.bf16, 1e-2),
+        (Compression.int8, 2e-2),
+    ])
+    def test_roundtrip_error(self, comp, tol):
+        rng = np.random.RandomState(11)
+        x = jnp.asarray(rng.randn(1000).astype(np.float32))
+        wire, ctx = comp.compress(x)
+        back = comp.decompress(wire, ctx)
+        assert back.dtype == x.dtype
+        assert back.shape == x.shape
+        err = np.abs(np.asarray(back) - np.asarray(x)).max()
+        assert err < tol * np.abs(np.asarray(x)).max() + 1e-6
+
+    def test_none_is_identity(self):
+        x = jnp.arange(5.0)
+        wire, ctx = Compression.none.compress(x)
+        assert wire is x
+        assert Compression.none.decompress(wire, ctx) is x
+
+    def test_int_tensors_pass_through(self):
+        x = jnp.arange(5, dtype=jnp.int32)
+        wire, ctx = Compression.fp16.compress(x)
+        assert wire.dtype == jnp.int32
+
+    def test_int8_nonmultiple_block(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(1000).astype(np.float32))
+        wire, ctx = Compression.int8.compress(x)
+        back = Compression.int8.decompress(wire, ctx)
+        assert back.shape == (1000,)
+
+    def test_from_name(self):
+        assert Compression.from_name("fp16") is Compression.fp16
+        with pytest.raises(ValueError):
+            Compression.from_name("zstd")

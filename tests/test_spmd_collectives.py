@@ -1,0 +1,289 @@
+"""SPMD collective correctness matrix.
+
+The analog of the reference's test/parallel/test_torch.py op × dtype ×
+path matrix (SURVEY.md §4): test bodies are rank-oblivious shard_map
+functions run over an 8-device mesh — the TPU-native equivalent of
+"every rank runs the same asserts under horovodrun -np 8".
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.comm import Compression, ReduceOp, spmd
+from horovod_tpu.comm.adasum import adasum_reduce_reference
+
+AXIS = "world"
+
+
+def mesh8():
+    return Mesh(np.asarray(jax.devices(), dtype=object), (AXIS,))
+
+
+def run_spmd(body, args, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh8(), in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    )(*args)
+
+
+DTYPES = [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int32]
+
+
+class TestAllreduce:
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_sum(self, dtype):
+        x = jnp.arange(8 * 4, dtype=dtype).reshape(8, 4)
+
+        def body(s):
+            return spmd.allreduce(s[0], axis_name=AXIS, op=ReduceOp.SUM)[None]
+
+        out = run_spmd(body, (x,), (P(AXIS),), P(AXIS))
+        expect = np.asarray(x, np.float64).sum(0)
+        np.testing.assert_allclose(
+            np.asarray(out[3], np.float64), expect, rtol=1e-2
+        )
+
+    def test_average(self):
+        x = jnp.arange(8.0).reshape(8, 1)
+
+        def body(s):
+            return spmd.allreduce(s[0], axis_name=AXIS, op=ReduceOp.AVERAGE)[None]
+
+        out = run_spmd(body, (x,), (P(AXIS),), P(AXIS))
+        np.testing.assert_allclose(np.asarray(out).ravel(), [3.5] * 8)
+
+    def test_average_int_floordiv(self):
+        x = jnp.arange(8, dtype=jnp.int32).reshape(8, 1)
+
+        def body(s):
+            return spmd.allreduce(s[0], axis_name=AXIS, op=ReduceOp.AVERAGE)[None]
+
+        out = run_spmd(body, (x,), (P(AXIS),), P(AXIS))
+        assert np.asarray(out).ravel().tolist() == [28 // 8] * 8
+
+    @pytest.mark.parametrize("op,npop", [
+        (ReduceOp.MIN, np.min), (ReduceOp.MAX, np.max),
+        (ReduceOp.PRODUCT, np.prod),
+    ])
+    def test_min_max_product(self, op, npop):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.uniform(0.5, 1.5, (8, 3)).astype(np.float32))
+
+        def body(s):
+            return spmd.allreduce(s[0], axis_name=AXIS, op=op)[None]
+
+        out = run_spmd(body, (x,), (P(AXIS),), P(AXIS))
+        np.testing.assert_allclose(
+            np.asarray(out[0]), npop(np.asarray(x), axis=0), rtol=1e-5
+        )
+
+    def test_prescale_postscale(self):
+        x = jnp.ones((8, 2))
+
+        def body(s):
+            return spmd.allreduce(
+                s[0], axis_name=AXIS, op=ReduceOp.SUM,
+                prescale_factor=0.5, postscale_factor=3.0,
+            )[None]
+
+        out = run_spmd(body, (x,), (P(AXIS),), P(AXIS))
+        np.testing.assert_allclose(np.asarray(out[0]), np.full((2,), 12.0))
+
+    def test_legacy_average_kwarg(self):
+        x = jnp.arange(8.0).reshape(8, 1)
+
+        def body(s):
+            return spmd.allreduce(s[0], axis_name=AXIS, average=False)[None]
+
+        out = run_spmd(body, (x,), (P(AXIS),), P(AXIS))
+        np.testing.assert_allclose(np.asarray(out).ravel(), [28.0] * 8)
+
+    @pytest.mark.parametrize("comp", [Compression.fp16, Compression.bf16])
+    def test_compressed_wire(self, comp):
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+
+        def body(s):
+            return spmd.allreduce(
+                s[0], axis_name=AXIS, op=ReduceOp.SUM, compression=comp
+            )[None]
+
+        out = run_spmd(body, (x,), (P(AXIS),), P(AXIS))
+        assert out.dtype == jnp.float32  # decompressed back
+        np.testing.assert_allclose(
+            np.asarray(out[0]), np.asarray(x).sum(0), rtol=5e-2, atol=5e-2
+        )
+
+    def test_explicit_groups(self):
+        # Two groups of 4: the analog of a process-set-scoped allreduce.
+        x = jnp.arange(8.0).reshape(8, 1)
+        groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+        def body(s):
+            return spmd.allreduce(
+                s[0], axis_name=AXIS, op=ReduceOp.SUM, groups=groups
+            )[None]
+
+        out = np.asarray(run_spmd(body, (x,), (P(AXIS),), P(AXIS))).ravel()
+        np.testing.assert_allclose(out[:4], [6.0] * 4)
+        np.testing.assert_allclose(out[4:], [22.0] * 4)
+
+
+class TestGroupedAllreduce:
+    def test_matches_individual(self):
+        rng = np.random.RandomState(3)
+        a = jnp.asarray(rng.randn(8, 3).astype(np.float32))
+        b = jnp.asarray(rng.randn(8, 5, 2).astype(np.float32))
+
+        def body(sa, sb):
+            ra, rb = spmd.grouped_allreduce(
+                [sa[0], sb[0]], axis_name=AXIS, op=ReduceOp.AVERAGE
+            )
+            return ra[None], rb[None]
+
+        oa, ob = run_spmd(
+            body, (a, b), (P(AXIS), P(AXIS)), (P(AXIS), P(AXIS))
+        )
+        np.testing.assert_allclose(
+            np.asarray(oa[0]), np.asarray(a).mean(0), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(ob[0]), np.asarray(b).mean(0), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestAllgather:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.int32])
+    def test_gather_dim0(self, dtype):
+        x = jnp.arange(8 * 2 * 3, dtype=dtype).reshape(8, 2, 3)
+
+        def body(s):
+            return spmd.allgather(s[0], axis_name=AXIS)[None]
+
+        out = run_spmd(body, (x,), (P(AXIS),), P(AXIS))
+        # every participant sees the full concatenation
+        np.testing.assert_array_equal(
+            np.asarray(out[0]), np.asarray(x).reshape(16, 3)
+        )
+
+
+class TestBroadcast:
+    @pytest.mark.parametrize("root", [0, 3, 7])
+    def test_root(self, root):
+        x = jnp.arange(8.0).reshape(8, 1) + 1.0
+
+        def body(s):
+            return spmd.broadcast(s[0], root_rank=root, axis_name=AXIS)[None]
+
+        out = run_spmd(body, (x,), (P(AXIS),), P(AXIS))
+        np.testing.assert_allclose(np.asarray(out).ravel(), [root + 1.0] * 8)
+
+    def test_bool(self):
+        x = jnp.asarray([i == 2 for i in range(8)]).reshape(8, 1)
+
+        def body(s):
+            return spmd.broadcast(s[0], root_rank=2, axis_name=AXIS)[None]
+
+        out = run_spmd(body, (x,), (P(AXIS),), P(AXIS))
+        assert out.dtype == jnp.bool_
+        assert np.asarray(out).all()
+
+
+class TestAlltoall:
+    def test_exchange(self):
+        # participant i sends row j of its shard to participant j
+        x = jnp.arange(8 * 8, dtype=jnp.float32).reshape(8, 8, 1)
+
+        def body(s):
+            return spmd.alltoall(s[0], axis_name=AXIS)[None]
+
+        out = run_spmd(body, (x,), (P(AXIS),), P(AXIS))
+        full = np.asarray(x)[..., 0]
+        got = np.asarray(out)[..., 0]
+        np.testing.assert_array_equal(got, full.T)
+
+    def test_indivisible_raises(self):
+        x = jnp.ones((8, 7))
+        with pytest.raises(ValueError):
+            def body(s):
+                return spmd.alltoall(s[0], axis_name=AXIS)[None]
+            run_spmd(body, (x,), (P(AXIS),), P(AXIS))
+
+
+class TestReducescatter:
+    def test_sum(self):
+        x = jnp.ones((8, 2))
+
+        def body(s):
+            # replicated input: every participant holds the full (8,2)
+            return spmd.reducescatter(s, axis_name=AXIS, op=ReduceOp.SUM)
+
+        out = run_spmd(body, (x,), (P(None),), P(AXIS))
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 2), 8.0))
+
+    def test_average(self):
+        x = jnp.full((8, 2), 4.0)
+
+        def body(s):
+            return spmd.reducescatter(s, axis_name=AXIS, op=ReduceOp.AVERAGE)
+
+        out = run_spmd(body, (x,), (P(None),), P(AXIS))
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 2), 4.0))
+
+
+class TestAdasum:
+    def test_matches_reference_recursion(self):
+        rng = np.random.RandomState(7)
+        vecs = rng.randn(8, 16).astype(np.float32)
+
+        def body(s):
+            return spmd.allreduce(s[0], axis_name=AXIS, op=ReduceOp.ADASUM)[None]
+
+        out = run_spmd(body, (jnp.asarray(vecs),), (P(AXIS),), P(AXIS))
+        ref = adasum_reduce_reference([vecs[i] for i in range(8)])
+        np.testing.assert_allclose(np.asarray(out[0]), ref, rtol=1e-3, atol=1e-4)
+
+    def test_orthogonal_sums(self):
+        # Adasum of orthogonal gradients reduces to their sum.
+        vecs = np.zeros((8, 8), np.float32)
+        for i in range(8):
+            vecs[i, i] = 2.0
+
+        def body(s):
+            return spmd.allreduce(s[0], axis_name=AXIS, op=ReduceOp.ADASUM)[None]
+
+        out = run_spmd(body, (jnp.asarray(vecs),), (P(AXIS),), P(AXIS))
+        np.testing.assert_allclose(
+            np.asarray(out[0]), np.full((8,), 2.0), rtol=1e-5
+        )
+
+    def test_identical_inputs_stay_put(self):
+        # Adasum of n identical gradients returns that gradient
+        # (scale-invariance: parallel components are averaged).
+        vecs = np.tile(np.arange(1, 5, dtype=np.float32), (8, 1))
+
+        def body(s):
+            return spmd.allreduce(s[0], axis_name=AXIS, op=ReduceOp.ADASUM)[None]
+
+        out = run_spmd(body, (jnp.asarray(vecs),), (P(AXIS),), P(AXIS))
+        np.testing.assert_allclose(
+            np.asarray(out[0]), vecs[0], rtol=1e-4
+        )
+
+
+class TestRankSize:
+    def test_axis_introspection(self):
+        def body(x):
+            r = spmd.rank(AXIS)
+            n = spmd.axis_size(AXIS)
+            return (x[0] * 0 + r)[None], (x[0] * 0 + n)[None]
+
+        x = jnp.zeros((8, 1), jnp.int32)
+        ranks, sizes = run_spmd(body, (x,), (P(AXIS),), (P(AXIS), P(AXIS)))
+        assert np.asarray(ranks).ravel().tolist() == list(range(8))
+        assert np.asarray(sizes).ravel().tolist() == [8] * 8
