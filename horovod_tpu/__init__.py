@@ -196,6 +196,7 @@ def allreduce(
         postscale_factor=postscale_factor,
         compression=compression,
         process_set=process_set,
+        name=name,
     )
 
 
@@ -210,22 +211,11 @@ def grouped_allreduce(tensors, *, op=None, average=None,
     keep per-tensor semantics (matching spmd.grouped_allreduce).
     """
     _state.require_init("grouped_allreduce")
-    from .comm.packing import pack_flat, unpack_flat
-    from .comm.reduce_ops import ReduceOp, normalize_op
-
-    rop = normalize_op(op, average)
-    tensors = list(tensors)
-    if not tensors:
-        return []
-    kwargs = dict(
+    return _eager.grouped_allreduce(
+        tensors, op=op, average=average,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         compression=compression, process_set=process_set,
     )
-    if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
-        return [_eager.allreduce(t, op=rop, **kwargs) for t in tensors]
-    flat, specs = pack_flat(tensors)
-    red = _eager.allreduce(flat, op=rop, **kwargs)
-    return unpack_flat(red, specs)
 
 
 def allgather(tensor, *, process_set=None, name: Optional[str] = None):

@@ -55,13 +55,18 @@ def allreduce_gradients(
     reference's fused execution order (Controller::FuseResponses).
     """
     rop = normalize_op(op, average)
+    st = core_state.global_state()
     if fusion_threshold_bytes is None:
-        st = core_state.global_state()
-        fusion_threshold_bytes = (
-            st.config.fusion_threshold_bytes
-            if st.initialized and st.config
-            else 64 * 1024 * 1024
-        )
+        if st.initialized and st.autotuner is not None and axis_name is None:
+            # Autotuned threshold (eager path only: the jit path's fusion
+            # is a compile-time constant, so retuning it would recompile
+            # per candidate).  Parity: ParameterManager adjusting
+            # HOROVOD_FUSION_THRESHOLD online.
+            fusion_threshold_bytes = st.autotuner.current[0]
+        elif st.initialized and st.config:
+            fusion_threshold_bytes = st.config.fusion_threshold_bytes
+        else:
+            fusion_threshold_bytes = 64 * 1024 * 1024
 
     if axis_name is not None:
         groups = None
@@ -93,7 +98,8 @@ def allreduce_gradients(
     treedef = jax.tree_util.tree_structure(grads)
     plan = plan_buckets(names, leaves, fusion_threshold_bytes)
     out = [None] * len(leaves)
-    for bucket in plan.buckets:
+    total_bytes = 0
+    for k, bucket in enumerate(plan.buckets):
         flat, _ = pack_flat([leaves[e.index] for e in bucket])
         red = eager_comm.allreduce(
             flat,
@@ -102,10 +108,14 @@ def allreduce_gradients(
             postscale_factor=postscale_factor,
             compression=compression,
             process_set=process_set,
+            name=f"allreduce.bucket_{k}",
         )
+        total_bytes += sum(e.nbytes for e in bucket)
         specs = [(e.shape, e.dtype, e.size) for e in bucket]
         for e, o in zip(bucket, unpack_flat(red, specs)):
             out[e.index] = o
+    if st.initialized and st.autotuner is not None and axis_name is None:
+        st.autotuner.record_step(total_bytes)
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

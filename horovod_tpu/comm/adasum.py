@@ -28,23 +28,39 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _pairwise_adasum(a, b):
+def _pairwise_adasum(a, b, segments=None):
+    """Combine two gradient vectors.
+
+    ``segments`` — list of (offset, size) — computes the dot-product
+    coefficients *per segment*, which is how the reference applies
+    Adasum inside a fused buffer (per-tensor ``tensor_counts`` in
+    adasum.h DispatchFusedAllreduce): each tensor in the bucket gets
+    its own scale correction, so results don't depend on bucketing.
+    """
     af = a.astype(jnp.float32).reshape(-1)
     bf = b.astype(jnp.float32).reshape(-1)
-    ab = jnp.dot(af, bf)
-    aa = jnp.dot(af, af)
-    bb = jnp.dot(bf, bf)
-    ca = jnp.where(aa > 0, ab / (2.0 * aa), 0.0)
-    cb = jnp.where(bb > 0, ab / (2.0 * bb), 0.0)
-    out = (1.0 - ca) * af + (1.0 - cb) * bf
+    if segments is None:
+        segments = [(0, af.shape[0])]
+    out_parts = []
+    for off, size in segments:
+        sa = lax.dynamic_slice(af, (off,), (size,))
+        sb = lax.dynamic_slice(bf, (off,), (size,))
+        ab = jnp.dot(sa, sb)
+        aa = jnp.dot(sa, sa)
+        bb = jnp.dot(sb, sb)
+        ca = jnp.where(aa > 0, ab / (2.0 * aa), 0.0)
+        cb = jnp.where(bb > 0, ab / (2.0 * bb), 0.0)
+        out_parts.append((1.0 - ca) * sa + (1.0 - cb) * sb)
+    out = jnp.concatenate(out_parts) if len(out_parts) > 1 else out_parts[0]
     return out.reshape(a.shape).astype(a.dtype)
 
 
-def adasum_reduce(x, axis_name: str, axis_size: int):
+def adasum_reduce(x, axis_name: str, axis_size: int, segments=None):
     """Adasum-combine ``x`` across ``axis_name`` inside shard_map/jit.
 
-    ``axis_size`` must be a power of two ≥ 1.  Returns the combined
-    tensor, identical on every participant.
+    ``axis_size`` must be a power of two ≥ 1.  ``segments`` (offset,
+    size) pairs apply the combine per-tensor within a fused flat buffer.
+    Returns the combined tensor, identical on every participant.
     """
     if axis_size & (axis_size - 1):
         raise ValueError(
@@ -56,7 +72,7 @@ def adasum_reduce(x, axis_name: str, axis_size: int):
         # Pairwise exchange with the partner at XOR distance `dist`.
         perm = [(j, j ^ dist) for j in range(axis_size)]
         other = lax.ppermute(v, axis_name, perm)
-        v = _pairwise_adasum(v, other)
+        v = _pairwise_adasum(v, other, segments)
         dist *= 2
     return v
 

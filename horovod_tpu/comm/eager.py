@@ -201,24 +201,41 @@ def allreduce(
     postscale_factor: float = 1.0,
     compression=NoneCompressor,
     process_set=None,
+    name: Optional[str] = None,
 ):
     rop = normalize_op(op, average)
     st, ps = _resolve_process_set(process_set)
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
     p = mesh.devices.size
-    if p == 1:
-        out = x * jnp.asarray(prescale_factor, x.dtype)
-        # averaging / sum over one participant is identity
-        return out * jnp.asarray(postscale_factor, out.dtype)
-    stacked = _stack_global(x, mesh)
-    fn = _jitted("allreduce", mesh, (rop, compression))
-    out = fn(
-        stacked,
-        jnp.asarray(prescale_factor, jnp.float32),
-        jnp.asarray(postscale_factor, jnp.float32),
-    )
-    return _fetch(out)
+
+    timeline = st.timeline
+    tname = name or f"allreduce.{x.shape}.{x.dtype}"
+    if timeline is not None:
+        timeline.begin(tname, "ICI_ALLREDUCE")
+    try:
+        if p == 1:
+            out = x * jnp.asarray(prescale_factor, x.dtype)
+            # averaging / sum over one participant is identity
+            out = out * jnp.asarray(postscale_factor, out.dtype)
+        else:
+            stacked = _stack_global(x, mesh)
+            fn = _jitted("allreduce", mesh, (rop, compression))
+            out = _fetch(
+                fn(
+                    stacked,
+                    jnp.asarray(prescale_factor, jnp.float32),
+                    jnp.asarray(postscale_factor, jnp.float32),
+                )
+            )
+        if timeline is not None:
+            # Timeline mode trades async dispatch for accurate spans
+            # (the reference's timeline also serializes op completion).
+            jax.block_until_ready(out)
+        return out
+    finally:
+        if timeline is not None:
+            timeline.end(tname)
 
 
 def _exchange_dim0_sizes(dim0: int, mesh: Mesh) -> np.ndarray:
@@ -227,6 +244,42 @@ def _exchange_dim0_sizes(dim0: int, mesh: Mesh) -> np.ndarray:
     stacked = _stack_global(jnp.asarray(dim0, jnp.int32), mesh)
     fn = _jitted("allgather", mesh, ())
     return np.asarray(_fetch(fn(stacked)))
+
+
+def grouped_allreduce(
+    tensors,
+    *,
+    op=None,
+    average=None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    compression=NoneCompressor,
+    process_set=None,
+):
+    """Reduce a list of tensors as one fused unit (parity:
+    hvd.grouped_allreduce / group_table.cc).
+
+    Single source of the fuse policy shared with spmd.grouped_allreduce:
+    Sum/Average pack into one flat wire buffer; Min/Max/Product/Adasum
+    keep per-tensor semantics.
+    """
+    from .packing import pack_flat, unpack_flat
+
+    rop = normalize_op(op, average)
+    tensors = list(tensors)
+    if not tensors:
+        return []
+    kwargs = dict(
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+        compression=compression,
+        process_set=process_set,
+    )
+    if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        return [allreduce(t, op=rop, **kwargs) for t in tensors]
+    flat, specs = pack_flat(tensors)
+    red = allreduce(flat, op=rop, **kwargs)
+    return unpack_flat(red, specs)
 
 
 def allgather(tensor, *, process_set=None):

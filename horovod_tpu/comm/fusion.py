@@ -134,6 +134,15 @@ def fused_tree_allreduce(
     out_leaves: List[Any] = [None] * len(leaves)
     for bucket in plan.buckets:
         flat, _ = pack_flat([leaves[e.index] for e in bucket])
+        # Per-tensor segment boundaries keep Adasum's dot products
+        # per-tensor inside the fused buffer (reference: tensor_counts
+        # in adasum.h DispatchFusedAllreduce) — results must not depend
+        # on the fusion threshold.
+        segments = []
+        off = 0
+        for e in bucket:
+            segments.append((off, e.size))
+            off += e.size
         # spmd.allreduce handles op routing (incl. the Adasum+groups and
         # int8 rejection paths) so fused and unfused semantics agree.
         red = spmd.allreduce(
@@ -144,6 +153,7 @@ def fused_tree_allreduce(
             postscale_factor=postscale_factor,
             compression=compression,
             groups=groups,
+            adasum_segments=segments if rop == ReduceOp.ADASUM else None,
         )
         specs = [(e.shape, e.dtype, e.size) for e in bucket]
         for e, out in zip(bucket, unpack_flat(red, specs)):

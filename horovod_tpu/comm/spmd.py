@@ -78,11 +78,14 @@ def allreduce(
     postscale_factor: float = 1.0,
     compression=NoneCompressor,
     groups: Optional[List[List[int]]] = None,
+    adasum_segments=None,
 ):
     """Allreduce inside jit. Parity: EnqueueTensorAllreduce + NCCLAllreduce.
 
     ``groups`` is an ``axis_index_groups`` partition (from
     ``ProcessSet.device_groups()``) scoping the reduction.
+    ``adasum_segments`` — (offset, size) pairs — applies Adasum's dot
+    products per-tensor within a fused flat buffer.
     """
     rop = normalize_op(op, average)
     n = _group_size(axis_name, groups)
@@ -96,8 +99,13 @@ def allreduce(
                 "Adasum over process-set groups is not supported in-jit; "
                 "use the global set"
             )
+        if _is_int8(compression):
+            raise ValueError(
+                "int8 compression cannot ride Adasum (per-rank scales "
+                "would corrupt the dot products); use fp16/bf16/none"
+            )
         wire, ctx = compression.compress(tensor)
-        out = adasum_reduce(wire, axis_name, n)
+        out = adasum_reduce(wire, axis_name, n, adasum_segments)
         out = compression.decompress(out, ctx)
     elif rop in (ReduceOp.SUM, ReduceOp.AVERAGE):
         if _is_int8(compression) and jnp.issubdtype(
