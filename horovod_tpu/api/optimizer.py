@@ -56,8 +56,15 @@ def allreduce_gradients(
     """
     rop = normalize_op(op, average)
     st = core_state.global_state()
+    # The tuner only participates when it actually chose the threshold —
+    # an explicit fusion_threshold_bytes must neither be overridden nor
+    # feed scores for candidates that were never in effect.
+    use_autotune = (
+        fusion_threshold_bytes is None
+        and st.initialized and st.autotuner is not None and axis_name is None
+    )
     if fusion_threshold_bytes is None:
-        if st.initialized and st.autotuner is not None and axis_name is None:
+        if use_autotune:
             # Autotuned threshold (eager path only: the jit path's fusion
             # is a compile-time constant, so retuning it would recompile
             # per candidate).  Parity: ParameterManager adjusting
@@ -100,6 +107,24 @@ def allreduce_gradients(
     out = [None] * len(leaves)
     total_bytes = 0
     for k, bucket in enumerate(plan.buckets):
+        if rop == ReduceOp.ADASUM:
+            # Adasum's dot-product correction is per-tensor (reference:
+            # tensor_counts in adasum.h DispatchFusedAllreduce keeps
+            # segment boundaries inside the fused buffer); the eager
+            # data plane has no segment support, so execute unfused —
+            # results must not depend on the fusion threshold.
+            for e in bucket:
+                out[e.index] = eager_comm.allreduce(
+                    leaves[e.index],
+                    op=rop,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    compression=compression,
+                    process_set=process_set,
+                    name=f"adasum.{e.name}",
+                )
+                total_bytes += e.nbytes
+            continue
         flat, _ = pack_flat([leaves[e.index] for e in bucket])
         red = eager_comm.allreduce(
             flat,
@@ -114,7 +139,7 @@ def allreduce_gradients(
         specs = [(e.shape, e.dtype, e.size) for e in bucket]
         for e, o in zip(bucket, unpack_flat(red, specs)):
             out[e.index] = o
-    if st.initialized and st.autotuner is not None and axis_name is None:
+    if use_autotune:
         st.autotuner.record_step(total_bytes)
     return jax.tree_util.tree_unflatten(treedef, out)
 

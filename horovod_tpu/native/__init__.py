@@ -1,0 +1,47 @@
+"""Native (C++) control-plane core + pure-Python twin.
+
+Layout (parity: the reference's C++ core horovod/common/* built by
+CMake into the framework .so; see SURVEY.md §2.1):
+
+- ``src/``        C++17 sources → ``libhvt_core.so`` (built on demand)
+- ``core.py``     ctypes bindings (parity: basics.py ctypes loading)
+- ``wire.py``     Python mirror of the coordination wire format
+- ``fallback.py`` pure-Python controller with identical bytes/semantics
+
+``make_controller`` picks the native implementation when a toolchain is
+available, else the fallback — both speak the same wire format, so
+mixed fleets coordinate fine.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import core, fallback, wire
+
+
+def native_available() -> bool:
+    return core.available()
+
+
+def make_controller(rank: int, size: int, fusion_threshold: int,
+                    cache_capacity: int = 1024, stall_warn_s: float = 60.0,
+                    stall_abort_s: float = 0.0):
+    """Controller factory: native if buildable, else Python fallback.
+    ``HVTPU_FORCE_PY_CONTROLLER=1`` forces the fallback (tests use this
+    to cross-check both)."""
+    if (not os.environ.get("HVTPU_FORCE_PY_CONTROLLER")
+            and core.available()):
+        return core.NativeController(
+            rank, size, fusion_threshold, cache_capacity,
+            stall_warn_s, stall_abort_s,
+        )
+    return fallback.PyController(
+        rank, size, fusion_threshold, cache_capacity,
+        stall_warn_s, stall_abort_s,
+    )
+
+
+__all__ = [
+    "core", "fallback", "wire", "native_available", "make_controller",
+]

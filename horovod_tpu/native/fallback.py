@@ -1,0 +1,287 @@
+"""Pure-Python controller — the executable spec of the native core.
+
+Implements exactly the protocol of native/src/controller.cc (same wire
+bytes via :mod:`horovod_tpu.native.wire`, same ordering, fusion, cache
+and stall semantics) for environments without a C++ toolchain, and as a
+cross-check in tests (test_native.py runs both and asserts byte-level
+agreement).  Parity anchors as in controller.h.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import wire
+
+
+class _ResponseCache:
+    """LRU keyed by signature; mutation only in apply order (see the
+    consistency argument in native/src/controller.h)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._lru: "collections.OrderedDict[str, Tuple[int, wire.Entry]]" = (
+            collections.OrderedDict()
+        )  # sig -> (bit, entry); last = most recent
+        self._by_bit: Dict[int, str] = {}
+        self._free_bits: List[int] = []
+        self._next_bit = 0
+
+    def lookup(self, sig: str) -> int:
+        item = self._lru.get(sig)
+        return -1 if item is None else item[0]
+
+    def put(self, sig: str, entry: wire.Entry) -> int:
+        if sig in self._lru:
+            bit = self._lru[sig][0]
+            self._lru.move_to_end(sig)
+            return bit
+        if len(self._lru) >= self.capacity and self._lru:
+            victim_sig, (victim_bit, _) = next(iter(self._lru.items()))
+            del self._lru[victim_sig]
+            del self._by_bit[victim_bit]
+            # Match C++: freed bits are reused smallest-first.
+            self._free_bits.append(victim_bit)
+            self._free_bits.sort()
+        if self._free_bits:
+            bit = self._free_bits.pop(0)
+        else:
+            bit = self._next_bit
+            self._next_bit += 1
+        self._lru[sig] = (bit, entry)
+        self._by_bit[bit] = sig
+        return bit
+
+    def entry_for_bit(self, bit: int) -> Optional[wire.Entry]:
+        sig = self._by_bit.get(bit)
+        return None if sig is None else self._lru[sig][1]
+
+    def __len__(self):
+        return len(self._lru)
+
+
+class PyController:
+    """Python twin of native Controller (controller.cc)."""
+
+    def __init__(self, rank: int, size: int, fusion_threshold: int,
+                 cache_capacity: int = 1024, stall_warn_s: float = 60.0,
+                 stall_abort_s: float = 0.0):
+        self.rank = rank
+        self.size = size
+        self.fusion_threshold = fusion_threshold
+        self.stall_warn_s = stall_warn_s
+        self.stall_abort_s = stall_abort_s
+        self._lock = threading.Lock()
+        self._pending: List[wire.Entry] = []
+        self._pending_names: Set[str] = set()
+        self._in_flight: Dict[str, wire.Entry] = {}
+        self._cache = _ResponseCache(cache_capacity)
+        self._groups: Dict[int, int] = {}
+        self._joined = False
+        # coordinator state
+        self._message_table: Dict[str, dict] = {}
+        self._joined_ranks: Set[int] = set()
+        self._shutdown_ranks: Set[int] = set()
+        self._process_sets: Dict[int, List[int]] = {0: list(range(size))}
+
+    # ---- rank-local side ----
+    def enqueue(self, seq: int, name: str, op_type: int, red_op: int,
+                dtype: int, shape: Sequence[int], process_set_id: int = 0,
+                group_id: int = -1, root_rank: int = -1) -> bool:
+        with self._lock:
+            if name in self._pending_names or name in self._in_flight:
+                return False
+            e = wire.Entry(
+                seq=seq, name=name, type=op_type, red_op=red_op,
+                dtype=dtype, shape=tuple(shape),
+                process_set_id=process_set_id, group_id=group_id,
+                root_rank=root_rank,
+            )
+            e._enqueue_time = time.monotonic()  # type: ignore[attr-defined]
+            self._pending.append(e)
+            self._pending_names.add(name)
+            return True
+
+    def declare_group(self, group_id: int, size: int):
+        self._groups[group_id] = size
+
+    def register_process_set(self, psid: int, ranks: Sequence[int]):
+        with self._lock:
+            self._process_sets[psid] = sorted(ranks)
+
+    def set_joined(self):
+        self._joined = True
+
+    def drain_requests(self) -> bytes:
+        with self._lock:
+            rl = wire.RequestList(rank=self.rank, joined=self._joined)
+            for e in self._pending:
+                self._in_flight[e.name] = e
+                self._pending_names.discard(e.name)
+                bit = self._cache.lookup(e.signature())
+                rq = wire.Request(rank=self.rank)
+                if bit >= 0:
+                    rq.cached = True
+                    rq.cache_bit = bit
+                    rq.entry = wire.Entry(seq=e.seq, name=e.name)
+                    rl.cache_hits.append(bit)
+                else:
+                    rq.entry = e
+                rl.requests.append(rq)
+            self._pending.clear()
+            return wire.serialize_request_list(rl)
+
+    def apply_responses(self, blob: bytes) -> List[int]:
+        rl = wire.parse_response_list(blob)
+        finished: List[int] = []
+        with self._lock:
+            for rs in rl.responses:
+                if rs.type not in (wire.BARRIER, wire.JOIN):
+                    for i, name in enumerate(rs.tensor_names):
+                        shape = (rs.tensor_shapes[i]
+                                 if i < len(rs.tensor_shapes) else ())
+                        e = wire.Entry(
+                            name=name, type=rs.type, red_op=rs.red_op,
+                            dtype=rs.dtype, shape=tuple(shape),
+                            process_set_id=rs.process_set_id,
+                            root_rank=rs.root_rank,
+                        )
+                        self._cache.put(e.signature(), e)
+                for name in rs.tensor_names:
+                    e = self._in_flight.pop(name, None)
+                    if e is not None:
+                        finished.append(e.seq)
+            if rl.join_last_rank >= 0:
+                self._joined = False
+        return finished
+
+    # ---- coordinator side ----
+    def ingest(self, blob: bytes):
+        rl = wire.parse_request_list(blob)
+        now = time.monotonic()
+        with self._lock:
+            if rl.joined:
+                self._joined_ranks.add(rl.rank)
+            if rl.shutdown:
+                self._shutdown_ranks.add(rl.rank)
+            for rq in rl.requests:
+                e = rq.entry
+                if rq.cached:
+                    cached = self._cache.entry_for_bit(rq.cache_bit)
+                    if cached is not None:
+                        e = wire.Entry(**{**cached.__dict__, "seq": rq.entry.seq})
+                pc = self._message_table.get(e.name)
+                if pc is None:
+                    self._message_table[e.name] = {
+                        "entry": e, "ranks": {rl.rank}, "first_seen": now,
+                    }
+                else:
+                    pc["ranks"].add(rl.rank)
+
+    def _required_ranks(self, psid: int) -> int:
+        ranks = self._process_sets.get(psid)
+        return self.size if ranks is None else len(ranks)
+
+    def compute_responses(self) -> bytes:
+        with self._lock:
+            out = wire.ResponseList()
+            # deterministic name order == std::map iteration in C++
+            ready = [
+                name for name in sorted(self._message_table)
+                if len(self._message_table[name]["ranks"])
+                >= self._required_ranks(
+                    self._message_table[name]["entry"].process_set_id)
+            ]
+            group_counts: Dict[int, int] = collections.Counter(
+                self._message_table[n]["entry"].group_id
+                for n in ready
+                if self._message_table[n]["entry"].group_id >= 0
+            )
+            responses: List[wire.Response] = []
+            for name in ready:
+                e = self._message_table[name]["entry"]
+                if e.group_id >= 0:
+                    want = self._groups.get(e.group_id, -1)
+                    if want > 0 and group_counts[e.group_id] < want:
+                        continue
+                rs = wire.Response(
+                    type=e.type, red_op=e.red_op, dtype=e.dtype,
+                    process_set_id=e.process_set_id, root_rank=e.root_rank,
+                    tensor_names=[name], tensor_shapes=[tuple(e.shape)],
+                    total_bytes=e.nbytes,
+                )
+                responses.append(rs)
+                del self._message_table[name]
+            out.responses = self._fuse(responses)
+            if len(self._joined_ranks) >= self.size and self.size > 0:
+                out.join_last_rank = max(self._joined_ranks)
+                self._joined_ranks.clear()
+            if self._shutdown_ranks:
+                out.shutdown = True
+            return wire.serialize_response_list(out)
+
+    def _fuse(self, responses: List[wire.Response]) -> List[wire.Response]:
+        fused: List[wire.Response] = []
+        for r in responses:
+            can_fuse = r.type in (wire.ALLREDUCE, wire.ADASUM) and not r.error
+            if fused and can_fuse:
+                prev = fused[-1]
+                compatible = (
+                    prev.type == r.type and prev.red_op == r.red_op
+                    and prev.dtype == r.dtype
+                    and prev.process_set_id == r.process_set_id
+                    and not prev.error
+                )
+                if (compatible and
+                        prev.total_bytes + r.total_bytes
+                        <= self.fusion_threshold):
+                    prev.tensor_names.extend(r.tensor_names)
+                    prev.tensor_shapes.extend(r.tensor_shapes)
+                    prev.total_bytes += r.total_bytes
+                    continue
+            fused.append(r)
+        return fused
+
+    # ---- introspection ----
+    @property
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    @property
+    def pending_bytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._pending)
+
+    @property
+    def cache_size(self) -> int:
+        return len(self._cache)
+
+    def set_fusion_threshold(self, nbytes: int):
+        self.fusion_threshold = nbytes
+
+    def check_stalls(self) -> List[dict]:
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for name in sorted(self._message_table):
+                pc = self._message_table[name]
+                waited = now - pc["first_seen"]
+                if waited < self.stall_warn_s:
+                    continue
+                members = self._process_sets.get(
+                    pc["entry"].process_set_id, list(range(self.size))
+                )
+                out.append({
+                    "name": name,
+                    "waiting_s": waited,
+                    "present": [r for r in members if r in pc["ranks"]],
+                    "missing": [r for r in members if r not in pc["ranks"]],
+                })
+        return out
+
+    def close(self):
+        pass

@@ -1,0 +1,377 @@
+#include "controller.h"
+
+#include <algorithm>
+#include <chrono>
+#include <sstream>
+
+namespace hvt {
+
+double NowSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// --------------------------------------------------------------------------
+// TensorQueue
+// --------------------------------------------------------------------------
+
+bool TensorQueue::Add(Entry e) {
+  std::lock_guard<std::mutex> g(mu_);
+  // Parity: tensor_queue.cc AddToTensorQueue rejects duplicate names —
+  // the same tensor cannot be pending twice.
+  if (pending_names_.count(e.name) || in_flight_.count(e.name)) return false;
+  pending_names_.insert(e.name);
+  pending_.push_back(std::move(e));
+  return true;
+}
+
+std::vector<Entry> TensorQueue::Drain() {
+  std::lock_guard<std::mutex> g(mu_);
+  std::vector<Entry> out(pending_.begin(), pending_.end());
+  for (const Entry& e : out) {
+    in_flight_.emplace(e.name, e);
+    pending_names_.erase(e.name);
+  }
+  pending_.clear();
+  return out;
+}
+
+std::vector<uint64_t> TensorQueue::Finish(
+    const std::vector<std::string>& names) {
+  std::lock_guard<std::mutex> g(mu_);
+  std::vector<uint64_t> seqs;
+  for (const std::string& n : names) {
+    auto it = in_flight_.find(n);
+    if (it != in_flight_.end()) {
+      seqs.push_back(it->second.seq);
+      in_flight_.erase(it);
+    }
+  }
+  return seqs;
+}
+
+int64_t TensorQueue::pending_count() const {
+  std::lock_guard<std::mutex> g(mu_);
+  return static_cast<int64_t>(pending_.size());
+}
+
+int64_t TensorQueue::pending_bytes() const {
+  std::lock_guard<std::mutex> g(mu_);
+  int64_t b = 0;
+  for (const Entry& e : pending_) b += e.nbytes();
+  return b;
+}
+
+// --------------------------------------------------------------------------
+// ResponseCache
+// --------------------------------------------------------------------------
+
+std::string ResponseCache::Signature(const Entry& e) {
+  // Parity: response_cache.cc keys on (name, op params, dtype, shape,
+  // device); device is implicit here (one logical device per rank).
+  std::ostringstream ss;
+  ss << e.name << '|' << int(e.type) << '|' << int(e.red_op) << '|'
+     << int(e.dtype) << '|' << e.process_set_id << '|' << e.root_rank << '|';
+  for (int64_t d : e.shape) ss << d << ',';
+  return ss.str();
+}
+
+int64_t ResponseCache::Lookup(const std::string& signature) const {
+  auto it = by_sig_.find(signature);
+  if (it == by_sig_.end()) return -1;
+  return it->second->bit;
+}
+
+uint32_t ResponseCache::Put(const std::string& signature, const Entry& e) {
+  auto it = by_sig_.find(signature);
+  if (it != by_sig_.end()) {
+    // Touch: move to front (most recently used).
+    lru_.splice(lru_.begin(), lru_, it->second);
+    return it->second->bit;
+  }
+  // Evict if at capacity (parity: response_cache.cc capacity_,
+  // HOROVOD_CACHE_CAPACITY).
+  if (lru_.size() >= capacity_ && !lru_.empty()) {
+    const CacheItem& victim = lru_.back();
+    free_bits_.insert(victim.bit);
+    by_sig_.erase(victim.signature);
+    by_bit_.erase(victim.bit);
+    lru_.pop_back();
+  }
+  uint32_t bit;
+  if (!free_bits_.empty()) {
+    bit = *free_bits_.begin();
+    free_bits_.erase(free_bits_.begin());
+  } else {
+    bit = next_bit_++;
+  }
+  lru_.push_front(CacheItem{signature, e, bit});
+  by_sig_[signature] = lru_.begin();
+  by_bit_[bit] = lru_.begin();
+  return bit;
+}
+
+bool ResponseCache::GetEntryForBit(uint32_t bit, Entry* out) const {
+  auto it = by_bit_.find(bit);
+  if (it == by_bit_.end()) return false;
+  *out = it->second->entry;
+  return true;
+}
+
+// --------------------------------------------------------------------------
+// Controller
+// --------------------------------------------------------------------------
+
+Controller::Controller(int32_t rank, int32_t size,
+                       int64_t fusion_threshold_bytes, size_t cache_capacity,
+                       double stall_warn_s, double stall_abort_s)
+    : rank_(rank),
+      size_(size),
+      fusion_threshold_(fusion_threshold_bytes),
+      stall_warn_s_(stall_warn_s),
+      stall_abort_s_(stall_abort_s),
+      cache_(cache_capacity) {
+  // Global process set 0 = all ranks (parity: process_set.cc id 0).
+  std::vector<int32_t> all(size);
+  for (int32_t i = 0; i < size; ++i) all[i] = i;
+  process_sets_[0] = std::move(all);
+}
+
+void Controller::RegisterProcessSet(int32_t psid, std::vector<int32_t> ranks) {
+  std::lock_guard<std::mutex> g(mu_);
+  std::sort(ranks.begin(), ranks.end());
+  process_sets_[psid] = std::move(ranks);
+}
+
+int32_t Controller::RequiredRanks(int32_t psid) const {
+  auto it = process_sets_.find(psid);
+  return it == process_sets_.end() ? size_
+                                   : static_cast<int32_t>(it->second.size());
+}
+
+std::vector<int32_t> Controller::ProcessSetRanks(int32_t psid) const {
+  auto it = process_sets_.find(psid);
+  if (it != process_sets_.end()) return it->second;
+  std::vector<int32_t> all(size_);
+  for (int32_t i = 0; i < size_; ++i) all[i] = i;
+  return all;
+}
+
+uint64_t Controller::Enqueue(Entry e, Status* status) {
+  static_cast<void>(rank_);
+  e.enqueue_time_s = NowSeconds();
+  uint64_t seq = e.seq;
+  if (!queue_.Add(std::move(e))) {
+    *status = Status::Error("duplicate tensor name in queue");
+    return 0;
+  }
+  *status = Status::OK();
+  return seq;
+}
+
+std::vector<uint8_t> Controller::DrainRequests() {
+  RequestList rl;
+  rl.rank = rank_;
+  rl.joined = joined_;
+  for (Entry& e : queue_.Drain()) {
+    std::string sig = ResponseCache::Signature(e);
+    int64_t bit = cache_.Lookup(sig);
+    Request rq;
+    rq.rank = rank_;
+    if (bit >= 0) {
+      // Steady state: transmit the bit id + seq only; the coordinator
+      // expands the bit via its own (identical) cache (parity: the
+      // cache bit-vector exchange in Controller::ComputeResponseList).
+      rq.cached = true;
+      rq.cache_bit = static_cast<uint32_t>(bit);
+      rq.entry.seq = e.seq;
+      rq.entry.name = e.name;  // kept for local Finish() + debuggability
+      rl.cache_hits.push_back(rq.cache_bit);
+    } else {
+      rq.entry = std::move(e);
+    }
+    rl.requests.push_back(std::move(rq));
+  }
+  return SerializeRequestList(rl);
+}
+
+void Controller::Ingest(const uint8_t* data, size_t len) {
+  RequestList rl = ParseRequestList(data, len);
+  std::lock_guard<std::mutex> g(mu_);
+  double now = NowSeconds();
+  if (rl.joined) joined_ranks_.insert(rl.rank);
+  if (rl.shutdown) shutdown_ranks_.insert(rl.rank);
+  for (const Request& rq : rl.requests) {
+    Entry e = rq.entry;
+    if (rq.cached) {
+      // Expand the bit back into the full entry via the coordinator's
+      // own (identical) cache.
+      Entry cached;
+      if (cache_.GetEntryForBit(rq.cache_bit, &cached)) {
+        cached.seq = e.seq;
+        e = cached;
+      }
+    }
+    auto it = message_table_.find(e.name);
+    if (it == message_table_.end()) {
+      // Parity: MessageTable insertion on first Request for a name.
+      PendingCoordination pc;
+      pc.entry = e;
+      pc.first_seen_s = now;
+      pc.ranks.insert(rl.rank);
+      message_table_.emplace(e.name, std::move(pc));
+    } else {
+      it->second.ranks.insert(rl.rank);
+    }
+  }
+}
+
+ResponseList Controller::BuildResponseList() {
+  // Caller holds mu_.
+  ResponseList out;
+
+  // 1. collect globally-ready names (every member rank reported).
+  //    message_table_ is a std::map → deterministic name order, the
+  //    analog of FuseResponses' stable response ordering.
+  std::vector<std::string> ready;
+  for (auto& kv : message_table_) {
+    const PendingCoordination& pc = kv.second;
+    if (static_cast<int32_t>(pc.ranks.size()) >=
+        RequiredRanks(pc.entry.process_set_id)) {
+      ready.push_back(kv.first);
+    }
+  }
+
+  // 2. group gating (parity: group_table.cc — a grouped tensor only
+  //    executes when the whole group is ready).
+  std::unordered_map<int64_t, int32_t> group_ready_counts;
+  for (const std::string& n : ready) {
+    const Entry& e = message_table_[n].entry;
+    if (e.group_id >= 0) group_ready_counts[e.group_id]++;
+  }
+  std::vector<std::string> admitted;
+  for (const std::string& n : ready) {
+    const Entry& e = message_table_[n].entry;
+    if (e.group_id >= 0) {
+      int32_t want = group_table_.GroupSize(e.group_id);
+      if (want > 0 && group_ready_counts[e.group_id] < want) continue;
+    }
+    admitted.push_back(n);
+  }
+
+  // 3. one Response per tensor, then fuse.
+  for (const std::string& n : admitted) {
+    const Entry& e = message_table_[n].entry;
+    Response rs;
+    rs.type = e.type;
+    rs.red_op = e.red_op;
+    rs.dtype = e.dtype;
+    rs.process_set_id = e.process_set_id;
+    rs.root_rank = e.root_rank;
+    rs.tensor_names.push_back(n);
+    rs.tensor_shapes.push_back(e.shape);
+    rs.total_bytes = e.nbytes();
+    out.responses.push_back(std::move(rs));
+    message_table_.erase(n);
+  }
+  FuseResponses(&out.responses);
+
+  // 4. join: once every rank joined, emit the last joiner (parity:
+  //    operations.cc join handling returns the last joined rank).
+  if (static_cast<int32_t>(joined_ranks_.size()) >= size_ && size_ > 0) {
+    out.join_last_rank = *joined_ranks_.rbegin();
+    joined_ranks_.clear();
+  }
+  if (!shutdown_ranks_.empty()) out.shutdown = true;
+  return out;
+}
+
+void Controller::FuseResponses(std::vector<Response>* responses) const {
+  // Parity: Controller::FuseResponses — adjacent compatible allreduce
+  // responses merge while under the fusion threshold.  Compatibility:
+  // same op type, reduction, dtype, process set; allreduce/adasum only
+  // (allgather fusion needs size tables; single responses there).
+  std::vector<Response> fused;
+  for (Response& r : *responses) {
+    bool can_fuse =
+        (r.type == OpType::kAllreduce || r.type == OpType::kAdasum) &&
+        r.error.empty();
+    if (!fused.empty() && can_fuse) {
+      Response& prev = fused.back();
+      bool compatible = prev.type == r.type && prev.red_op == r.red_op &&
+                        prev.dtype == r.dtype &&
+                        prev.process_set_id == r.process_set_id &&
+                        prev.error.empty();
+      if (compatible &&
+          prev.total_bytes + r.total_bytes <= fusion_threshold_) {
+        prev.tensor_names.insert(prev.tensor_names.end(),
+                                 r.tensor_names.begin(),
+                                 r.tensor_names.end());
+        prev.tensor_shapes.insert(prev.tensor_shapes.end(),
+                                  r.tensor_shapes.begin(),
+                                  r.tensor_shapes.end());
+        prev.total_bytes += r.total_bytes;
+        continue;
+      }
+    }
+    fused.push_back(std::move(r));
+  }
+  *responses = std::move(fused);
+}
+
+std::vector<uint8_t> Controller::ComputeResponses() {
+  std::lock_guard<std::mutex> g(mu_);
+  return SerializeResponseList(BuildResponseList());
+}
+
+ResponseList Controller::ApplyResponses(const uint8_t* data, size_t len,
+                                        std::vector<uint64_t>* out_finished) {
+  ResponseList rl = ParseResponseList(data, len);
+  for (const Response& rs : rl.responses) {
+    // Cache insertion in response order — identical on every rank, so
+    // bit ids stay globally consistent (see header comment).  The entry
+    // is rebuilt entirely from the response (incl. echoed shapes), so
+    // the signature matches what Enqueue computes next cycle.
+    for (size_t i = 0; i < rs.tensor_names.size(); ++i) {
+      if (rs.type == OpType::kBarrier || rs.type == OpType::kJoin) continue;
+      Entry e;
+      e.name = rs.tensor_names[i];
+      e.type = rs.type;
+      e.red_op = rs.red_op;
+      e.dtype = rs.dtype;
+      if (i < rs.tensor_shapes.size()) e.shape = rs.tensor_shapes[i];
+      e.process_set_id = rs.process_set_id;
+      e.root_rank = rs.root_rank;
+      cache_.Put(ResponseCache::Signature(e), e);
+    }
+    std::vector<uint64_t> seqs = queue_.Finish(rs.tensor_names);
+    out_finished->insert(out_finished->end(), seqs.begin(), seqs.end());
+  }
+  if (rl.join_last_rank >= 0) joined_ = false;
+  return rl;
+}
+
+std::vector<StallEntry> Controller::CheckStalls() const {
+  std::lock_guard<std::mutex> g(mu_);
+  std::vector<StallEntry> out;
+  double now = NowSeconds();
+  for (const auto& kv : message_table_) {
+    const PendingCoordination& pc = kv.second;
+    double waited = now - pc.first_seen_s;
+    if (waited < stall_warn_s_) continue;
+    StallEntry se;
+    se.name = kv.first;
+    se.waiting_s = waited;
+    for (int32_t r : ProcessSetRanks(pc.entry.process_set_id)) {
+      if (pc.ranks.count(r))
+        se.present_ranks.push_back(r);
+      else
+        se.missing_ranks.push_back(r);
+    }
+    out.push_back(std::move(se));
+  }
+  return out;
+}
+
+}  // namespace hvt

@@ -1,0 +1,189 @@
+// Eager mini-controller: readiness coordination, response cache, fusion
+// planning, group gating, join, stall inspection.
+//
+// Parity map (reference -> here):
+//   horovod/common/tensor_queue.cc  TensorQueue            -> TensorQueue
+//   horovod/common/controller.cc    Controller::ComputeResponseList,
+//                                   MessageTable            -> Controller
+//   horovod/common/controller.cc    Controller::FuseResponses -> FuseResponses
+//   horovod/common/response_cache.cc ResponseCache          -> ResponseCache
+//   horovod/common/group_table.cc   GroupTable              -> GroupTable
+//   horovod/common/stall_inspector.cc StallInspector        -> StallInspector
+//
+// Design departure (SURVEY.md §7.0): the reference's controller runs on a
+// background thread inside each rank and talks MPI/Gloo.  Here the
+// controller is a passive state machine driven by the Python cycle loop
+// (horovod_tpu/eager/controller.py); the transport between ranks is the
+// JAX coordination-service KV store, and the data plane is XLA
+// collectives.  Everything order-sensitive (cache mutation, fusion
+// order) happens in response-apply order, which is identical on every
+// rank — that is what keeps rank-local state consistent without any
+// extra coordination traffic.
+#pragma once
+
+#include <deque>
+#include <list>
+#include <map>
+#include <mutex>
+#include <set>
+#include <unordered_map>
+
+#include "message.h"
+
+namespace hvt {
+
+double NowSeconds();  // monotonic
+
+// --------------------------------------------------------------------------
+// TensorQueue (parity: tensor_queue.cc)
+// --------------------------------------------------------------------------
+class TensorQueue {
+ public:
+  // Returns false if a pending entry with the same name already exists
+  // (parity: AddToTensorQueue's DUPLICATE_NAME_ERROR).
+  bool Add(Entry e);
+  // Pop up to the full pending list for this cycle (parity:
+  // PopMessagesFromQueue); entries move to in-flight keyed by name.
+  std::vector<Entry> Drain();
+  // Remove finished entries by name; returns their seq ids (parity:
+  // GetTensorEntriesFromResponse + PopMessagesFromQueue bookkeeping).
+  std::vector<uint64_t> Finish(const std::vector<std::string>& names);
+  int64_t pending_count() const;
+  int64_t pending_bytes() const;
+
+ private:
+  mutable std::mutex mu_;
+  std::deque<Entry> pending_;
+  std::unordered_map<std::string, Entry> in_flight_;
+  std::set<std::string> pending_names_;
+};
+
+// --------------------------------------------------------------------------
+// ResponseCache (parity: response_cache.cc)
+// --------------------------------------------------------------------------
+// Caches the full signature of repeated requests so steady-state cycles
+// exchange small bit ids instead of serialized requests.  All mutation
+// happens in response-apply order => identical on all ranks.
+class ResponseCache {
+ public:
+  explicit ResponseCache(size_t capacity) : capacity_(capacity) {}
+
+  static std::string Signature(const Entry& e);
+  // -1 if absent, else bit id. Does NOT touch LRU order (enqueue-side
+  // lookups happen in rank-local order; only Apply-side touches are
+  // replicated).
+  int64_t Lookup(const std::string& signature) const;
+  // Insert-or-touch in apply order; evicts LRU when over capacity.
+  // Returns the bit id.
+  uint32_t Put(const std::string& signature, const Entry& e);
+  bool GetEntryForBit(uint32_t bit, Entry* out) const;
+  size_t size() const { return lru_.size(); }
+  size_t capacity() const { return capacity_; }
+
+ private:
+  struct CacheItem {
+    std::string signature;
+    Entry entry;
+    uint32_t bit;
+  };
+  size_t capacity_;
+  std::list<CacheItem> lru_;  // front = most recent
+  std::unordered_map<std::string, std::list<CacheItem>::iterator> by_sig_;
+  std::unordered_map<uint32_t, std::list<CacheItem>::iterator> by_bit_;
+  std::set<uint32_t> free_bits_;
+  uint32_t next_bit_ = 0;
+};
+
+// --------------------------------------------------------------------------
+// GroupTable (parity: group_table.cc)
+// --------------------------------------------------------------------------
+class GroupTable {
+ public:
+  void DeclareGroup(int64_t group_id, int32_t size) { sizes_[group_id] = size; }
+  int32_t GroupSize(int64_t group_id) const {
+    auto it = sizes_.find(group_id);
+    return it == sizes_.end() ? -1 : it->second;
+  }
+
+ private:
+  std::unordered_map<int64_t, int32_t> sizes_;
+};
+
+// --------------------------------------------------------------------------
+// StallInspector (parity: stall_inspector.cc)
+// --------------------------------------------------------------------------
+struct StallEntry {
+  std::string name;
+  double waiting_s = 0;
+  std::vector<int32_t> present_ranks;
+  std::vector<int32_t> missing_ranks;
+};
+
+// --------------------------------------------------------------------------
+// Controller
+// --------------------------------------------------------------------------
+class Controller {
+ public:
+  Controller(int32_t rank, int32_t size, int64_t fusion_threshold_bytes,
+             size_t cache_capacity, double stall_warn_s, double stall_abort_s);
+
+  // ---- rank-local side ----
+  uint64_t Enqueue(Entry e, Status* status);
+  void DeclareGroup(int64_t group_id, int32_t size) {
+    group_table_.DeclareGroup(group_id, size);
+  }
+  void RegisterProcessSet(int32_t psid, std::vector<int32_t> ranks);
+  void SetJoined() { joined_ = true; }
+  // Serialize this cycle's RequestList (drains the queue into in-flight).
+  std::vector<uint8_t> DrainRequests();
+  // Apply an agreed ResponseList: update cache + queue; out_finished gets
+  // the seq ids completed by this response list, in response order.
+  ResponseList ApplyResponses(const uint8_t* data, size_t len,
+                              std::vector<uint64_t>* out_finished);
+
+  // ---- coordinator side (rank 0; parity: MessageTable at rank 0) ----
+  void Ingest(const uint8_t* data, size_t len);
+  // Decide globally-ready set, fuse, clear consumed coordination state.
+  // (parity: Controller::ComputeResponseList + FuseResponses)
+  std::vector<uint8_t> ComputeResponses();
+
+  std::vector<StallEntry> CheckStalls() const;
+
+  int64_t pending_count() const { return queue_.pending_count(); }
+  int64_t pending_bytes() const { return queue_.pending_bytes(); }
+  size_t cache_size() const { return cache_.size(); }
+  int32_t rank() const { return rank_; }
+  int32_t size() const { return size_; }
+  void set_fusion_threshold(int64_t b) { fusion_threshold_ = b; }
+  int64_t fusion_threshold() const { return fusion_threshold_; }
+
+ private:
+  struct PendingCoordination {
+    Entry entry;                 // from the first rank that reported it
+    std::set<int32_t> ranks;     // ranks that reported ready
+    double first_seen_s = 0;
+  };
+
+  int32_t RequiredRanks(int32_t psid) const;
+  std::vector<int32_t> ProcessSetRanks(int32_t psid) const;
+  ResponseList BuildResponseList();
+  void FuseResponses(std::vector<Response>* responses) const;
+
+  int32_t rank_, size_;
+  int64_t fusion_threshold_;
+  double stall_warn_s_, stall_abort_s_;
+
+  TensorQueue queue_;
+  ResponseCache cache_;
+  GroupTable group_table_;
+  bool joined_ = false;
+
+  // coordinator state
+  std::map<std::string, PendingCoordination> message_table_;  // by name (ordered for determinism)
+  std::set<int32_t> joined_ranks_;
+  std::set<int32_t> shutdown_ranks_;
+  std::unordered_map<int32_t, std::vector<int32_t>> process_sets_;
+  mutable std::mutex mu_;
+};
+
+}  // namespace hvt

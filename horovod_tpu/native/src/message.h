@@ -1,0 +1,121 @@
+// Wire format for controller coordination messages.
+//
+// Parity: horovod/common/message.cc + horovod/common/wire/message.fbs
+// (Request / RequestList / Response / ResponseList, FlatBuffers).  We
+// use a hand-rolled little-endian length-prefixed format instead of
+// FlatBuffers: the blobs ride the JAX coordination-service KV store
+// (which replaces MPI_Gatherv/MPI_Bcast of the reference controller),
+// so all we need is compact, versioned, deterministic bytes.
+#pragma once
+
+#include <cstring>
+#include <stdexcept>
+
+#include "common.h"
+
+namespace hvt {
+
+constexpr uint32_t kRequestMagic = 0x52545648;   // "HVTR"
+constexpr uint32_t kResponseMagic = 0x50545648;  // "HVTP"
+constexpr uint32_t kWireVersion = 1;
+
+// A request as sent rank -> coordinator. Parity: message.h Request.
+struct Request {
+  int32_t rank = 0;
+  Entry entry;          // metadata of the op this rank declares ready
+  bool cached = false;  // true: only cache_bit below is meaningful
+  uint32_t cache_bit = 0;
+};
+
+// A rank's per-cycle message. Parity: RequestList (with its `shutdown`
+// flag; we add `joined` like EnqueueJoin's special request).
+struct RequestList {
+  int32_t rank = 0;
+  std::vector<Request> requests;
+  std::vector<uint32_t> cache_hits;  // bit ids of cached pending requests
+  bool joined = false;
+  bool shutdown = false;
+};
+
+// Coordinator decision for one fused batch. Parity: message.h Response:
+// one Response may carry many tensor names that execute as a single
+// fused collective.
+struct Response {
+  OpType type = OpType::kAllreduce;
+  RedOp red_op = RedOp::kSum;
+  DataType dtype = DataType::kFloat32;
+  int32_t process_set_id = 0;
+  int32_t root_rank = -1;
+  std::vector<std::string> tensor_names;
+  // Per-tensor shapes, parallel to tensor_names.  The reference carries
+  // shapes only in Requests; we echo them in Responses so every rank can
+  // rebuild the full cache entry from the response blob alone — that is
+  // what keeps ResponseCache bit ids identical across ranks even for
+  // process-set-restricted ops.
+  std::vector<std::vector<int64_t>> tensor_shapes;
+  int64_t total_bytes = 0;
+  std::string error;  // non-empty => error response (parity: Response::ERROR)
+};
+
+// Parity: ResponseList + `shutdown` flag.
+struct ResponseList {
+  std::vector<Response> responses;
+  int32_t join_last_rank = -1;  // >=0 once every rank joined
+  bool shutdown = false;
+};
+
+// ---------------------------------------------------------------------------
+// byte writer/reader
+// ---------------------------------------------------------------------------
+
+class Writer {
+ public:
+  std::vector<uint8_t> buf;
+  void u8(uint8_t v) { buf.push_back(v); }
+  void u32(uint32_t v) { raw(&v, 4); }
+  void i32(int32_t v) { raw(&v, 4); }
+  void i64(int64_t v) { raw(&v, 8); }
+  void u64(uint64_t v) { raw(&v, 8); }
+  void f64(double v) { raw(&v, 8); }
+  void str(const std::string& s) {
+    u32(static_cast<uint32_t>(s.size()));
+    raw(s.data(), s.size());
+  }
+  void raw(const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    buf.insert(buf.end(), b, b + n);
+  }
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* p, size_t n) : p_(p), end_(p + n) {}
+  uint8_t u8() { return *take(1); }
+  uint32_t u32() { uint32_t v; memcpy(&v, take(4), 4); return v; }
+  int32_t i32() { int32_t v; memcpy(&v, take(4), 4); return v; }
+  int64_t i64() { int64_t v; memcpy(&v, take(8), 8); return v; }
+  uint64_t u64() { uint64_t v; memcpy(&v, take(8), 8); return v; }
+  double f64() { double v; memcpy(&v, take(8), 8); return v; }
+  std::string str() {
+    uint32_t n = u32();
+    const uint8_t* p = take(n);
+    return std::string(reinterpret_cast<const char*>(p), n);
+  }
+
+ private:
+  const uint8_t* take(size_t n) {
+    if (p_ + n > end_) throw std::runtime_error("hvt wire: short read");
+    const uint8_t* r = p_;
+    p_ += n;
+    return r;
+  }
+  const uint8_t* p_;
+  const uint8_t* end_;
+};
+
+std::vector<uint8_t> SerializeRequestList(const RequestList& rl);
+RequestList ParseRequestList(const uint8_t* data, size_t len);
+std::vector<uint8_t> SerializeResponseList(const ResponseList& rl);
+ResponseList ParseResponseList(const uint8_t* data, size_t len);
+
+}  // namespace hvt

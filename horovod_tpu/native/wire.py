@@ -1,0 +1,262 @@
+"""Python mirror of the native wire format (native/src/message.cc).
+
+Parity surface: ``horovod/common/message.cc`` (+ ``wire/message.fbs``)
+— Request/RequestList/Response/ResponseList.  The byte layout here is
+bit-identical to the C++ implementation so native and pure-Python
+controllers interoperate on the same coordination channel (mixed
+deployments, and the fallback when no C++ toolchain is present).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import List, Tuple
+
+REQUEST_MAGIC = 0x52545648  # "HVTR"
+RESPONSE_MAGIC = 0x50545648  # "HVTP"
+WIRE_VERSION = 1
+
+# OpType (native/src/common.h)
+ALLREDUCE, ALLGATHER, BROADCAST, ALLTOALL, REDUCESCATTER, ADASUM, BARRIER, JOIN = range(8)
+# RedOp
+RED_SUM, RED_AVERAGE, RED_MIN, RED_MAX, RED_PRODUCT, RED_ADASUM = range(6)
+# DataType
+DTYPE_IDS = {
+    "uint8": 0, "int8": 1, "int32": 2, "int64": 3,
+    "float16": 4, "bfloat16": 5, "float32": 6, "float64": 7, "bool": 8,
+}
+DTYPE_NAMES = {v: k for k, v in DTYPE_IDS.items()}
+DTYPE_SIZES = {0: 1, 1: 1, 2: 4, 3: 8, 4: 2, 5: 2, 6: 4, 7: 8, 8: 1}
+
+
+@dataclasses.dataclass
+class Entry:
+    seq: int = 0
+    name: str = ""
+    type: int = ALLREDUCE
+    red_op: int = RED_SUM
+    dtype: int = 6
+    shape: Tuple[int, ...] = ()
+    process_set_id: int = 0
+    group_id: int = -1
+    root_rank: int = -1
+
+    @property
+    def num_elements(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    @property
+    def nbytes(self) -> int:
+        return self.num_elements * DTYPE_SIZES[self.dtype]
+
+    def signature(self) -> str:
+        """Must match ResponseCache::Signature (controller.cc)."""
+        dims = "".join(f"{d}," for d in self.shape)
+        return (f"{self.name}|{self.type}|{self.red_op}|{self.dtype}|"
+                f"{self.process_set_id}|{self.root_rank}|{dims}")
+
+
+@dataclasses.dataclass
+class Request:
+    rank: int = 0
+    entry: Entry = dataclasses.field(default_factory=Entry)
+    cached: bool = False
+    cache_bit: int = 0
+
+
+@dataclasses.dataclass
+class RequestList:
+    rank: int = 0
+    requests: List[Request] = dataclasses.field(default_factory=list)
+    cache_hits: List[int] = dataclasses.field(default_factory=list)
+    joined: bool = False
+    shutdown: bool = False
+
+
+@dataclasses.dataclass
+class Response:
+    type: int = ALLREDUCE
+    red_op: int = RED_SUM
+    dtype: int = 6
+    process_set_id: int = 0
+    root_rank: int = -1
+    tensor_names: List[str] = dataclasses.field(default_factory=list)
+    tensor_shapes: List[Tuple[int, ...]] = dataclasses.field(default_factory=list)
+    total_bytes: int = 0
+    error: str = ""
+
+
+@dataclasses.dataclass
+class ResponseList:
+    responses: List[Response] = dataclasses.field(default_factory=list)
+    join_last_rank: int = -1
+    shutdown: bool = False
+
+
+class _W:
+    def __init__(self):
+        self.parts: List[bytes] = []
+
+    def u8(self, v): self.parts.append(struct.pack("<B", v))
+    def u32(self, v): self.parts.append(struct.pack("<I", v))
+    def i32(self, v): self.parts.append(struct.pack("<i", v))
+    def i64(self, v): self.parts.append(struct.pack("<q", v))
+    def u64(self, v): self.parts.append(struct.pack("<Q", v))
+
+    def s(self, v: str):
+        b = v.encode("utf-8")
+        self.u32(len(b))
+        self.parts.append(b)
+
+    def bytes(self) -> bytes:
+        return b"".join(self.parts)
+
+
+class _R:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.off = 0
+
+    def _take(self, fmt: str, n: int):
+        v = struct.unpack_from(fmt, self.data, self.off)[0]
+        self.off += n
+        return v
+
+    def u8(self): return self._take("<B", 1)
+    def u32(self): return self._take("<I", 4)
+    def i32(self): return self._take("<i", 4)
+    def i64(self): return self._take("<q", 8)
+    def u64(self): return self._take("<Q", 8)
+
+    def s(self) -> str:
+        n = self.u32()
+        v = self.data[self.off:self.off + n].decode("utf-8")
+        self.off += n
+        return v
+
+
+def _write_entry(w: _W, e: Entry):
+    w.u64(e.seq)
+    w.s(e.name)
+    w.u8(e.type)
+    w.u8(e.red_op)
+    w.u8(e.dtype)
+    w.u8(len(e.shape))
+    for d in e.shape:
+        w.i64(d)
+    w.i32(e.process_set_id)
+    w.i64(e.group_id)
+    w.i32(e.root_rank)
+
+
+def _read_entry(r: _R) -> Entry:
+    e = Entry()
+    e.seq = r.u64()
+    e.name = r.s()
+    e.type = r.u8()
+    e.red_op = r.u8()
+    e.dtype = r.u8()
+    ndim = r.u8()
+    e.shape = tuple(r.i64() for _ in range(ndim))
+    e.process_set_id = r.i32()
+    e.group_id = r.i64()
+    e.root_rank = r.i32()
+    return e
+
+
+def serialize_request_list(rl: RequestList) -> bytes:
+    w = _W()
+    w.u32(REQUEST_MAGIC)
+    w.u32(WIRE_VERSION)
+    w.i32(rl.rank)
+    w.u8(1 if rl.joined else 0)
+    w.u8(1 if rl.shutdown else 0)
+    w.u32(len(rl.cache_hits))
+    for b in rl.cache_hits:
+        w.u32(b)
+    w.u32(len(rl.requests))
+    for rq in rl.requests:
+        w.i32(rq.rank)
+        w.u8(1 if rq.cached else 0)
+        w.u32(rq.cache_bit)
+        _write_entry(w, rq.entry)
+    return w.bytes()
+
+
+def parse_request_list(data: bytes) -> RequestList:
+    r = _R(data)
+    if r.u32() != REQUEST_MAGIC:
+        raise ValueError("bad request magic")
+    if r.u32() != WIRE_VERSION:
+        raise ValueError("bad wire version")
+    rl = RequestList()
+    rl.rank = r.i32()
+    rl.joined = r.u8() != 0
+    rl.shutdown = r.u8() != 0
+    rl.cache_hits = [r.u32() for _ in range(r.u32())]
+    n = r.u32()
+    for _ in range(n):
+        rq = Request()
+        rq.rank = r.i32()
+        rq.cached = r.u8() != 0
+        rq.cache_bit = r.u32()
+        rq.entry = _read_entry(r)
+        rl.requests.append(rq)
+    return rl
+
+
+def serialize_response_list(rl: ResponseList) -> bytes:
+    w = _W()
+    w.u32(RESPONSE_MAGIC)
+    w.u32(WIRE_VERSION)
+    w.i32(rl.join_last_rank)
+    w.u8(1 if rl.shutdown else 0)
+    w.u32(len(rl.responses))
+    for rs in rl.responses:
+        w.u8(rs.type)
+        w.u8(rs.red_op)
+        w.u8(rs.dtype)
+        w.i32(rs.process_set_id)
+        w.i32(rs.root_rank)
+        w.i64(rs.total_bytes)
+        w.s(rs.error)
+        w.u32(len(rs.tensor_names))
+        for n in rs.tensor_names:
+            w.s(n)
+        for shape in rs.tensor_shapes:
+            w.u8(len(shape))
+            for d in shape:
+                w.i64(d)
+    return w.bytes()
+
+
+def parse_response_list(data: bytes) -> ResponseList:
+    r = _R(data)
+    if r.u32() != RESPONSE_MAGIC:
+        raise ValueError("bad response magic")
+    if r.u32() != WIRE_VERSION:
+        raise ValueError("bad wire version")
+    rl = ResponseList()
+    rl.join_last_rank = r.i32()
+    rl.shutdown = r.u8() != 0
+    n = r.u32()
+    for _ in range(n):
+        rs = Response()
+        rs.type = r.u8()
+        rs.red_op = r.u8()
+        rs.dtype = r.u8()
+        rs.process_set_id = r.i32()
+        rs.root_rank = r.i32()
+        rs.total_bytes = r.i64()
+        rs.error = r.s()
+        nt = r.u32()
+        rs.tensor_names = [r.s() for _ in range(nt)]
+        rs.tensor_shapes = [
+            tuple(r.i64() for _ in range(r.u8())) for _ in range(nt)
+        ]
+        rl.responses.append(rs)
+    return rl

@@ -1,0 +1,279 @@
+"""Native core tests.
+
+Mirrors the reference's coverage of its C++ core (SURVEY.md §4: the
+controller/fusion/cache logic is exercised indirectly by
+test/parallel/*; we test it directly plus cross-check the C++ and
+pure-Python implementations agree byte-for-byte on the wire).
+"""
+
+import json
+import os
+
+import pytest
+
+from horovod_tpu import native
+from horovod_tpu.native import core as ncore
+from horovod_tpu.native import fallback, wire
+
+
+NATIVE = ncore.available()
+
+
+def make_pair(cls, size=2, fusion=1 << 20, **kw):
+    return [cls(r, size, fusion, **kw) for r in range(size)]
+
+
+def run_cycle(controllers, coordinator=0):
+    """One controller cycle: drain -> ingest at coordinator ->
+    compute -> apply everywhere. Returns (response_blob, finished_per_rank)."""
+    blobs = [c.drain_requests() for c in controllers]
+    coord = controllers[coordinator]
+    for b in blobs:
+        coord.ingest(b)
+    resp = coord.compute_responses()
+    finished = [c.apply_responses(resp) for c in controllers]
+    return resp, finished
+
+
+CONTROLLER_IMPLS = [fallback.PyController] + (
+    [ncore.NativeController] if NATIVE else []
+)
+
+
+@pytest.mark.parametrize("impl", CONTROLLER_IMPLS)
+class TestControllerProtocol:
+    def test_basic_allreduce_ready(self, impl):
+        c0, c1 = make_pair(impl)
+        assert c0.enqueue(1, "grad/a", wire.ALLREDUCE, wire.RED_SUM, 6, (4, 4))
+        # only rank 0 has submitted -> nothing ready
+        resp, fin = run_cycle([c0, c1])
+        assert fin == [[], []]
+        # now rank 1 submits too -> ready next cycle
+        assert c1.enqueue(7, "grad/a", wire.ALLREDUCE, wire.RED_SUM, 6, (4, 4))
+        resp, fin = run_cycle([c0, c1])
+        rl = wire.parse_response_list(resp)
+        assert len(rl.responses) == 1
+        assert rl.responses[0].tensor_names == ["grad/a"]
+        assert rl.responses[0].tensor_shapes == [(4, 4)]
+        assert fin == [[1], [7]]
+
+    def test_duplicate_name_rejected(self, impl):
+        (c,) = make_pair(impl, size=1)
+        assert c.enqueue(1, "x", wire.ALLREDUCE, wire.RED_SUM, 6, (2,))
+        assert not c.enqueue(2, "x", wire.ALLREDUCE, wire.RED_SUM, 6, (2,))
+
+    def test_fusion_under_threshold(self, impl):
+        # 3 compatible f32 tensors of 10 elements = 40B each; threshold
+        # 100B -> first two fuse, third goes alone (greedy, name order).
+        c0, c1 = make_pair(impl, fusion=100)
+        for c in (c0, c1):
+            for i, name in enumerate(["a", "b", "c"]):
+                c.enqueue(i + 1, name, wire.ALLREDUCE, wire.RED_SUM, 6, (10,))
+        resp, fin = run_cycle([c0, c1])
+        rl = wire.parse_response_list(resp)
+        assert [r.tensor_names for r in rl.responses] == [["a", "b"], ["c"]]
+        assert rl.responses[0].total_bytes == 80
+        # finished seqs preserve response order
+        assert fin[0] == [1, 2, 3]
+
+    def test_no_fusion_across_dtype_or_op(self, impl):
+        c0, c1 = make_pair(impl, fusion=1 << 20)
+        for c in (c0, c1):
+            c.enqueue(1, "a", wire.ALLREDUCE, wire.RED_SUM, 6, (4,))
+            c.enqueue(2, "b", wire.ALLREDUCE, wire.RED_SUM, 5, (4,))  # bf16
+            c.enqueue(3, "c", wire.BROADCAST, wire.RED_SUM, 6, (4,), 0, -1, 0)
+        resp, _ = run_cycle([c0, c1])
+        rl = wire.parse_response_list(resp)
+        assert len(rl.responses) == 3
+
+    def test_deterministic_name_order(self, impl):
+        # Ranks enqueue in different orders; response order is sorted
+        # by name regardless (parity: FuseResponses determinism).
+        c0, c1 = make_pair(impl)
+        for name, seq in (("z", 1), ("a", 2), ("m", 3)):
+            c0.enqueue(seq, name, wire.ALLREDUCE, wire.RED_SUM, 6, (1000,))
+        for name, seq in (("m", 1), ("z", 2), ("a", 3)):
+            c1.enqueue(seq, name, wire.ALLREDUCE, wire.RED_SUM, 6, (1000,))
+        resp, _ = run_cycle([c0, c1], coordinator=0)
+        rl = wire.parse_response_list(resp)
+        names = [n for r in rl.responses for n in r.tensor_names]
+        assert names == ["a", "m", "z"]
+
+    def test_response_cache_steady_state(self, impl):
+        c0, c1 = make_pair(impl)
+        for step in range(3):
+            for seq, c in enumerate((c0, c1), start=step * 10):
+                c.enqueue(seq + 1, "g", wire.ALLREDUCE, wire.RED_SUM, 6, (8,))
+            blobs = [c.drain_requests() for c in (c0, c1)]
+            parsed = [wire.parse_request_list(b) for b in blobs]
+            if step == 0:
+                assert not parsed[0].requests[0].cached
+            else:
+                # steady state: bit-only requests, much smaller blob
+                assert parsed[0].requests[0].cached
+                assert parsed[1].requests[0].cached
+            for b in blobs:
+                c0.ingest(b)
+            resp = c0.compute_responses()
+            for c in (c0, c1):
+                c.apply_responses(resp)
+            rl = wire.parse_response_list(resp)
+            assert [r.tensor_names for r in rl.responses] == [["g"]]
+            # cached expansion must preserve shape metadata
+            assert rl.responses[0].tensor_shapes == [(8,)]
+        assert c0.cache_size == 1
+
+    def test_cache_eviction_consistency(self, impl):
+        c0, c1 = make_pair(impl, cache_capacity=2)
+        # insert 3 distinct signatures -> evicts the LRU; both ranks
+        # must still agree (we just check no wrong-tensor responses).
+        for step, name in enumerate(["a", "b", "c", "a", "b", "c"]):
+            for c in (c0, c1):
+                c.enqueue(step * 2 + c.rank + 1, name,
+                          wire.ALLREDUCE, wire.RED_SUM, 6, (4,))
+            resp, fin = run_cycle([c0, c1])
+            rl = wire.parse_response_list(resp)
+            assert [r.tensor_names for r in rl.responses] == [[name]]
+        assert c0.cache_size == 2
+
+    def test_group_gating(self, impl):
+        c0, c1 = make_pair(impl)
+        for c in (c0, c1):
+            c.declare_group(5, 2)
+        # both ranks ready on only one of two group members -> held back
+        for c in (c0, c1):
+            c.enqueue(1, "g/x", wire.ALLREDUCE, wire.RED_SUM, 6, (4,), 0, 5)
+        resp, fin = run_cycle([c0, c1])
+        assert wire.parse_response_list(resp).responses == []
+        # second member arrives -> both released together
+        for c in (c0, c1):
+            c.enqueue(2, "g/y", wire.ALLREDUCE, wire.RED_SUM, 6, (4,), 0, 5)
+        resp, fin = run_cycle([c0, c1])
+        rl = wire.parse_response_list(resp)
+        names = [n for r in rl.responses for n in r.tensor_names]
+        assert sorted(names) == ["g/x", "g/y"]
+
+    def test_join(self, impl):
+        c0, c1 = make_pair(impl)
+        c0.set_joined()
+        resp, _ = run_cycle([c0, c1])
+        assert wire.parse_response_list(resp).join_last_rank == -1
+        c1.set_joined()
+        resp, _ = run_cycle([c0, c1])
+        assert wire.parse_response_list(resp).join_last_rank == 1
+
+    def test_process_set_subset(self, impl):
+        c0, c1, c2 = make_pair(impl, size=3)
+        for c in (c0, c1, c2):
+            c.register_process_set(7, [0, 2])
+        # only the two members of process set 7 need to report
+        c0.enqueue(1, "ps", wire.ALLREDUCE, wire.RED_SUM, 6, (4,), 7)
+        c2.enqueue(1, "ps", wire.ALLREDUCE, wire.RED_SUM, 6, (4,), 7)
+        resp, fin = run_cycle([c0, c1, c2])
+        rl = wire.parse_response_list(resp)
+        assert [r.tensor_names for r in rl.responses] == [["ps"]]
+        assert rl.responses[0].process_set_id == 7
+        assert fin[0] == [1] and fin[1] == [] and fin[2] == [1]
+
+    def test_stall_detection(self, impl):
+        c0, c1 = make_pair(impl, stall_warn_s=0.0)
+        c0.enqueue(1, "stuck", wire.ALLREDUCE, wire.RED_SUM, 6, (4,))
+        run_cycle([c0, c1])
+        stalls = c0.check_stalls()
+        assert len(stalls) == 1
+        assert stalls[0]["name"] == "stuck"
+        assert stalls[0]["present"] == [0]
+        assert stalls[0]["missing"] == [1]
+
+    def test_pending_introspection(self, impl):
+        (c,) = make_pair(impl, size=1)
+        c.enqueue(1, "t", wire.ALLREDUCE, wire.RED_SUM, 6, (10,))
+        assert c.pending_count == 1
+        assert c.pending_bytes == 40
+        c.drain_requests()
+        assert c.pending_count == 0
+
+
+@pytest.mark.skipif(not NATIVE, reason="no C++ toolchain")
+class TestNativePythonAgreement:
+    """The native and Python controllers must emit identical bytes for
+    identical inputs — that is what allows mixed fleets."""
+
+    def test_wire_bytes_identical(self):
+        seq_ops = [
+            (1, "w/dense/kernel", wire.ALLREDUCE, wire.RED_AVERAGE, 6, (128, 64)),
+            (2, "w/dense/bias", wire.ALLREDUCE, wire.RED_AVERAGE, 6, (64,)),
+            (3, "bcast/step", wire.BROADCAST, wire.RED_SUM, 3, ()),
+        ]
+        for step in range(3):  # includes cache steady-state cycles
+            nat = make_pair(ncore.NativeController, size=2, fusion=1 << 10)
+            py = make_pair(fallback.PyController, size=2, fusion=1 << 10)
+            for _ in range(step + 1):
+                for c in nat + py:
+                    for seq, name, op, red, dt, shape in seq_ops:
+                        c.enqueue(seq, name, op, red, dt, shape,
+                                  0, -1, 0 if op == wire.BROADCAST else -1)
+                nat_blobs = [c.drain_requests() for c in nat]
+                py_blobs = [c.drain_requests() for c in py]
+                assert nat_blobs == py_blobs
+                for b in nat_blobs:
+                    nat[0].ingest(b)
+                for b in py_blobs:
+                    py[0].ingest(b)
+                nat_resp = nat[0].compute_responses()
+                py_resp = py[0].compute_responses()
+                assert nat_resp == py_resp
+                nat_fins = [c.apply_responses(nat_resp) for c in nat]
+                py_fins = [c.apply_responses(py_resp) for c in py]
+                assert nat_fins == py_fins
+
+    def test_cross_impl_fleet(self):
+        """Rank 0 native + rank 1 Python coordinate successfully."""
+        c0 = ncore.NativeController(0, 2, 1 << 20)
+        c1 = fallback.PyController(1, 2, 1 << 20)
+        for step in range(2):
+            for c in (c0, c1):
+                c.enqueue(step + 1, "mixed", wire.ALLREDUCE,
+                          wire.RED_SUM, 6, (16,))
+            resp, fin = run_cycle([c0, c1])
+            assert fin == [[step + 1], [step + 1]]
+
+
+@pytest.mark.skipif(not NATIVE, reason="no C++ toolchain")
+class TestNativeUtilities:
+    def test_parallel_gather_scatter(self):
+        import numpy as np
+
+        srcs = [np.arange(i * 7, i * 7 + 13, dtype=np.uint8) for i in range(5)]
+        total = sum(s.nbytes for s in srcs)
+        dst = bytearray(total)
+        ncore.parallel_gather(memoryview(dst),
+                              [memoryview(s) for s in srcs])
+        assert bytes(dst) == b"".join(s.tobytes() for s in srcs)
+        outs = [bytearray(13) for _ in range(5)]
+        ncore.parallel_scatter(memoryview(bytes(dst)),
+                               [memoryview(o) for o in outs])
+        for s, o in zip(srcs, outs):
+            assert bytes(o) == s.tobytes()
+
+    def test_timeline_writer(self, tmp_path):
+        p = tmp_path / "tl.json"
+        tl = ncore.NativeTimeline(str(p), rank=0)
+        tl.event("NEGOTIATE_ALLREDUCE", "B", "negotiate", 1.0)
+        tl.event("NEGOTIATE_ALLREDUCE", "E", "negotiate", 2.0)
+        tl.event("XLA_COLLECTIVE", "X", "comm", 3.0, 4.5)
+        tl.mark_cycle(10.0)
+        tl.close()
+        events = json.loads(p.read_text())
+        assert [e["ph"] for e in events] == ["B", "E", "X", "i"]
+        assert events[2]["dur"] == 4.5
+
+    def test_pool_threads(self):
+        lib = ncore.load()
+        assert lib.hvt_pool_num_threads() >= 1
+
+
+def test_make_controller_fallback_env(monkeypatch):
+    monkeypatch.setenv("HVTPU_FORCE_PY_CONTROLLER", "1")
+    c = native.make_controller(0, 1, 1 << 20)
+    assert isinstance(c, fallback.PyController)
