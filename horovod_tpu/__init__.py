@@ -246,28 +246,86 @@ def barrier(*, process_set=None):
     return _eager.barrier(process_set=process_set)
 
 
-# --- async variants (parity: *_async + synchronize/poll; the XLA runtime
-# is natively async, so handles wrap undelivered arrays) ---
+# --- async variants (parity: *_async + synchronize/poll in
+# horovod/torch/mpi_ops.py).  Async ops go through the eager
+# mini-controller (horovod_tpu.eager): ranks may enqueue in ANY order —
+# the controller negotiates an agreed, fused execution schedule each
+# cycle, exactly the reference's background-thread semantics.  Sync ops
+# (above) bypass it and require identical issuance order across ranks,
+# like any SPMD program. ---
 
-def allreduce_async(tensor, *, op=None, average=None, name=None, **kw):
-    out = allreduce(tensor, op=op, average=average, **kw)
-    return _handle_manager().allocate(out)
+def _controller():
+    from .eager import get_controller
 
-
-def allgather_async(tensor, *, name=None, **kw):
-    return _handle_manager().allocate(allgather(tensor, **kw))
-
-
-def broadcast_async(tensor, root_rank: int = 0, *, name=None, **kw):
-    return _handle_manager().allocate(broadcast(tensor, root_rank, **kw))
+    return get_controller()
 
 
-def alltoall_async(tensor, splits=None, *, name=None, **kw):
-    return _handle_manager().allocate(alltoall(tensor, splits, **kw))
+def allreduce_async(tensor, *, op=None, average=None, name=None,
+                    compression=Compression.none, process_set=None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0):
+    _state.require_init("allreduce_async")
+    from .comm.reduce_ops import normalize_op
+
+    fut = _controller().enqueue(
+        "allreduce", tensor, name=name, op=normalize_op(op, average),
+        compression=compression, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+    )
+    return _handle_manager().allocate(fut)
 
 
-def reducescatter_async(tensor, *, op=None, name=None, **kw):
-    return _handle_manager().allocate(reducescatter(tensor, op=op, **kw))
+def grouped_allreduce_async(tensors, *, op=None, average=None, names=None,
+                            compression=Compression.none, process_set=None):
+    """Async grouped allreduce: the set executes only when every member
+    is ready on every rank (parity: group_table.cc)."""
+    _state.require_init("grouped_allreduce_async")
+    from .comm.reduce_ops import normalize_op
+
+    futs = _controller().grouped_enqueue(
+        "allreduce", list(tensors), names=names,
+        op=normalize_op(op, average), compression=compression,
+        process_set=process_set,
+    )
+    return [_handle_manager().allocate(f) for f in futs]
+
+
+def allgather_async(tensor, *, name=None, process_set=None):
+    _state.require_init("allgather_async")
+    fut = _controller().enqueue(
+        "allgather", tensor, name=name, process_set=process_set
+    )
+    return _handle_manager().allocate(fut)
+
+
+def broadcast_async(tensor, root_rank: int = 0, *, name=None,
+                    process_set=None):
+    _state.require_init("broadcast_async")
+    fut = _controller().enqueue(
+        "broadcast", tensor, name=name, root_rank=root_rank,
+        process_set=process_set,
+    )
+    return _handle_manager().allocate(fut)
+
+
+def alltoall_async(tensor, splits=None, *, name=None, process_set=None):
+    _state.require_init("alltoall_async")
+    fut = _controller().enqueue(
+        "alltoall", tensor, name=name, splits=splits,
+        process_set=process_set,
+    )
+    return _handle_manager().allocate(fut)
+
+
+def reducescatter_async(tensor, *, op=None, name=None, process_set=None):
+    _state.require_init("reducescatter_async")
+    from .comm.reduce_ops import normalize_op
+
+    fut = _controller().enqueue(
+        "reducescatter", tensor, name=name,
+        op=normalize_op(op, None), process_set=process_set,
+    )
+    return _handle_manager().allocate(fut)
 
 
 def synchronize(handle: int):
@@ -311,12 +369,9 @@ def join(device=None) -> int:
     st = _state.require_init("join")
     if st.size == 1:
         return 0
-    import jax.numpy as jnp
-
-    last = _eager.allreduce(
-        jnp.asarray(st.rank, jnp.int32), op=Max
-    )
-    return int(last)
+    # Dynamic form through the mini-controller: ranks may keep issuing
+    # async collectives; join resolves once every rank has joined.
+    return int(_controller().join().result())
 
 
 # ---------------------------------------------------------------------------
@@ -337,7 +392,8 @@ __all__ = [
     "num_devices", "local_devices", "world_mesh", "hierarchical_mesh", "mesh",
     "allreduce", "grouped_allreduce", "allgather", "broadcast", "alltoall",
     "reducescatter", "barrier", "join",
-    "allreduce_async", "allgather_async", "broadcast_async", "alltoall_async",
+    "allreduce_async", "grouped_allreduce_async", "allgather_async",
+    "broadcast_async", "alltoall_async",
     "reducescatter_async", "synchronize", "poll",
     "start_timeline", "stop_timeline",
     "DistributedOptimizer", "allreduce_gradients",

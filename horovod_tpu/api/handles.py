@@ -39,7 +39,11 @@ class HandleManager:
             if handle not in self._results:
                 raise ValueError(f"unknown or already-synchronized handle {handle}")
             value = self._results.pop(handle)
-        if callable(value):
+        if hasattr(value, "result") and hasattr(value, "done"):
+            # Controller future (horovod_tpu.eager.OpFuture, duck-typed
+            # to avoid the import cycle): block on negotiation+execution.
+            value = value.result()
+        elif callable(value):
             value = value()
         return jax.block_until_ready(value)
 
@@ -48,6 +52,8 @@ class HandleManager:
             value = self._results.get(handle)
         if value is None:
             return True  # unknown / already-synchronized handles are done
+        if hasattr(value, "result") and hasattr(value, "done"):
+            return bool(value.done())
         if callable(value):
             return False
         # value may be a pytree (e.g. alltoall's (tensor, splits) pair):

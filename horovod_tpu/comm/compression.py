@@ -29,6 +29,13 @@ class Compressor:
     def decompress(tensor, ctx):
         raise NotImplementedError
 
+    @staticmethod
+    def wire_dtype(dtype):
+        """Dtype that actually crosses the wire for an input of `dtype`
+        (the fusion/caching signature — fusion_buffer_manager.cc keys
+        buffers on the buffer dtype, not the framework dtype)."""
+        return dtype
+
 
 class NoneCompressor(Compressor):
     @staticmethod
@@ -56,6 +63,10 @@ class FP16Compressor(Compressor):
             tensor = tensor.astype(ctx)
         return tensor
 
+    @staticmethod
+    def wire_dtype(dtype):
+        return jnp.float16 if jnp.issubdtype(dtype, jnp.floating) else dtype
+
 
 class BF16Compressor(Compressor):
     """bfloat16 wire format — the TPU-idiomatic 2× compression."""
@@ -72,6 +83,10 @@ class BF16Compressor(Compressor):
         if ctx is not None and tensor.dtype != ctx:
             tensor = tensor.astype(ctx)
         return tensor
+
+    @staticmethod
+    def wire_dtype(dtype):
+        return jnp.bfloat16 if jnp.issubdtype(dtype, jnp.floating) else dtype
 
 
 class Int8Compressor(Compressor):
@@ -109,6 +124,10 @@ class Int8Compressor(Compressor):
         orig_dtype, orig_shape, n, scale = ctx
         deq = tensor.astype(jnp.float32) * scale
         return deq.reshape(-1)[:n].reshape(orig_shape).astype(orig_dtype)
+
+    @staticmethod
+    def wire_dtype(dtype):
+        return jnp.int8 if jnp.issubdtype(dtype, jnp.floating) else dtype
 
 
 class Compression:

@@ -189,6 +189,8 @@ def add_process_set(ps) -> ProcessSet:
     if not isinstance(ps, ProcessSet):
         ps = ProcessSet(ps)
     st.process_set_table.add(ps)
+    if st.controller is not None and ps.ranks is not None:
+        st.controller.register_process_set(ps.process_set_id, ps.ranks)
     return ps
 
 

@@ -1,0 +1,53 @@
+"""Eager mini-controller package (SURVEY.md §7.0 "eager/").
+
+Restores the reference's out-of-order enqueue tolerance for the async
+eager API: ranks may submit collectives in any order; the controller
+negotiates a globally-agreed, deterministically-fused execution schedule
+each cycle (parity: BackgroundThreadLoop + Controller::
+ComputeResponseList), then executes it on the XLA data plane.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core import state as core_state
+from .controller import (
+    EagerController,
+    KVTransport,
+    LocalTransport,
+    OpFuture,
+)
+
+
+def get_controller() -> EagerController:
+    """The process-wide controller, started lazily on first use
+    (parity: InitializeHorovodOnce starting the background thread)."""
+    st = core_state.require_init("async eager collectives")
+    if st.controller is None:
+        cfg = st.config
+        process_sets = {
+            psid: list(ps.ranks)
+            for psid, ps in st.process_set_table._table.items()
+            if ps.ranks is not None
+        }
+        st.controller = EagerController(
+            st.rank,
+            st.size,
+            cycle_time_ms=cfg.cycle_time_ms,
+            fusion_threshold=cfg.fusion_threshold_bytes,
+            cache_capacity=cfg.cache_capacity,
+            stall_warn_s=(float("inf") if cfg.stall_check_disable
+                          else cfg.stall_check_time_seconds),
+            stall_abort_s=cfg.stall_shutdown_time_seconds,
+            timeline=st.timeline,
+            process_sets=process_sets,
+        )
+        st.controller.start()
+    return st.controller
+
+
+__all__ = [
+    "EagerController", "OpFuture", "KVTransport", "LocalTransport",
+    "get_controller",
+]

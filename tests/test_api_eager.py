@@ -63,8 +63,10 @@ class TestEagerSingleProcess:
 
     def test_async_and_synchronize(self, hvt):
         h = hvt.allreduce_async(jnp.ones((2,)))
-        assert hvt.poll(h)
+        # Truly async now (reference semantics): poll flips to True once
+        # the background cycle completes the op; synchronize blocks.
         out = hvt.synchronize(h)
+        assert hvt.poll(h)  # completed handles poll True
         np.testing.assert_allclose(np.asarray(out), np.ones((2,)))
         with pytest.raises(ValueError):
             hvt.synchronize(h)  # double-sync of same handle

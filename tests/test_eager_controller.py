@@ -1,0 +1,233 @@
+"""Eager mini-controller tests.
+
+The reference exercises its controller via the async torch API under
+horovodrun (SURVEY.md §4).  Here, multi-rank negotiation runs as N
+controller instances over an in-memory KV store (the localhost-as-
+cluster pattern at the thread level); the XLA data plane degenerates to
+local math in a 1-process world, which is exactly what we want: these
+tests pin the *coordination* semantics.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.comm.compression import Compression
+from horovod_tpu.comm.reduce_ops import ReduceOp
+from horovod_tpu.core.exceptions import HorovodInternalError
+from horovod_tpu.eager.controller import EagerController, KVTransport
+from horovod_tpu.native import wire
+
+
+class FakeKV:
+    """In-memory stand-in for the JAX coordination-service KV client."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._store = {}
+
+    def key_value_set(self, key, value):
+        with self._lock:
+            self._store[key] = value
+            self._lock.notify_all()
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._lock:
+            while key not in self._store:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"KV key {key} not set")
+                self._lock.wait(remaining)
+            return self._store[key]
+
+    def key_value_delete(self, key):
+        with self._lock:
+            self._store.pop(key, None)
+
+
+def make_world(size, **kw):
+    kv = FakeKV()
+    ctrls = [
+        EagerController(
+            r, size,
+            transport=KVTransport(r, size, client=kv, timeout_s=20.0),
+            cycle_time_ms=0.5,
+            **kw,
+        )
+        for r in range(size)
+    ]
+    for c in ctrls:
+        c.start()
+    return ctrls
+
+
+def stop_world(ctrls):
+    for c in ctrls:
+        c.stop()
+
+
+# --------------------------------------------------------------------------
+# single-process (LocalTransport) behavior through the public API
+# --------------------------------------------------------------------------
+
+class TestSingleProcess:
+    def test_allreduce_async_roundtrip(self, hvt):
+        h = hvt.allreduce_async(jnp.arange(6.0), average=False, name="t0")
+        out = hvt.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out), np.arange(6.0))
+
+    def test_poll_completes(self, hvt):
+        h = hvt.allreduce_async(jnp.ones((4,)), average=True, name="t1")
+        deadline = time.monotonic() + 10
+        while not hvt.poll(h):
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        out = hvt.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out), 1.0)
+
+    def test_out_of_order_many(self, hvt):
+        handles = {
+            name: hvt.allreduce_async(jnp.full((3,), float(i)), name=name)
+            for i, name in enumerate(["z", "b", "q", "a"])
+        }
+        for i, name in enumerate(["z", "b", "q", "a"]):
+            out = hvt.synchronize(handles[name])
+            np.testing.assert_allclose(np.asarray(out), float(i))
+
+    def test_all_op_kinds(self, hvt):
+        ha = hvt.allgather_async(jnp.arange(4.0), name="ag")
+        hb = hvt.broadcast_async(jnp.full((2,), 7.0), 0, name="bc")
+        hr = hvt.reducescatter_async(jnp.arange(8.0), name="rs")
+        np.testing.assert_allclose(np.asarray(hvt.synchronize(ha)),
+                                   np.arange(4.0))
+        np.testing.assert_allclose(np.asarray(hvt.synchronize(hb)), 7.0)
+        hvt.synchronize(hr)
+
+    def test_grouped_allreduce_async(self, hvt):
+        tensors = [jnp.full((2,), 1.0), jnp.full((3,), 2.0)]
+        handles = hvt.grouped_allreduce_async(
+            tensors, names=["ga/x", "ga/y"], average=False
+        )
+        outs = [hvt.synchronize(h) for h in handles]
+        np.testing.assert_allclose(np.asarray(outs[0]), 1.0)
+        np.testing.assert_allclose(np.asarray(outs[1]), 2.0)
+
+    def test_duplicate_pending_name_fails(self, hvt):
+        ctrl = None
+        from horovod_tpu.eager import get_controller
+
+        ctrl = get_controller()
+        # enqueue directly with manual pause so the first is still pending
+        f1 = ctrl.enqueue("allreduce", jnp.ones(2), name="dup")
+        f2 = ctrl.enqueue("allreduce", jnp.ones(2), name="dup")
+        # one of them errors with the duplicate-name status
+        try:
+            f2.result(timeout=10)
+            dup_failed = False
+        except HorovodInternalError:
+            dup_failed = True
+        f1.result(timeout=10)
+        assert dup_failed
+
+    def test_join_single(self, hvt):
+        assert hvt.join() == 0
+
+    def test_compression_fused(self, hvt):
+        hs = [
+            hvt.allreduce_async(
+                jnp.full((4,), 3.0), name=f"c/{i}",
+                compression=Compression.fp16, average=False,
+            )
+            for i in range(3)
+        ]
+        for h in hs:
+            out = hvt.synchronize(h)
+            assert out.dtype == jnp.float32
+            np.testing.assert_allclose(np.asarray(out), 3.0)
+
+
+# --------------------------------------------------------------------------
+# multi-rank negotiation over the KV transport
+#
+# The N "ranks" are N controller instances in one process; the XLA data
+# plane underneath each runs in this process's 1-rank world (so results
+# are local values) — these tests pin negotiation, not the math.  The
+# `hvt` fixture initializes that 1-rank world for the data plane.
+# --------------------------------------------------------------------------
+
+class TestMultiRankNegotiation:
+    def test_out_of_order_enqueue_resolves(self, hvt):
+        ctrls = make_world(2)
+        try:
+            # rank 0 enqueues a then b; rank 1 enqueues b then a — the
+            # exact reordering scenario the controller exists for.
+            fa0 = ctrls[0].enqueue("allreduce", jnp.ones(4), name="a")
+            fb0 = ctrls[0].enqueue("allreduce", jnp.ones(4), name="b")
+            fb1 = ctrls[1].enqueue("allreduce", jnp.ones(4), name="b")
+            fa1 = ctrls[1].enqueue("allreduce", jnp.ones(4), name="a")
+            for f in (fa0, fb0, fb1, fa1):
+                f.result(timeout=20)
+        finally:
+            stop_world(ctrls)
+
+    def test_partial_submission_waits(self, hvt):
+        ctrls = make_world(2)
+        try:
+            f0 = ctrls[0].enqueue("allreduce", jnp.ones(2), name="only0")
+            time.sleep(0.2)
+            assert not f0.done()  # rank 1 never submitted
+            f1 = ctrls[1].enqueue("allreduce", jnp.ones(2), name="only0")
+            f0.result(timeout=20)
+            f1.result(timeout=20)
+        finally:
+            stop_world(ctrls)
+
+    def test_dynamic_join(self, hvt):
+        ctrls = make_world(2)
+        try:
+            jf0 = ctrls[0].join()
+            # join resolves only after EVERY rank joins; rank 1 is late.
+            time.sleep(0.1)
+            assert not jf0.done()
+            jf1 = ctrls[1].join()
+            assert jf0.result(timeout=20) == 1
+            assert jf1.result(timeout=20) == 1
+        finally:
+            stop_world(ctrls)
+
+    def test_steady_state_cache_and_fusion(self, hvt):
+        ctrls = make_world(2, fusion_threshold=1 << 20)
+        try:
+            for step in range(3):
+                futs = []
+                for c in ctrls:
+                    for i in range(4):
+                        futs.append(c.enqueue(
+                            "allreduce", jnp.full((8,), float(step)),
+                            name=f"g/{i}", op=ReduceOp.SUM,
+                        ))
+                for f in futs:
+                    f.result(timeout=20)
+            assert ctrls[0]._ctrl.cache_size == 4
+        finally:
+            stop_world(ctrls)
+
+    def test_stall_abort_fails_futures(self, hvt):
+        ctrls = make_world(2, stall_warn_s=0.0, stall_abort_s=0.3)
+        try:
+            f0 = ctrls[0].enqueue("allreduce", jnp.ones(2), name="never")
+            with pytest.raises(HorovodInternalError):
+                f0.result(timeout=30)
+        finally:
+            stop_world(ctrls)
+
+    def test_shutdown_fails_pending(self, hvt):
+        ctrls = make_world(2)
+        f0 = ctrls[0].enqueue("allreduce", jnp.ones(2), name="pend")
+        stop_world(ctrls)
+        with pytest.raises(HorovodInternalError):
+            f0.result(timeout=5)
