@@ -9,6 +9,7 @@ ComputeResponseList), then executes it on the XLA data plane.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 from ..core import state as core_state
@@ -19,31 +20,38 @@ from .controller import (
     OpFuture,
 )
 
+_init_lock = threading.Lock()
+
 
 def get_controller() -> EagerController:
     """The process-wide controller, started lazily on first use
-    (parity: InitializeHorovodOnce starting the background thread)."""
+    (parity: InitializeHorovodOnce starting the background thread).
+    Thread-safe: concurrent first calls create exactly one controller."""
     st = core_state.require_init("async eager collectives")
-    if st.controller is None:
-        cfg = st.config
-        process_sets = {
-            psid: list(ps.ranks)
-            for psid, ps in st.process_set_table._table.items()
-            if ps.ranks is not None
-        }
-        st.controller = EagerController(
-            st.rank,
-            st.size,
-            cycle_time_ms=cfg.cycle_time_ms,
-            fusion_threshold=cfg.fusion_threshold_bytes,
-            cache_capacity=cfg.cache_capacity,
-            stall_warn_s=(float("inf") if cfg.stall_check_disable
-                          else cfg.stall_check_time_seconds),
-            stall_abort_s=cfg.stall_shutdown_time_seconds,
-            timeline=st.timeline,
-            process_sets=process_sets,
-        )
-        st.controller.start()
+    if st.controller is not None:
+        return st.controller
+    with _init_lock:
+        if st.controller is None:
+            cfg = st.config
+            process_sets = {
+                psid: list(ps.ranks)
+                for psid, ps in st.process_set_table._table.items()
+                if ps.ranks is not None
+            }
+            controller = EagerController(
+                st.rank,
+                st.size,
+                cycle_time_ms=cfg.cycle_time_ms,
+                fusion_threshold=cfg.fusion_threshold_bytes,
+                cache_capacity=cfg.cache_capacity,
+                stall_warn_s=(float("inf") if cfg.stall_check_disable
+                              else cfg.stall_check_time_seconds),
+                stall_abort_s=cfg.stall_shutdown_time_seconds,
+                timeline=st.timeline,
+                process_sets=process_sets,
+            )
+            controller.start()
+            st.controller = controller
     return st.controller
 
 

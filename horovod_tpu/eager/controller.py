@@ -164,7 +164,8 @@ class KVTransport:
 
 class _Payload:
     __slots__ = ("seq", "name", "future", "tensor", "rop", "prescale",
-                 "postscale", "compressor", "splits", "kind")
+                 "postscale", "compressor", "splits", "kind",
+                 "process_set", "root_rank", "t_enqueue")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -192,6 +193,7 @@ class EagerController:
         # manual=True: no background thread; tests drive run_cycle_once.
         self.manual = manual
         self.cycle_time_s = cycle_time_ms / 1000.0
+        self.stall_warn_s = stall_warn_s
         self.stall_abort_s = stall_abort_s
         self._ctrl = native.make_controller(
             rank, size, fusion_threshold, cache_capacity,
@@ -206,7 +208,7 @@ class EagerController:
         )
         self._timeline = timeline
         self._seq = itertools.count(1)
-        self._noname = itertools.count(0)
+        self._noname: Dict[str, itertools.count] = {}
         self._group_ids = itertools.count(1)
         self._lock = threading.Lock()
         self._payloads: Dict[int, _Payload] = {}
@@ -230,8 +232,10 @@ class EagerController:
 
     def stop(self):
         self._stop.set()
+        thread_exited = True
         if self._thread is not None:
             self._thread.join(timeout=30)
+            thread_exited = not self._thread.is_alive()
             self._thread = None
         self._transport.close()
         # Fail anything still outstanding, like the reference's shutdown
@@ -244,13 +248,25 @@ class EagerController:
             p.future.set_error(
                 HorovodInternalError("controller shut down with pending ops")
             )
-        self._ctrl.close()
+        if thread_exited:
+            self._ctrl.close()
+        else:
+            # The cycle thread may still be blocked in a transport call
+            # holding a reference to the native controller; leaking it
+            # beats a use-after-free when the call finally returns.
+            logger.warning(
+                "controller cycle thread did not exit within 30s; "
+                "leaking native controller handle"
+            )
 
     # ---- enqueue API ----
     def _auto_name(self, kind: str) -> str:
-        # Parity: mpi_ops.py's "allreduce.noname.<n>" counters.  The
-        # counter pairs ops across ranks by issuance count per kind.
-        return f"{kind}.noname.{next(self._noname)}"
+        # Parity: mpi_ops.py's "allreduce.noname.<n>" counters — one
+        # counter PER KIND so unnamed ops of different kinds pair up
+        # across ranks by per-kind issuance count even when ranks
+        # interleave kinds in different orders.
+        ctr = self._noname.setdefault(kind, itertools.count(0))
+        return f"{kind}.noname.{next(ctr)}"
 
     def enqueue(self, kind: str, tensor, *, name: Optional[str] = None,
                 op: ReduceOp = ReduceOp.SUM, process_set=None,
@@ -289,6 +305,8 @@ class EagerController:
             seq=None, name=name, future=fut, tensor=x,
             rop=op, prescale=prescale_factor, postscale=postscale_factor,
             compressor=compressor, splits=splits, kind=kind,
+            process_set=process_set, root_rank=root_rank,
+            t_enqueue=time.monotonic(),
         )
         with self._lock:
             seq = next(self._seq)
@@ -311,12 +329,39 @@ class EagerController:
     def grouped_enqueue(self, kind: str, tensors, names=None, **kw
                         ) -> List[OpFuture]:
         """Enqueue a set that must execute together (parity:
-        hvd.grouped_allreduce via group_table.cc)."""
+        hvd.grouped_allreduce via group_table.cc).
+
+        Names are validated up front: a duplicate (within the group or
+        against a pending op) fails the WHOLE group immediately, since a
+        partially-enqueued group could never reach its declared quorum
+        and would hang the surviving members until stall abort.
+        """
+        eff_names = [
+            (names[i] if names else None) or self._auto_name(kind)
+            for i in range(len(tensors))
+        ]
+        with self._lock:
+            dup = None
+            seen = set()
+            for n in eff_names:
+                if n in seen or n in self._by_name:
+                    dup = n
+                    break
+                seen.add(n)
+        if dup is not None:
+            futs = []
+            for n in eff_names:
+                f = OpFuture(n)
+                f.set_error(HorovodInternalError(
+                    f"duplicate tensor name in group: {dup!r} "
+                    "(parity: TensorQueue DUPLICATE_NAME_ERROR)"
+                ))
+                futs.append(f)
+            return futs
         gid = next(self._group_ids)
         self._ctrl.declare_group(gid, len(tensors))
         futures = []
-        for i, t in enumerate(tensors):
-            n = names[i] if names else None
+        for t, n in zip(tensors, eff_names):
             futures.append(self.enqueue(kind, t, name=n, group_id=gid, **kw))
         return futures
 
@@ -370,12 +415,19 @@ class EagerController:
         rl = wire.parse_response_list(resp_blob)
         if rl.responses or rl.join_last_rank >= 0:
             self._execute(rl, finished)
-        if self.rank == 0 and cycle % 256 == 0:
+        if cycle % 256 == 0:
             self._inspect_stalls()
 
     def _inspect_stalls(self):
         # Parity: stall_inspector.cc — name the tensors and the missing
         # ranks; warn once per tensor, abort past the shutdown deadline.
+        # Rank 0 (coordinator) sees per-rank presence via its message
+        # table; every OTHER rank watchdogs its own pending payloads by
+        # age so a stalled collective surfaces everywhere, not just on
+        # the coordinator.
+        if self.rank != 0:
+            self._inspect_local_stalls()
+            return
         for s in self._ctrl.check_stalls():
             key = s["name"]
             if key not in self._stall_logged:
@@ -390,6 +442,31 @@ class EagerController:
                 raise HorovodInternalError(
                     f"collective {s['name']!r} stalled for "
                     f"{s['waiting_s']:.0f}s; missing ranks {s['missing']}"
+                )
+
+    def _inspect_local_stalls(self):
+        """Age-based watchdog for non-coordinator ranks: they cannot see
+        which ranks are missing (only rank 0's message table can), but
+        they can tell their own op has waited too long."""
+        now = time.monotonic()
+        with self._lock:
+            pending = [(p.name, now - p.t_enqueue)
+                       for p in self._payloads.values()]
+        for name, waited in pending:
+            if waited < self.stall_warn_s:
+                continue
+            key = f"local:{name}"
+            if key not in self._stall_logged:
+                self._stall_logged.add(key)
+                logger.warning(
+                    "stalled collective %r: waited %.1fs on rank %d "
+                    "(coordinator rank 0 logs which ranks are missing)",
+                    name, waited, self.rank,
+                )
+            if self.stall_abort_s > 0 and waited > self.stall_abort_s:
+                raise HorovodInternalError(
+                    f"collective {name!r} stalled for {waited:.0f}s on "
+                    f"rank {self.rank}"
                 )
 
     # ---- execution (parity: PerformOperation dispatching to ops/*) ----
@@ -428,30 +505,39 @@ class EagerController:
                 f.set_result(rl.join_last_rank)
 
     def _execute_one(self, rs: wire.Response, payloads: List[_Payload]):
+        # Each op executes over ITS process set (parity: PerformOperation
+        # looking up the Response's process_set_id communicator); the
+        # controller negotiated readiness among exactly those ranks.
         if rs.type == wire.BARRIER:
             for p in payloads:
-                eager_comm.barrier()
+                eager_comm.barrier(process_set=p.process_set)
                 p.future.set_result(None)
             return
         if rs.type == wire.ALLREDUCE:
             self._execute_allreduce(rs, payloads)
         elif rs.type == wire.ALLGATHER:
             for p in payloads:
-                p.future.set_result(eager_comm.allgather(p.tensor))
+                p.future.set_result(
+                    eager_comm.allgather(p.tensor,
+                                         process_set=p.process_set)
+                )
         elif rs.type == wire.BROADCAST:
             for p in payloads:
                 p.future.set_result(
-                    eager_comm.broadcast(p.tensor, root_rank=rs.root_rank)
+                    eager_comm.broadcast(p.tensor, root_rank=rs.root_rank,
+                                         process_set=p.process_set)
                 )
         elif rs.type == wire.ALLTOALL:
             for p in payloads:
                 p.future.set_result(
-                    eager_comm.alltoall(p.tensor, p.splits)
+                    eager_comm.alltoall(p.tensor, p.splits,
+                                        process_set=p.process_set)
                 )
         elif rs.type == wire.REDUCESCATTER:
             for p in payloads:
                 p.future.set_result(
-                    eager_comm.reducescatter(p.tensor, op=p.rop)
+                    eager_comm.reducescatter(p.tensor, op=p.rop,
+                                             process_set=p.process_set)
                 )
         else:  # pragma: no cover
             raise HorovodInternalError(f"unknown response type {rs.type}")
@@ -476,6 +562,7 @@ class EagerController:
                     postscale_factor=p.postscale,
                     compression=p.compressor,
                     name=p.name,
+                    process_set=p.process_set,
                 )
                 p.future.set_result(out)
             return
@@ -492,8 +579,12 @@ class EagerController:
             wires.append(t)
             ctxs.append(ctx)
         flat, _ = pack_flat(wires)
+        # The fuser only merges responses with equal process_set_id
+        # (fallback._fuse / Controller::FuseResponses), so the group's
+        # shared set is payloads[0]'s.
         red = eager_comm.allreduce(
-            flat, op=rop, name=f"fused.{rs.tensor_names[0]}.{len(payloads)}"
+            flat, op=rop, name=f"fused.{rs.tensor_names[0]}.{len(payloads)}",
+            process_set=payloads[0].process_set,
         )
         specs = [(tuple(w.shape), w.dtype, int(w.size)) for w in wires]
         for p, ctx, piece in zip(payloads, ctxs, unpack_flat(red, specs)):

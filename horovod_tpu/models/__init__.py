@@ -1,4 +1,11 @@
 from .mlp import MLP
+from .transformer import (
+    TransformerConfig,
+    init_params as transformer_init_params,
+    make_loss_fn as transformer_loss_fn,
+    make_train_step as transformer_train_step,
+    param_specs as transformer_param_specs,
+)
 from .resnet import (
     ResNet,
     ResNet18,
@@ -10,6 +17,11 @@ from .resnet import (
 
 __all__ = [
     "MLP",
+    "TransformerConfig",
+    "transformer_init_params",
+    "transformer_loss_fn",
+    "transformer_train_step",
+    "transformer_param_specs",
     "ResNet",
     "ResNet18",
     "ResNet34",

@@ -1,0 +1,179 @@
+"""Mesh layouts for hybrid dp/tp/pp/sp/ep parallelism.
+
+The reference is a pure data-parallel framework (SURVEY.md §2.7): its
+only notion of topology is rank/local_rank/cross_rank
+(horovod/common/basics.py) and named rank subsets
+(horovod/common/process_set.cc ``ProcessSetTable``).  On TPU the
+idiomatic generalization is a single ``jax.sharding.Mesh`` whose axes
+carry all parallelism dimensions at once, with XLA lowering collectives
+onto the ICI torus per axis.  This module owns the mapping from a flat
+device list to that mesh, and from *logical* parallelism axes
+(dp/tp/pp/sp/ep) to *physical* mesh axes.
+
+Two logical axes may share one physical axis — the standard layouts:
+
+* ``sp`` (sequence/context parallel) defaults to sharing the ``tp``
+  group, as in Megatron-LM sequence parallelism: inside attention the
+  sequence is resharded over the tensor-parallel group (Ulysses
+  all-to-all or ring ppermute), so no extra devices are needed.
+* ``ep`` (expert parallel) defaults to sharing the ``dp`` group, the
+  usual Switch/GShard layout: experts are spread over data-parallel
+  replicas and tokens reach them via all_to_all.
+
+Dedicated ``sp``/``ep`` physical axes are supported when the device
+count allows (pass explicit sizes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+LOGICAL_AXES = ("dp", "pp", "tp", "sp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshLayout:
+    """A physical mesh plus the logical→physical axis mapping.
+
+    ``axis("sp")`` returns the physical mesh-axis name to use in
+    ``PartitionSpec``/collectives for sequence parallelism, which may be
+    ``"tp"`` when sp shares the tensor-parallel group.
+    """
+
+    mesh: Mesh
+    logical_to_physical: Dict[str, str]
+
+    def axis(self, logical: str) -> str:
+        if logical not in self.logical_to_physical:
+            raise KeyError(
+                f"unknown logical axis {logical!r}; have "
+                f"{sorted(self.logical_to_physical)}"
+            )
+        return self.logical_to_physical[logical]
+
+    def axis_size(self, logical: str) -> int:
+        return self.mesh.shape[self.axis(logical)]
+
+    @property
+    def dp(self) -> str:
+        return self.axis("dp")
+
+    @property
+    def tp(self) -> str:
+        return self.axis("tp")
+
+    @property
+    def pp(self) -> str:
+        return self.axis("pp")
+
+    @property
+    def sp(self) -> str:
+        return self.axis("sp")
+
+    @property
+    def ep(self) -> str:
+        return self.axis("ep")
+
+
+def _factor_default(n: int) -> Dict[str, int]:
+    """Balanced default factorization of ``n`` devices into pp×dp×tp.
+
+    Heuristic order of preference mirrors how real TPU jobs are laid
+    out: tp first (rides the fastest ICI links), then pp, then dp soaks
+    up the rest.
+    """
+    tp = 1
+    for cand in (2, 4, 8):
+        if n % cand == 0 and cand <= n:
+            tp = cand
+        else:
+            break
+    tp = min(tp, 4) if n > 4 else tp
+    rem = n // tp
+    pp = 2 if rem % 2 == 0 and rem >= 2 else 1
+    dp = rem // pp
+    return {"pp": pp, "dp": dp, "tp": tp}
+
+
+def make_layout(
+    devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    dp: Optional[int] = None,
+    tp: int = 1,
+    pp: int = 1,
+    sp: Optional[int] = None,
+    ep: Optional[int] = None,
+) -> MeshLayout:
+    """Build a :class:`MeshLayout` over ``devices``.
+
+    ``dp=None`` means "whatever is left" after tp/pp (and dedicated
+    sp/ep, if given).  ``sp``/``ep`` of ``None`` share tp/dp
+    respectively; an explicit integer size allocates a dedicated
+    physical axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+
+    phys_sizes: Dict[str, int] = {}
+    logical_to_physical = {"dp": "dp", "tp": "tp", "pp": "pp"}
+
+    denom = tp * pp
+    if sp is not None:
+        phys_sizes["sp"] = sp
+        logical_to_physical["sp"] = "sp"
+        denom *= sp
+    else:
+        logical_to_physical["sp"] = "tp"
+    if ep is not None:
+        phys_sizes["ep"] = ep
+        logical_to_physical["ep"] = "ep"
+        denom *= ep
+    else:
+        logical_to_physical["ep"] = "dp"
+
+    if dp is None:
+        if n % denom != 0:
+            raise ValueError(
+                f"{n} devices not divisible by tp*pp(*sp*ep)={denom}"
+            )
+        dp = n // denom
+    total = dp * denom
+    if total != n:
+        raise ValueError(
+            f"mesh size {total} (dp={dp} tp={tp} pp={pp} sp={sp} ep={ep})"
+            f" != {n} devices"
+        )
+
+    # Physical axis order: slowest-varying first.  pp stages talk only
+    # to neighbours (cheap over any link); tp is innermost so its
+    # all-reduces ride contiguous ICI; dedicated sp/ep sit between.
+    order: Tuple[str, ...] = ("pp", "dp")
+    shape = [pp, dp]
+    if "ep" in phys_sizes:
+        order = order + ("ep",)
+        shape.append(phys_sizes["ep"])
+    if "sp" in phys_sizes:
+        order = order + ("sp",)
+        shape.append(phys_sizes["sp"])
+    order = order + ("tp",)
+    shape.append(tp)
+
+    dev_array = np.asarray(devices, dtype=object).reshape(shape)
+    mesh = Mesh(dev_array, order)
+    return MeshLayout(mesh=mesh, logical_to_physical=logical_to_physical)
+
+
+def auto_layout(devices: Optional[Sequence[jax.Device]] = None) -> MeshLayout:
+    """Default hybrid layout for ``len(devices)`` chips (pp×dp×tp, with
+    sp sharing tp and ep sharing dp)."""
+    if devices is None:
+        devices = jax.devices()
+    f = _factor_default(len(devices))
+    return make_layout(devices, dp=f["dp"], tp=f["tp"], pp=f["pp"])

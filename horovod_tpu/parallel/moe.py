@@ -1,0 +1,107 @@
+"""Expert parallelism: Switch-style top-1 MoE with all_to_all dispatch.
+
+Out of the reference's scope (SURVEY.md §2.7: EP absent; its
+``hvd.alltoall`` is the primitive EP is built from).  TPU-first
+formulation per GShard/Switch: routing is dense einsum algebra over
+one-hot dispatch/combine tensors (MXU-friendly, static shapes,
+capacity-bounded), and the only communication is a pair of
+``lax.all_to_all``s over the ``ep`` axis — tokens travel to their
+expert's device and back in two ICI hops.
+
+Capacity discipline: each expert accepts at most
+``C = ceil(tokens_per_device * capacity_factor / E)`` tokens from each
+ep peer; overflow tokens fall through the residual connection (standard
+Switch behaviour — keeps every shape static for XLA).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def switch_route(
+    x: jax.Array,
+    gate_w: jax.Array,
+    num_experts: int,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-1 routing: returns (dispatch [N,E,C] bool-ish one-hot,
+    combine [N,E,C] weights, aux load-balancing loss scalar)."""
+    n = x.shape[0]
+    logits = jnp.einsum("nd,de->ne", x.astype(jnp.float32), gate_w)
+    probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
+    expert_idx = jnp.argmax(probs, axis=-1)  # [N]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)
+    # Position of each token within its expert's queue.
+    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot  # [N, E]
+    pos_in_expert = pos.sum(axis=-1).astype(jnp.int32)  # [N]
+    keep = pos_in_expert < capacity
+    dispatch = (
+        onehot
+        * keep[:, None].astype(jnp.float32)
+    )[..., None] * jax.nn.one_hot(
+        pos_in_expert, capacity, dtype=jnp.float32
+    )[:, None, :]  # [N, E, C]
+    combine = dispatch * gate[:, None, None]
+    # Switch aux loss: E * Σ_e fraction_tokens_e · mean_prob_e.
+    frac = onehot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = num_experts * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def expert_parallel_moe(
+    x: jax.Array,
+    gate_w: jax.Array,
+    expert_params: Any,
+    expert_fn: Callable[[Any, jax.Array], jax.Array],
+    axis_name: str,
+    *,
+    num_experts: int,
+    capacity_factor: float = 1.25,
+) -> Tuple[jax.Array, jax.Array]:
+    """Switch-MoE layer over the ``ep`` mesh axis (inside shard_map).
+
+    Args:
+      x: local tokens ``[N, D]`` (flatten batch×seq before calling).
+      gate_w: router weights ``[D, E]`` (replicated).
+      expert_params: pytree stacked ``[E_local, ...]`` — this device's
+        ``E_local = E/ep`` experts' params.
+      expert_fn: ``(params_one_expert, tokens [C', D]) -> [C', D]``.
+      axis_name: the ep mesh axis.
+      num_experts: E, total experts across the ep group.
+
+    Returns:
+      (output ``[N, D]``, aux load-balancing loss scalar).
+    """
+    ep = lax.axis_size(axis_name)
+    if num_experts % ep != 0:
+        raise ValueError(f"E={num_experts} not divisible by ep={ep}")
+    e_local = num_experts // ep
+    n, d = x.shape
+    capacity = max(1, math.ceil(n * capacity_factor / num_experts))
+
+    dispatch, combine, aux = switch_route(x, gate_w, num_experts, capacity)
+    # Gather each expert's token queue: [E, C, D].
+    sent = jnp.einsum("nec,nd->ecd", dispatch, x.astype(jnp.float32))
+    # ep-th of the E dim goes to each peer; received queues stack along
+    # capacity: [E, C, D] -> [E_local, ep*C, D].
+    recv = lax.all_to_all(
+        sent, axis_name, split_axis=0, concat_axis=1, tiled=True
+    )
+    recv = recv.astype(x.dtype)
+    # Run this device's experts over their queues.
+    out = jax.vmap(expert_fn)(expert_params, recv)  # [E_local, ep*C, D]
+    # Return trip + weighted combine back into token order.
+    back = lax.all_to_all(
+        out.astype(jnp.float32), axis_name, split_axis=1, concat_axis=0,
+        tiled=True,
+    )  # [E, C, D]
+    y = jnp.einsum("nec,ecd->nd", combine, back)
+    return y.astype(x.dtype), aux

@@ -1,0 +1,119 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a mesh axis.
+
+Out of the reference's scope (SURVEY.md §2.7: PP absent) but
+first-class here.  SPMD formulation: every pp stage runs the *same*
+compiled program (no per-stage programs, no send/recv runtime); stage
+identity is ``lax.axis_index(pp)``, activations advance one stage per
+schedule tick via ``lax.ppermute`` (neighbour ICI transfer), and the
+tick loop is a ``lax.scan`` — so the whole pipeline, fill and drain
+included, is one XLA computation that autodiff reverses into the
+backward pipeline automatically.
+
+Schedule: classic GPipe.  ``M`` microbatches over ``S`` stages take
+``M + S - 1`` ticks; bubble fraction ``(S-1)/(M+S-1)``.  Stage 0 feeds
+microbatch ``t`` at tick ``t``; the last stage emits microbatch
+``t-(S-1)`` at tick ``t``; a final ``psum`` replicates the collected
+outputs to every stage so loss/backward code is stage-oblivious.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], Any],
+    stage_params: Any,
+    microbatches: jax.Array,
+    axis_name: str,
+    *,
+    with_aux: bool = False,
+):
+    """Run ``stage_fn`` as a GPipe pipeline over ``axis_name``.
+
+    Args:
+      stage_fn: ``(params, x) -> y`` (or ``(params, x) -> (y, aux)``
+        with ``with_aux=True``, ``aux`` a scalar accumulated over all
+        valid (non-bubble) stage executions and psum'd over the pp
+        axis).  ``y`` must have the same shape/dtype as ``x`` (the
+        usual transformer-block invariant).
+      stage_params: THIS stage's parameters (pytree) — i.e. already
+        sharded over the pp axis outside shard_map with the stage dim
+        consumed.
+      microbatches: ``[M, ...]`` input microbatches, replicated over the
+        pp axis (only stage 0 reads them).
+      axis_name: the pp mesh axis.
+
+    Returns:
+      ``[M, ...]`` stage-``S-1`` outputs, replicated to all stages
+      (plus the accumulated aux scalar when ``with_aux``).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    ticks = n_micro + n_stages - 1
+
+    # Scan requires carry input/output types (incl. varying-axis sets)
+    # to match.  Outputs vary over this pp axis (stage masks, ppermute)
+    # plus every axis the microbatches or stage params vary over; build
+    # a zero carrying exactly that union and fold it into the inits.
+    zp = sum(
+        ((leaf * 0).sum().astype(jnp.float32)
+         for leaf in jax.tree_util.tree_leaves(stage_params)),
+        start=jnp.zeros((), jnp.float32),
+    )
+    zero = (
+        zp
+        + (microbatches * 0).sum().astype(jnp.float32)
+        + (lax.axis_index(axis_name) * 0).astype(jnp.float32)
+    )
+    x0 = jnp.zeros_like(microbatches[0]) + zero.astype(microbatches.dtype)
+    out0 = jnp.zeros_like(microbatches) + zero.astype(microbatches.dtype)
+    aux0 = zero
+
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        x_in, outs, aux_acc = carry
+        # Stage 0 sources microbatch t (clamped; masked past M).
+        mb = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False
+        )
+        feed = jnp.where(t < n_micro, mb, jnp.zeros_like(mb))
+        x = jnp.where(stage == 0, feed, x_in)
+        res = stage_fn(stage_params, x)
+        y, aux = res if with_aux else (res, jnp.zeros((), jnp.float32))
+        # Stage s does useful work for microbatch t-s at ticks
+        # s <= t < s + M; bubble executions contribute nothing.
+        useful = jnp.logical_and(t >= stage, t < stage + n_micro)
+        aux_acc = aux_acc + jnp.where(useful, aux, 0.0)
+        # Last stage writes microbatch t-(S-1) once the pipe is full.
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        valid = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+        cur = lax.dynamic_index_in_dim(outs, out_idx, axis=0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(valid, y, cur), out_idx, axis=0
+        )
+        # Advance the pipe: my output becomes stage+1's next input.
+        x_next = lax.ppermute(y, axis_name, fwd_perm)
+        return (x_next, outs, aux_acc), None
+
+    (_, outs, aux_acc), _ = lax.scan(tick, (x0, out0, aux0),
+                                     jnp.arange(ticks))
+    # Replicate the last stage's collected outputs to every stage.
+    outs = lax.psum(
+        jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)),
+        axis_name,
+    )
+    if with_aux:
+        return outs, lax.psum(aux_acc, axis_name)
+    return outs
+
+
+def bubble_fraction(n_micro: int, n_stages: int) -> float:
+    """GPipe bubble overhead for a given schedule size."""
+    return (n_stages - 1) / (n_micro + n_stages - 1)

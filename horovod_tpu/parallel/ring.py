@@ -1,0 +1,120 @@
+"""Ring attention: exact long-context attention over a sequence-sharded
+mesh axis.
+
+The reference has **no** sequence/context parallelism (SURVEY.md §5.7 —
+its nearest primitive is ``hvd.alltoall``).  This module is the
+TPU-first answer to the same scaling problem: each device holds a
+``T/S`` slice of the sequence; K/V blocks rotate around the ``sp`` ring
+via ``lax.ppermute`` (lowered to ICI neighbour transfers) while each
+device folds every block into a numerically-stable online-softmax
+accumulator (the log-sum-exp recurrence of blockwise/flash attention).
+Compute on block ``s`` overlaps the transfer of block ``s+1`` because
+XLA schedules the ppermute asynchronously.
+
+Memory per device is O(T/S · d) for K/V and O((T/S)²) only transiently
+per block-pair — sequence length scales linearly with ring size.
+
+Call inside ``jax.shard_map`` with the sequence dimension sharded over
+``axis_name``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    # q: [B, H, Tq, D]  k: [B, H, Tk, D]  -> [B, H, Tq, Tk]
+    return jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over a sequence sharded along ``axis_name``.
+
+    Args:
+      q, k, v: local shards ``[B, H, T_local, D]`` (sequence dim 2).
+      axis_name: mesh axis the sequence is sharded over (ring).
+      causal: apply a causal mask in *global* sequence positions.
+      scale: score scale; default ``1/sqrt(D)``.
+
+    Returns:
+      Local attention output ``[B, H, T_local, D]``.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    ring_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, t_local, d = q.shape
+
+    q32 = q.astype(jnp.float32)
+
+    q_gpos = my_idx * t_local + jnp.arange(t_local)  # [Tq] global positions
+
+    def fold(carry, s):
+        k_cur, v_cur, m, l, acc = carry
+        # After s forward rotations, we hold the block originally owned
+        # by ring position (my_idx - s) mod S.
+        src = (my_idx - s) % ring_size
+        scores = _block_scores(q32, k_cur.astype(jnp.float32), scale)
+        if causal:
+            k_gpos = src * t_local + jnp.arange(t_local)
+            mask = q_gpos[:, None] >= k_gpos[None, :]  # [Tq, Tk]
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))  # [B, H, Tq]
+        # Guard fully-masked rows: keep m finite so exp() stays 0, not nan.
+        m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+        p = jnp.exp(scores - m_safe[..., None])  # [B, H, Tq, Tk]
+        correction = jnp.exp(m - m_safe)  # [B, H, Tq]
+        l = l * correction + p.sum(axis=-1)
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32)
+        )
+        k_nxt, v_nxt = _rotate(k_cur, v_cur, axis_name, ring_size)
+        return (k_nxt, v_nxt, m_new, l, acc), None
+
+    # Scan requires carry input/output types (incl. varying-axis sets)
+    # to match.  The loop makes every carry vary over this ring axis
+    # (ppermute) and over whatever axes q/k/v already vary over; build
+    # a zero that carries exactly that union and fold it into the inits.
+    zero = (
+        (q32 * 0).sum()
+        + (k.astype(jnp.float32) * 0).sum()
+        + (v.astype(jnp.float32) * 0).sum()
+        + (lax.axis_index(axis_name) * 0).astype(jnp.float32)
+    )
+    k0 = k + zero.astype(k.dtype)
+    v0 = v + zero.astype(v.dtype)
+    m0 = jnp.full((b, h, t_local), _NEG_INF, jnp.float32) + zero
+    l0 = jnp.zeros((b, h, t_local), jnp.float32) + zero
+    acc0 = jnp.zeros((b, h, t_local, d), jnp.float32) + zero
+    (_, _, _, l, acc), _ = _scan_fold(fold, (k0, v0, m0, l0, acc0),
+                                      ring_size)
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def _rotate(k, v, axis_name, ring_size):
+    perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+    return (
+        lax.ppermute(k, axis_name, perm),
+        lax.ppermute(v, axis_name, perm),
+    )
+
+
+def _scan_fold(fold, init, steps):
+    return lax.scan(fold, init, jnp.arange(steps))

@@ -1,0 +1,90 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism via all-to-all.
+
+Absent from the reference (SURVEY.md §5.7), whose ``hvd.alltoall``
+(horovod/common/operations.cc ``EnqueueTensorAlltoall``) is exactly the
+primitive Ulysses is built from — here expressed as ``lax.all_to_all``
+inside shard_map, which XLA lowers to a single ICI all-to-all.
+
+Layout transform: activations arrive sequence-sharded
+``[B, T/S, H, D]``; the first all-to-all reshards to head-sharded
+``[B, T, H/S, D]`` so each device runs *full-sequence* attention over
+its head subset (any kernel — including flash/splash — works
+unchanged); the second all-to-all reshards back.  Exact attention, two
+collectives, no per-block recurrence — the right trade when
+``H >= ring size`` and sequence blocks are small enough to gather.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, T/S, H, D] -> [B, T, H/S, D] (inside shard_map)."""
+    # all_to_all: split the head axis (2) across the group, concat the
+    # sequence axis (1) in peer (= sequence-block) order.
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, T, H/S, D] -> [B, T/S, H, D] (inverse of seq_to_heads)."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def _default_attention(q, k, v, *, causal, scale):
+    # q,k,v: [B, T, h, D] -> [B, T, h, D]; fp32 softmax accumulation.
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    attn_fn: Optional[Callable] = None,
+) -> jax.Array:
+    """Sequence-parallel exact attention via two all-to-alls.
+
+    Args:
+      q, k, v: local shards ``[B, T_local, H, D]`` — note layout
+        (sequence dim 1, heads dim 2), matching transformer activation
+        layout.  ``H`` must be divisible by the axis size.
+      axis_name: mesh axis carrying the sequence shards.
+      attn_fn: optional full-sequence attention kernel
+        ``(q, k, v, causal=..., scale=...) -> out`` with ``[B, T, h, D]``
+        layout; defaults to a fused-softmax reference implementation
+        (swap in a Pallas flash kernel on TPU).
+
+    Returns:
+      Local output ``[B, T_local, H, D]``.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if attn_fn is None:
+        attn_fn = _default_attention
+    s = lax.axis_size(axis_name)
+    if q.shape[2] % s != 0:
+        raise ValueError(
+            f"num heads {q.shape[2]} not divisible by axis {axis_name!r}"
+            f" size {s}"
+        )
+    qh = seq_to_heads(q, axis_name)
+    kh = seq_to_heads(k, axis_name)
+    vh = seq_to_heads(v, axis_name)
+    out = attn_fn(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out, axis_name)

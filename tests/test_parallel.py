@@ -1,0 +1,328 @@
+"""Correctness tests for horovod_tpu.parallel on an 8-device CPU mesh.
+
+Pattern per SURVEY.md §4: SPMD test bodies, localhost-as-cluster (8
+virtual XLA CPU devices).  Every sharded implementation is checked
+against a dense single-device reference to tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu import parallel as par
+
+
+def dense_attention(q, k, v, causal=False):
+    # q,k,v: [B, H, T, D]
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        t = q.shape[2]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def ring_mesh(n=8):
+    return Mesh(np.asarray(jax.devices()[:n], dtype=object), ("sp",))
+
+
+# ---------------------------------------------------------------------------
+# mesh layout
+# ---------------------------------------------------------------------------
+
+class TestMeshLayout:
+    def test_make_layout_shapes(self):
+        lay = par.make_layout(jax.devices(), dp=2, tp=2, pp=2)
+        assert lay.mesh.shape == {"pp": 2, "dp": 2, "tp": 2}
+        assert lay.axis("sp") == "tp"  # sp shares the tp group
+        assert lay.axis("ep") == "dp"  # ep shares the dp group
+        assert lay.axis_size("sp") == 2
+
+    def test_dedicated_sp_axis(self):
+        lay = par.make_layout(jax.devices(), dp=2, tp=2, sp=2)
+        assert lay.axis("sp") == "sp"
+        assert lay.mesh.shape["sp"] == 2
+
+    def test_auto_layout_covers_all_devices(self):
+        lay = par.auto_layout(jax.devices())
+        assert int(np.prod(list(lay.mesh.shape.values()))) == 8
+
+    def test_bad_factorization_raises(self):
+        with pytest.raises(ValueError):
+            par.make_layout(jax.devices(), dp=3, tp=2, pp=2)
+
+
+# ---------------------------------------------------------------------------
+# ring attention
+# ---------------------------------------------------------------------------
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        mesh = ring_mesh()
+        b, h, t, d = 2, 4, 64, 16
+        rng = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (b, h, t, d), jnp.float32)
+        k = jax.random.normal(kk, (b, h, t, d), jnp.float32)
+        v = jax.random.normal(kv, (b, h, t, d), jnp.float32)
+
+        ref = dense_attention(q, k, v, causal=causal)
+
+        def body(q, k, v):
+            return par.ring_attention(q, k, v, "sp", causal=causal)
+
+        out = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(None, None, "sp"), P(None, None, "sp"),
+                          P(None, None, "sp")),
+                out_specs=P(None, None, "sp"),
+            )
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grad_flows(self):
+        mesh = ring_mesh()
+        b, h, t, d = 1, 2, 32, 8
+        q = jax.random.normal(jax.random.PRNGKey(1), (b, h, t, d))
+        k = jax.random.normal(jax.random.PRNGKey(2), (b, h, t, d))
+        v = jax.random.normal(jax.random.PRNGKey(3), (b, h, t, d))
+
+        def loss_sharded(q, k, v):
+            def body(q, k, v):
+                o = par.ring_attention(q, k, v, "sp", causal=True)
+                return lax.psum(jnp.sum(o ** 2), "sp")
+            return jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(None, None, "sp"),) * 3,
+                out_specs=P(),
+            )(q, k, v)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+        g_sharded = jax.jit(jax.grad(loss_sharded))(q, k, v)
+        g_dense = jax.grad(loss_dense)(q, k, v)
+        np.testing.assert_allclose(np.asarray(g_sharded),
+                                   np.asarray(g_dense), rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# ulysses
+# ---------------------------------------------------------------------------
+
+class TestUlysses:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        mesh = ring_mesh()
+        b, t, h, d = 2, 64, 8, 16  # h divisible by sp=8
+        rng = jax.random.split(jax.random.PRNGKey(7), 3)
+        # activation layout [B, T, H, D]
+        q = jax.random.normal(rng[0], (b, t, h, d))
+        k = jax.random.normal(rng[1], (b, t, h, d))
+        v = jax.random.normal(rng[2], (b, t, h, d))
+
+        ref = dense_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=causal,
+        ).transpose(0, 2, 1, 3)
+
+        def body(q, k, v):
+            return par.ulysses_attention(q, k, v, "sp", causal=causal)
+
+        out = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(None, "sp"),) * 3,
+                out_specs=P(None, "sp"),
+            )
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_head_divisibility_check(self):
+        mesh = ring_mesh()
+        q = jnp.ones((1, 8, 4, 4))  # 4 heads, sp=8 → error
+
+        def body(q):
+            return par.ulysses_attention(q, q, q, "sp")
+
+        with pytest.raises(ValueError, match="not divisible"):
+            jax.jit(
+                jax.shard_map(body, mesh=mesh, in_specs=P(None, "sp"),
+                              out_specs=P(None, "sp"))
+            )(q)
+
+
+# ---------------------------------------------------------------------------
+# tensor parallel
+# ---------------------------------------------------------------------------
+
+class TestTensorParallel:
+    def test_column_then_row_matches_dense(self):
+        mesh = Mesh(np.asarray(jax.devices(), dtype=object).reshape(8),
+                    ("tp",))
+        bsz, f_in, f_hidden, f_out = 4, 16, 64, 16
+        rng = jax.random.split(jax.random.PRNGKey(0), 4)
+        x = jax.random.normal(rng[0], (bsz, f_in))
+        w1 = jax.random.normal(rng[1], (f_in, f_hidden)) / np.sqrt(f_in)
+        b1 = jax.random.normal(rng[2], (f_hidden,))
+        w2 = jax.random.normal(rng[3], (f_hidden, f_out)) / np.sqrt(f_hidden)
+
+        ref = jax.nn.gelu(x @ w1 + b1) @ w2
+
+        def body(x, w1, b1, w2):
+            h = jax.nn.gelu(par.column_parallel(x, w1, b1))
+            return par.row_parallel(h, w2, "tp")
+
+        out = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), P(None, "tp"), P("tp"), P("tp", None)),
+                out_specs=P(),
+            )
+        )(x, w1, b1, w2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# pipeline
+# ---------------------------------------------------------------------------
+
+class TestPipeline:
+    def test_matches_sequential(self):
+        n_stages, n_micro = 4, 8
+        mesh = Mesh(np.asarray(jax.devices()[:n_stages], dtype=object),
+                    ("pp",))
+        d = 16
+        rng = jax.random.split(jax.random.PRNGKey(5), n_stages + 1)
+        ws = jnp.stack([
+            jax.random.normal(rng[i], (d, d)) / np.sqrt(d)
+            for i in range(n_stages)
+        ])  # [S, d, d]
+        x = jax.random.normal(rng[-1], (n_micro, 4, d))  # [M, B_mb, d]
+
+        def stage_fn(w, x):
+            return jnp.tanh(x @ w)
+
+        # dense reference: stages applied in order to each microbatch
+        ref = x
+        for i in range(n_stages):
+            ref = stage_fn(ws[i], ref)
+
+        def body(ws_local, mb):
+            w = ws_local[0]  # [1, d, d] shard -> this stage's weights
+            return par.pipeline_apply(stage_fn, w, mb, "pp")
+
+        out = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P("pp"), P()),
+                out_specs=P(),
+            )
+        )(ws, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_matches_sequential(self):
+        n_stages, n_micro, d = 2, 4, 8
+        mesh = Mesh(np.asarray(jax.devices()[:n_stages], dtype=object),
+                    ("pp",))
+        rng = jax.random.split(jax.random.PRNGKey(9), 3)
+        ws = jnp.stack([jax.random.normal(rng[i], (d, d)) / np.sqrt(d)
+                        for i in range(n_stages)])
+        x = jax.random.normal(rng[2], (n_micro, 2, d))
+
+        def stage_fn(w, x):
+            return jnp.tanh(x @ w)
+
+        def loss_pp(ws):
+            def body(ws_local, mb):
+                out = par.pipeline_apply(stage_fn, ws_local[0], mb, "pp")
+                return jnp.sum(out ** 2)
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+            )(ws, x)
+
+        def loss_seq(ws):
+            y = x
+            for i in range(n_stages):
+                y = stage_fn(ws[i], y)
+            return jnp.sum(y ** 2)
+
+        g_pp = jax.jit(jax.grad(loss_pp))(ws)
+        g_seq = jax.grad(loss_seq)(ws)
+        np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_seq),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_bubble_fraction(self):
+        assert par.bubble_fraction(8, 4) == pytest.approx(3 / 11)
+
+
+# ---------------------------------------------------------------------------
+# expert parallel MoE
+# ---------------------------------------------------------------------------
+
+class TestMoE:
+    def test_routing_capacity_and_onehot(self):
+        n, d, e, c = 16, 8, 4, 4
+        x = jax.random.normal(jax.random.PRNGKey(0), (n, d))
+        gw = jax.random.normal(jax.random.PRNGKey(1), (d, e))
+        dispatch, combine, aux = par.switch_route(x, gw, e, c)
+        assert dispatch.shape == (n, e, c)
+        # each token dispatched at most once
+        assert np.all(np.asarray(dispatch.sum(axis=(1, 2))) <= 1.0 + 1e-6)
+        # each (expert, slot) holds at most one token
+        assert np.all(np.asarray(dispatch.sum(axis=0)) <= 1.0 + 1e-6)
+        assert float(aux) > 0
+
+    def test_identity_experts_roundtrip(self):
+        """With identity experts and ample capacity, MoE output ==
+        gate_prob * x for every kept token — verifies the all_to_all
+        dispatch/return plumbing exactly."""
+        ep = 4
+        mesh = Mesh(np.asarray(jax.devices()[:ep], dtype=object), ("ep",))
+        n, d, e = 32, 8, 8
+        x = jax.random.normal(jax.random.PRNGKey(3), (ep * n, d))
+        gw = jax.random.normal(jax.random.PRNGKey(4), (d, e))
+        # identity expert: params are [E_local] dummies
+        params = jnp.zeros((e // ep * ep,))  # placeholder, resharded below
+        params_local = jnp.zeros((e,))
+
+        def expert_fn(p, tokens):
+            del p
+            return tokens
+
+        def body(x_local):
+            out, aux = par.expert_parallel_moe(
+                x_local, gw, jnp.zeros((e // ep,)), expert_fn, "ep",
+                num_experts=e, capacity_factor=4.0,
+            )
+            return out, lax.pmean(aux, "ep")
+
+        out, aux = jax.jit(
+            jax.shard_map(body, mesh=mesh, in_specs=P("ep"),
+                          out_specs=(P("ep"), P()))
+        )(x)
+        # reference: per-shard routing with identity experts
+        outs = []
+        for s in range(ep):
+            xs = x[s * n:(s + 1) * n]
+            cap = max(1, int(np.ceil(n * 4.0 / e)))
+            dispatch, combine, _ = par.switch_route(xs, gw, e, cap)
+            outs.append(np.einsum("nec,ecd->nd",
+                                  np.asarray(combine),
+                                  np.einsum("nec,nd->ecd",
+                                            np.asarray(dispatch),
+                                            np.asarray(xs))))
+        ref = np.concatenate(outs, axis=0)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-5)

@@ -1,0 +1,158 @@
+"""Hybrid-parallel transformer correctness on the 8-device CPU mesh.
+
+Gold test: the full dp×pp×tp(+sp,+ep) sharded training step must match
+a single-device dense execution of the same model to tolerance — loss
+AND gradients — proving every collective (all_gather, psum_scatter,
+psum, ppermute pipeline, all_to_all MoE) and every transpose rule in
+the sharded path is exact.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu import parallel as par
+from horovod_tpu.models import transformer as tfm
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        vocab_size=64,
+        d_model=32,
+        n_heads=4,
+        n_layers=4,
+        d_ff=64,
+        max_seq=32,
+        dtype=jnp.float32,
+        num_microbatches=2,
+    )
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+def single_device_layout():
+    """1-device 'mesh' with every axis size 1 — runs the same code path
+    dense, giving the ground-truth reference."""
+    return par.make_layout(jax.devices()[:1], dp=1, tp=1, pp=1)
+
+
+def tokens_for(cfg, batch):
+    rng = np.random.RandomState(0)
+    return jnp.asarray(
+        rng.randint(0, cfg.vocab_size, size=(batch, 17)), jnp.int32
+    )
+
+
+BATCH = 16  # divisible by dp×microbatches for every layout under test
+
+
+class TestHybridMatchesDense:
+    @pytest.mark.parametrize(
+        "dp,tp,pp", [(2, 2, 2), (8, 1, 1), (1, 4, 2), (2, 4, 1)]
+    )
+    def test_loss_and_grads(self, dp, tp, pp):
+        cfg = tiny_cfg()
+        layout = par.make_layout(jax.devices(), dp=dp, tp=tp, pp=pp)
+        ref_layout = single_device_layout()
+
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        toks = tokens_for(cfg, batch=BATCH)
+
+        loss_sharded = tfm.make_loss_fn(cfg, layout)
+        loss_dense = tfm.make_loss_fn(cfg, ref_layout)
+
+        l_s, g_s = jax.jit(jax.value_and_grad(loss_sharded))(params, toks)
+        l_d, g_d = jax.jit(jax.value_and_grad(loss_dense))(params, toks)
+
+        np.testing.assert_allclose(float(l_s), float(l_d), rtol=1e-5)
+        flat_s = jax.tree_util.tree_leaves_with_path(g_s)
+        flat_d = jax.tree_util.tree_leaves(g_d)
+        for (path, a), b in zip(flat_s, flat_d):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+                err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}"
+                        f" (dp={dp} tp={tp} pp={pp})",
+            )
+
+    def test_ring_mode_matches_megatron_mode(self):
+        """Dedicated-sp ring attention path vs megatron_sp path — same
+        math, different comm schedule."""
+        toks = tokens_for(tiny_cfg(), batch=BATCH)
+        params = tfm.init_params(tiny_cfg(), jax.random.PRNGKey(0))
+
+        cfg_m = tiny_cfg(attn_mode="megatron_sp")
+        lay_m = par.make_layout(jax.devices(), dp=2, tp=2, pp=2)
+        l_m = jax.jit(tfm.make_loss_fn(cfg_m, lay_m))(params, toks)
+
+        cfg_r = tiny_cfg(attn_mode="ring")
+        lay_r = par.make_layout(jax.devices(), dp=2, tp=2, sp=2, pp=1)
+        l_r = jax.jit(tfm.make_loss_fn(cfg_r, lay_r))(params, toks)
+
+        cfg_u = tiny_cfg(attn_mode="ulysses")
+        l_u = jax.jit(tfm.make_loss_fn(cfg_u, lay_r))(params, toks)
+
+        np.testing.assert_allclose(float(l_m), float(l_r), rtol=1e-5)
+        np.testing.assert_allclose(float(l_m), float(l_u), rtol=1e-5)
+
+    def test_moe_runs_and_trains(self):
+        """Switch-MoE over ep (shared with dp): loss finite and
+        decreasing over a few steps on the full hybrid mesh."""
+        cfg = tiny_cfg(n_experts=4, n_layers=2, num_microbatches=2)
+        layout = par.make_layout(jax.devices(), dp=2, tp=2, pp=2)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(1))
+        toks = tokens_for(cfg, batch=BATCH)
+
+        tx = optax.adam(1e-2)
+        step = tfm.make_train_step(cfg, layout, tx)
+        opt_state = tx.init(params)
+
+        losses = []
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, toks)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_moe_grads_match_dense(self):
+        """MoE expert grads across ep==dp sharding vs dense 1-device."""
+        # aux_loss_weight=0: the aux value legitimately differs between
+        # routing-group layouts (per-member vs global groups), so exact
+        # grad comparison is only meaningful for the main loss.
+        cfg = tiny_cfg(n_experts=4, n_layers=2, num_microbatches=1,
+                       capacity_factor=8.0, aux_loss_weight=0.0)
+        layout = par.make_layout(jax.devices(), dp=2, tp=2, pp=2)
+        ref_layout = single_device_layout()
+        params = tfm.init_params(cfg, jax.random.PRNGKey(2))
+        toks = tokens_for(cfg, batch=BATCH)
+
+        g_s = jax.jit(jax.grad(tfm.make_loss_fn(cfg, layout)))(params, toks)
+        g_d = jax.jit(jax.grad(tfm.make_loss_fn(cfg, ref_layout)))(
+            params, toks)
+        flat_s = jax.tree_util.tree_leaves_with_path(g_s)
+        flat_d = jax.tree_util.tree_leaves(g_d)
+        for (path, a), b in zip(flat_s, flat_d):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+                err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}",
+            )
+
+
+class TestTrainStep:
+    def test_loss_decreases_full_hybrid(self):
+        cfg = tiny_cfg()
+        layout = par.make_layout(jax.devices(), dp=2, tp=2, pp=2)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        toks = tokens_for(cfg, batch=BATCH)
+        tx = optax.adam(1e-2)
+        step = tfm.make_train_step(cfg, layout, tx)
+        opt_state = tx.init(params)
+        first = last = None
+        for i in range(8):
+            params, opt_state, loss = step(params, opt_state, toks)
+            if i == 0:
+                first = float(loss)
+            last = float(loss)
+        assert last < first * 0.9, (first, last)
