@@ -210,7 +210,10 @@ class EagerController:
         self._seq = itertools.count(1)
         self._noname: Dict[str, itertools.count] = {}
         self._group_ids = itertools.count(1)
-        self._lock = threading.Lock()
+        # RLock: grouped_enqueue holds it across validate+declare+member
+        # enqueues (which lock individually) so no concurrent enqueue can
+        # slip a colliding name in mid-group.
+        self._lock = threading.RLock()
         self._payloads: Dict[int, _Payload] = {}
         self._by_name: Dict[str, int] = {}
         self._join_futures: List[OpFuture] = []
@@ -340,6 +343,10 @@ class EagerController:
             (names[i] if names else None) or self._auto_name(kind)
             for i in range(len(tensors))
         ]
+        # Hold the (reentrant) lock across check + declare + enqueues so
+        # a concurrent enqueue can't introduce a colliding name after
+        # the check but before a member lands — that would strand a
+        # partially-filled group below its declared quorum forever.
         with self._lock:
             dup = None
             seen = set()
@@ -348,21 +355,22 @@ class EagerController:
                     dup = n
                     break
                 seen.add(n)
-        if dup is not None:
-            futs = []
-            for n in eff_names:
-                f = OpFuture(n)
-                f.set_error(HorovodInternalError(
-                    f"duplicate tensor name in group: {dup!r} "
-                    "(parity: TensorQueue DUPLICATE_NAME_ERROR)"
-                ))
-                futs.append(f)
-            return futs
-        gid = next(self._group_ids)
-        self._ctrl.declare_group(gid, len(tensors))
-        futures = []
-        for t, n in zip(tensors, eff_names):
-            futures.append(self.enqueue(kind, t, name=n, group_id=gid, **kw))
+            if dup is not None:
+                futs = []
+                for n in eff_names:
+                    f = OpFuture(n)
+                    f.set_error(HorovodInternalError(
+                        f"duplicate tensor name in group: {dup!r} "
+                        "(parity: TensorQueue DUPLICATE_NAME_ERROR)"
+                    ))
+                    futs.append(f)
+                return futs
+            gid = next(self._group_ids)
+            self._ctrl.declare_group(gid, len(tensors))
+            futures = [
+                self.enqueue(kind, t, name=n, group_id=gid, **kw)
+                for t, n in zip(tensors, eff_names)
+            ]
         return futures
 
     def register_process_set(self, psid: int, ranks: List[int]):

@@ -346,6 +346,13 @@ def make_loss_fn(cfg: TransformerConfig, layout: MeshLayout):
     specs = param_specs(cfg, layout)
     dp_ax = layout.dp
 
+    if cfg.attn_mode == "megatron_sp" and axes["sp"] != axes["tp"]:
+        raise ValueError(
+            "attn_mode='megatron_sp' requires sp to share the tp group "
+            "(make_layout without a dedicated sp axis); with a dedicated "
+            "sp axis use attn_mode='ring' or 'ulysses'"
+        )
+
     def loss_fn(params, tokens):
         def body(params, tokens):
             loss, aux = forward_local(cfg, params, tokens, axes)

@@ -27,7 +27,6 @@ count allows (pass explicit sizes).
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax

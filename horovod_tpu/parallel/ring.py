@@ -20,7 +20,6 @@ Call inside ``jax.shard_map`` with the sequence dimension sharded over
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

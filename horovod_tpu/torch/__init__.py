@@ -1,0 +1,111 @@
+"""horovod_tpu.torch — source-compatible ``horovod.torch`` frontend.
+
+Parity surface of horovod/torch/__init__.py: lifecycle, topology
+queries, eager collectives on torch tensors (sync, async, in-place,
+grouped), DistributedOptimizer, Compression, broadcast_parameters /
+broadcast_optimizer_state / broadcast_object, SyncBatchNorm, join.
+
+Usage (identical shape to the reference)::
+
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    optimizer = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01),
+        named_parameters=model.named_parameters(),
+        compression=hvd.Compression.fp16)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+"""
+
+from __future__ import annotations
+
+import horovod_tpu as _hvt
+
+# lifecycle + topology (parity: HorovodBasics surface)
+init = _hvt.init
+shutdown = _hvt.shutdown
+is_initialized = _hvt.is_initialized
+rank = _hvt.rank
+size = _hvt.size
+local_rank = _hvt.local_rank
+local_size = _hvt.local_size
+cross_rank = _hvt.cross_rank
+cross_size = _hvt.cross_size
+mpi_enabled = _hvt.mpi_enabled
+mpi_built = _hvt.mpi_built
+mpi_threads_supported = _hvt.mpi_threads_supported
+gloo_enabled = _hvt.gloo_enabled
+gloo_built = _hvt.gloo_built
+nccl_built = _hvt.nccl_built
+ddl_built = _hvt.ddl_built
+ccl_built = _hvt.ccl_built
+cuda_built = _hvt.cuda_built
+rocm_built = _hvt.rocm_built
+xla_built = _hvt.xla_built
+start_timeline = _hvt.start_timeline
+stop_timeline = _hvt.stop_timeline
+
+ProcessSet = _hvt.ProcessSet
+add_process_set = _hvt.add_process_set
+remove_process_set = _hvt.remove_process_set
+HorovodInternalError = _hvt.HorovodInternalError
+HostsUpdatedInterrupt = _hvt.HostsUpdatedInterrupt
+
+from .compression import Compression  # noqa: E402
+from .mpi_ops import (  # noqa: E402
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    alltoall,
+    alltoall_async,
+    barrier,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    grouped_allreduce,
+    grouped_allreduce_,
+    grouped_allreduce_async,
+    join,
+    poll,
+    reducescatter,
+    reducescatter_async,
+    synchronize,
+)
+from .functions import (  # noqa: E402
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from .optimizer import DistributedOptimizer  # noqa: E402
+from .sync_batch_norm import SyncBatchNorm  # noqa: E402
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size",
+    "mpi_enabled", "mpi_built", "mpi_threads_supported", "gloo_enabled",
+    "gloo_built", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
+    "rocm_built", "xla_built",
+    "start_timeline", "stop_timeline",
+    "ProcessSet", "add_process_set", "remove_process_set",
+    "HorovodInternalError", "HostsUpdatedInterrupt",
+    "Compression", "Sum", "Average", "Adasum", "Min", "Max", "Product",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_", "grouped_allreduce_async",
+    "allgather", "allgather_async", "broadcast", "broadcast_",
+    "broadcast_async", "broadcast_async_", "alltoall", "alltoall_async",
+    "reducescatter", "reducescatter_async", "barrier", "join",
+    "synchronize", "poll",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "broadcast_object", "allgather_object",
+    "DistributedOptimizer", "SyncBatchNorm",
+]

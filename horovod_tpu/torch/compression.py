@@ -1,0 +1,69 @@
+"""Gradient compression for the torch frontend (parity:
+horovod/torch/compression.py ``Compression.none`` / ``Compression.fp16``).
+
+Wire compression for the torch eager path casts before the collective
+and casts back after; on TPU the cast itself runs as an XLA fusion once
+the tensor crosses into the engine, so these classes only carry the
+*intent* (wire dtype) — the math lives in horovod_tpu.comm.compression.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    """Interface: compress(tensor) -> (tensor, ctx); decompress(tensor, ctx)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class NoneCompressor(Compressor):
+    pass
+
+
+class FP16Compressor(Compressor):
+    """Cast fp32/fp64 gradients to fp16 on the wire, cast back after."""
+
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        if tensor.dtype in (torch.float32, torch.float64):
+            return tensor.to(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        if ctx is not None:
+            return tensor.to(ctx)
+        return tensor
+
+
+class BF16Compressor(Compressor):
+    """bfloat16 wire format — the TPU-native choice (same exponent range
+    as fp32, so no overflow risk on un-normalized gradient sums)."""
+
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        if tensor.dtype in (torch.float32, torch.float64):
+            return tensor.to(torch.bfloat16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        if ctx is not None:
+            return tensor.to(ctx)
+        return tensor
+
+
+class Compression:
+    """Namespace matching ``hvd.Compression``."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,66 @@
+"""State-fanout helpers for torch models (parity:
+horovod/torch/functions.py ``broadcast_parameters`` /
+``broadcast_optimizer_state`` / ``broadcast_object``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import torch
+
+import horovod_tpu as _hvt
+
+from . import mpi_ops
+
+
+def broadcast_parameters(params, root_rank: int = 0, process_set=None):
+    """Broadcast a ``model.state_dict()`` or ``named_parameters`` from
+    ``root_rank`` in place."""
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    for name, p in items:
+        if p is not None and torch.is_tensor(p):
+            mpi_ops.broadcast_(p, root_rank=root_rank, name=f"bp.{name}",
+                               process_set=process_set)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0,
+                              process_set=None):
+    """Broadcast a torch optimizer's state (exp_avg, momentum buffers,
+    step counters, ...) from ``root_rank``.
+
+    The reference reconstructs missing state by running a zero-grad
+    step first (horovod/torch/functions.py); we do the same so newly
+    initialized workers have state entries to receive into.
+    """
+    if len(optimizer.state) == 0:
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = torch.zeros_like(p)
+        try:
+            optimizer.step()
+        except Exception:
+            pass
+    state = optimizer.state_dict()
+    new_state = broadcast_object(state, root_rank=root_rank,
+                                 process_set=process_set)
+    if _hvt.rank() != root_rank:
+        optimizer.load_state_dict(new_state)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: str = None,
+                     process_set=None) -> Any:
+    """Pickle-broadcast an arbitrary object (parity: hvd.broadcast_object).
+    Torch tensors pickle fine, so this delegates to the engine's
+    size-then-payload wire protocol."""
+    del name
+    return _hvt.broadcast_object(obj, root_rank=root_rank,
+                                 process_set=process_set)
+
+
+def allgather_object(obj: Any, process_set=None):
+    return _hvt.allgather_object(obj, process_set=process_set)

@@ -1,0 +1,287 @@
+"""Torch-tensor collectives over the TPU engine (parity:
+horovod/torch/mpi_ops.py + the C++ binding horovod/torch/mpi_ops_v2.cc).
+
+Where the reference wraps ``at::Tensor`` into ``TorchTensor`` adapters
+and enqueues into the C++ core, here the adapter boundary is
+torch(CPU) ↔ numpy ↔ jax: zero-copy for contiguous CPU tensors in both
+directions (``Tensor.numpy()`` / ``torch.from_numpy``).  Sync ops call
+the engine directly; async ops flow through the eager mini-controller
+(out-of-order enqueue tolerance, fusion, response cache) and return
+integer handles compatible with ``synchronize``/``poll``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import torch
+
+import horovod_tpu as _hvt
+
+from .compression import Compression
+
+# Re-exported reduce ops (parity: hvd.Sum/Average/Adasum/Min/Max/Product)
+Sum = _hvt.Sum
+Average = _hvt.Average
+Adasum = _hvt.Adasum
+Min = _hvt.Min
+Max = _hvt.Max
+Product = _hvt.Product
+
+
+_TORCH_HANDLES = {}  # handle -> (payload for post-processing)
+
+
+def _to_np(tensor: torch.Tensor) -> np.ndarray:
+    t = tensor.detach()
+    if not t.is_contiguous():
+        t = t.contiguous()
+    if t.dtype == torch.bfloat16:
+        # numpy has no bf16; round-trip via fp32 (values preserved).
+        return t.to(torch.float32).numpy()
+    return t.numpy()
+
+
+def _from_np(arr, like: Optional[torch.Tensor] = None) -> torch.Tensor:
+    a = np.ascontiguousarray(arr)
+    if not a.flags.writeable:
+        a = a.copy()  # jax buffers are read-only; torch wants writable
+    out = torch.from_numpy(a)
+    # Restore the caller's dtype: the engine computes in jax's dtype
+    # system (fp64 math runs at fp32 wire precision unless
+    # jax_enable_x64 is set; bf16 round-trips via fp32 since numpy has
+    # no bf16).
+    if like is not None and out.dtype != like.dtype:
+        out = out.to(like.dtype)
+    return out
+
+
+def _engine_compression(compression):
+    """Map torch-side Compression intent onto the engine's wire codec."""
+    from ..comm.compression import Compression as EngineCompression
+
+    if compression in (Compression.fp16,):
+        return EngineCompression.fp16
+    if compression in (Compression.bf16,):
+        return EngineCompression.bf16
+    return EngineCompression.none
+
+
+# ---------------------------------------------------------------------------
+# synchronous ops
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor: torch.Tensor, average=None, name=None,
+              compression=Compression.none, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set=None) -> torch.Tensor:
+    """Averaged (by default) allreduce returning a NEW tensor (parity:
+    hvd.allreduce in horovod/torch/mpi_ops.py)."""
+    out = _hvt.allreduce(
+        _to_np(tensor), op=op, average=average,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        compression=_engine_compression(compression),
+        process_set=process_set, name=name,
+    )
+    return _from_np(np.asarray(out), like=tensor).reshape(tensor.shape)
+
+
+def allreduce_(tensor: torch.Tensor, average=None, name=None,
+               compression=Compression.none, op=None,
+               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+               process_set=None) -> torch.Tensor:
+    """In-place allreduce (parity: hvd.allreduce_)."""
+    result = allreduce(
+        tensor, average=average, name=name, compression=compression, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set,
+    )
+    tensor.data.copy_(result)
+    return tensor
+
+
+def grouped_allreduce(tensors: List[torch.Tensor], average=None, name=None,
+                      compression=Compression.none, op=None,
+                      process_set=None) -> List[torch.Tensor]:
+    outs = _hvt.grouped_allreduce(
+        [_to_np(t) for t in tensors], op=op, average=average,
+        compression=_engine_compression(compression),
+        process_set=process_set,
+    )
+    return [
+        _from_np(np.asarray(o), like=t).reshape(t.shape)
+        for o, t in zip(outs, tensors)
+    ]
+
+
+def grouped_allreduce_(tensors: List[torch.Tensor], **kw) -> List[torch.Tensor]:
+    outs = grouped_allreduce(tensors, **kw)
+    for t, o in zip(tensors, outs):
+        t.data.copy_(o)
+    return tensors
+
+
+def allgather(tensor: torch.Tensor, name=None, process_set=None
+              ) -> torch.Tensor:
+    """Concatenate along dim 0 across ranks (ragged dim-0 supported;
+    parity: hvd.allgather / allgather size negotiation)."""
+    out = _hvt.allgather(_to_np(tensor), process_set=process_set, name=name)
+    return _from_np(np.asarray(out), like=tensor)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int = 0, name=None,
+              process_set=None) -> torch.Tensor:
+    out = _hvt.broadcast(_to_np(tensor), root_rank=root_rank,
+                         process_set=process_set, name=name)
+    return _from_np(np.asarray(out), like=tensor).reshape(tensor.shape)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int = 0, name=None,
+               process_set=None) -> torch.Tensor:
+    tensor.data.copy_(broadcast(tensor, root_rank, name, process_set))
+    return tensor
+
+
+def alltoall(tensor: torch.Tensor, splits: Optional[torch.Tensor] = None,
+             name=None, process_set=None):
+    """Scatter dim-0 slices to every rank, gather received (parity:
+    hvd.alltoall; returns (output, received_splits) like the reference
+    when splits is given)."""
+    splits_np = None if splits is None else _to_np(splits)
+    out = _hvt.alltoall(_to_np(tensor), splits_np, process_set=process_set,
+                        name=name)
+    if isinstance(out, tuple):
+        data, rsplits = out
+        return (_from_np(np.asarray(data), like=tensor),
+                torch.as_tensor(np.asarray(rsplits)))
+    return _from_np(np.asarray(out), like=tensor)
+
+
+def reducescatter(tensor: torch.Tensor, op=None, name=None,
+                  process_set=None) -> torch.Tensor:
+    out = _hvt.reducescatter(_to_np(tensor), op=op, process_set=process_set,
+                             name=name)
+    return _from_np(np.asarray(out), like=tensor)
+
+
+def barrier(process_set=None):
+    _hvt.barrier(process_set=process_set)
+
+
+# ---------------------------------------------------------------------------
+# async ops + handle management
+# ---------------------------------------------------------------------------
+
+def allreduce_async(tensor: torch.Tensor, average=None, name=None,
+                    op=None, compression=Compression.none,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    process_set=None) -> int:
+    handle = _hvt.allreduce_async(
+        _to_np(tensor), op=op, average=average, name=name,
+        compression=_engine_compression(compression),
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set,
+    )
+    _TORCH_HANDLES[handle] = ("new", tensor)
+    return handle
+
+
+def allreduce_async_(tensor: torch.Tensor, average=None, name=None,
+                     op=None, compression=Compression.none,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0,
+                     process_set=None) -> int:
+    """Async in-place allreduce: result lands in ``tensor`` at
+    synchronize (parity: hvd.allreduce_async_)."""
+    handle = _hvt.allreduce_async(
+        _to_np(tensor), op=op, average=average, name=name,
+        compression=_engine_compression(compression),
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set,
+    )
+    _TORCH_HANDLES[handle] = ("inplace", tensor)
+    return handle
+
+
+def grouped_allreduce_async(tensors: List[torch.Tensor], average=None,
+                            names=None, op=None,
+                            compression=Compression.none,
+                            process_set=None) -> List[int]:
+    handles = _hvt.grouped_allreduce_async(
+        [_to_np(t) for t in tensors], op=op, average=average, names=names,
+        compression=_engine_compression(compression),
+        process_set=process_set,
+    )
+    for h, t in zip(handles, tensors):
+        _TORCH_HANDLES[h] = ("new", t)
+    return handles
+
+
+def allgather_async(tensor: torch.Tensor, name=None, process_set=None) -> int:
+    handle = _hvt.allgather_async(_to_np(tensor), name=name,
+                                  process_set=process_set)
+    _TORCH_HANDLES[handle] = ("gather", tensor)
+    return handle
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int = 0, name=None,
+                    process_set=None) -> int:
+    handle = _hvt.broadcast_async(_to_np(tensor), root_rank=root_rank,
+                                  name=name, process_set=process_set)
+    _TORCH_HANDLES[handle] = ("new", tensor)
+    return handle
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int = 0, name=None,
+                     process_set=None) -> int:
+    handle = _hvt.broadcast_async(_to_np(tensor), root_rank=root_rank,
+                                  name=name, process_set=process_set)
+    _TORCH_HANDLES[handle] = ("inplace", tensor)
+    return handle
+
+
+def alltoall_async(tensor: torch.Tensor, splits=None, name=None,
+                   process_set=None) -> int:
+    splits_np = None if splits is None else _to_np(splits)
+    handle = _hvt.alltoall_async(_to_np(tensor), splits_np, name=name,
+                                 process_set=process_set)
+    _TORCH_HANDLES[handle] = ("gather", tensor)
+    return handle
+
+
+def reducescatter_async(tensor: torch.Tensor, op=None, name=None,
+                        process_set=None) -> int:
+    handle = _hvt.reducescatter_async(_to_np(tensor), op=op, name=name,
+                                      process_set=process_set)
+    _TORCH_HANDLES[handle] = ("gather", tensor)
+    return handle
+
+
+def synchronize(handle: int):
+    """Wait for an async op; returns the torch result (and applies the
+    in-place semantics for *_async_ variants)."""
+    mode, ref = _TORCH_HANDLES.pop(handle, ("new", None))
+    out = _hvt.synchronize(handle)
+    if isinstance(out, tuple):  # alltoall with splits
+        data, rsplits = out
+        return (_from_np(np.asarray(data), like=ref),
+                torch.as_tensor(np.asarray(rsplits)))
+    if out is None:  # barrier-like
+        return None
+    result = _from_np(np.asarray(out), like=ref)
+    if mode == "inplace" and ref is not None:
+        ref.data.copy_(result.reshape(ref.shape))
+        return ref
+    if mode == "new" and ref is not None:
+        return result.reshape(ref.shape)
+    return result
+
+
+def poll(handle: int) -> bool:
+    return _hvt.poll(handle)
+
+
+def join(device=None) -> int:
+    return _hvt.join(device)

@@ -1,0 +1,204 @@
+"""Torch DistributedOptimizer (parity: horovod/torch/optimizer.py
+``_DistributedOptimizer`` / ``DistributedOptimizer``).
+
+Same contract as the reference: wrap any ``torch.optim.Optimizer``;
+per-parameter hooks fire as autograd accumulates each grad and launch
+an async (optionally compressed) allreduce through the eager
+mini-controller — so communication of early layers overlaps backward of
+later layers exactly like the reference's background thread; ``step()``
+synchronizes all handles, writes averaged grads back, then runs the
+wrapped optimizer's math locally.
+
+Supports ``backward_passes_per_step`` local aggregation,
+``op=Average/Sum/Adasum``, ``gradient_predivide_factor``, process sets,
+and ``skip_synchronize()``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import torch
+
+import horovod_tpu as _hvt
+
+from . import mpi_ops
+from .compression import Compression
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    def __init__(self, params, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1,
+                 op=None, gradient_predivide_factor: float = 1.0,
+                 process_set=None):
+        super(self.__class__, self).__init__(params)
+        op = mpi_ops.Average if op is None else op
+        if gradient_predivide_factor != 1.0 and op != mpi_ops.Average:
+            raise ValueError(
+                "gradient_predivide_factor requires op=Average"
+            )
+        self._compression = compression
+        self._op = op
+        self._process_set = process_set
+        self._predivide = gradient_predivide_factor
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = []
+        name_of = {id(p): n for n, p in named}
+
+        self._parameter_names = {}
+        self._handles = {}
+        self._grad_accs = []
+        self._requires_update = []
+        self._synchronized = False
+        self._should_synchronize = True
+        self._passes = {}
+
+        idx = 0
+        for group in self.param_groups:
+            for p in group["params"]:
+                if not p.requires_grad:
+                    continue
+                self._parameter_names[p] = name_of.get(
+                    id(p), f"allreduce.noname.{idx}"
+                )
+                idx += 1
+                self._requires_update.append(p)
+                self._passes[p] = 0
+                self._register_hook(p)
+
+    # -- hook plumbing ----------------------------------------------------
+    def _register_hook(self, p: torch.nn.Parameter):
+        if hasattr(p, "register_post_accumulate_grad_hook"):
+            p.register_post_accumulate_grad_hook(self._make_post_hook(p))
+        else:  # pragma: no cover - old torch
+            # Reference trick: hook the grad accumulator node
+            # (horovod/torch/optimizer.py _register_hooks).
+            tmp = p.expand_as(p)
+            grad_acc = tmp.grad_fn.next_functions[0][0]
+            grad_acc.register_hook(self._make_acc_hook(p))
+            self._grad_accs.append(grad_acc)
+
+    def _make_post_hook(self, p):
+        def hook(param):
+            self._on_grad_ready(p)
+        return hook
+
+    def _make_acc_hook(self, p):  # pragma: no cover - old torch
+        def hook(*ignore):
+            self._on_grad_ready(p)
+        return hook
+
+    def _on_grad_ready(self, p):
+        if self._handles.get(p) is not None:
+            raise AssertionError(
+                "Gradients were computed more than "
+                "backward_passes_per_step times before call to step(). "
+                "Increase backward_passes_per_step to accumulate more."
+            )
+        self._passes[p] += 1
+        if self._passes[p] == self.backward_passes_per_step:
+            self._handles[p] = self._allreduce_grad_async(p)
+
+    def _allreduce_grad_async(self, p) -> int:
+        name = self._parameter_names[p]
+        grad = p.grad
+        if self._predivide != 1.0:
+            prescale = 1.0 / self._predivide
+            postscale = self._predivide / _hvt.size()
+            op = mpi_ops.Sum
+        else:
+            prescale, postscale, op = 1.0, 1.0, self._op
+        return mpi_ops.allreduce_async_(
+            grad, name=f"allreduce.{name}", op=op,
+            compression=self._compression,
+            prescale_factor=prescale, postscale_factor=postscale,
+            process_set=self._process_set,
+        )
+
+    # -- public contract --------------------------------------------------
+    def set_backward_passes_per_step(self, passes: int):
+        self.backward_passes_per_step = passes
+        for p in self._passes:
+            self._passes[p] = 0
+
+    def synchronize(self):
+        """Wait for all outstanding grad allreduces; grads are updated
+        in place (the *_async_ in-place contract)."""
+        for p in self._requires_update:
+            handle = self._handles.get(p)
+            if handle is None:
+                # Hook never fired (conditionally-unused param, or a
+                # partial accumulation when step() arrives early).  The
+                # reference allreduces EVERY registered param here
+                # (optimizer.py synchronize's missing_p loop) — ranks
+                # that didn't touch the param contribute zeros; skipping
+                # instead would desync the collective schedule and hang
+                # the other ranks.
+                if p.grad is None:
+                    p.grad = torch.zeros_like(p)
+                handle = self._allreduce_grad_async(p)
+                self._handles[p] = handle
+            mpi_ops.synchronize(handle)
+        self._handles.clear()
+        for p in self._passes:
+            self._passes[p] = 0
+        self._synchronized = True
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """Run step() without synchronizing (caller already did; parity:
+        optimizer.skip_synchronize() in the reference)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                import warnings
+
+                warnings.warn(
+                    "optimizer.step() called without a preceding "
+                    "backward; called synchronize() twice"
+                )
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, set_to_none: bool = True):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() "
+                "but before optimizer.step() or optimizer.synchronize(). "
+                "This is prohibited as it can cause a race condition."
+            )
+        return super(self.__class__, self).zero_grad(set_to_none)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op=None,
+                         gradient_predivide_factor: float = 1.0,
+                         process_set=None) -> torch.optim.Optimizer:
+    """Wrap ``optimizer`` for data-parallel training (parity:
+    hvd.DistributedOptimizer for torch).
+
+    Dynamically subclasses the optimizer's own class (same trick as
+    horovod/torch/optimizer.py) so isinstance checks and hyperparameter
+    access keep working.
+    """
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step, op, gradient_predivide_factor,
+               process_set)

@@ -1,0 +1,255 @@
+"""Torch frontend tests (parity model: test/parallel/test_torch.py in
+the reference, §4 of SURVEY.md — op × dtype matrix, in-place semantics,
+optimizer behavior).
+
+This sandbox is one process, so collectives degenerate to
+identity/size-1 semantics; the multi-rank data path is exercised by the
+engine's own tests and by runner integration tests.  What IS fully
+tested here: the torch↔engine adapter boundary (dtype/shape/layout
+round-trips, in-place contracts, handle lifecycle) and the
+DistributedOptimizer's hook/synchronize machinery, which is identical
+code at any world size.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import horovod_tpu.torch as hvd
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init()
+    yield
+
+
+DTYPES = [torch.float32, torch.float64, torch.int32, torch.int64,
+          torch.float16, torch.bfloat16]
+
+
+class TestOps:
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_allreduce_roundtrip(self, dtype):
+        t = torch.arange(17).reshape(17).to(dtype)
+        out = hvd.allreduce(t, name=f"ar.{dtype}")
+        assert out.dtype == dtype
+        assert out.shape == t.shape
+        torch.testing.assert_close(out, t)
+
+    def test_allreduce_noncontiguous(self):
+        t = torch.arange(12.0).reshape(3, 4).t()  # non-contiguous view
+        out = hvd.allreduce(t, name="ar.nc")
+        torch.testing.assert_close(out, t)
+
+    def test_allreduce_inplace(self):
+        t = torch.ones(5)
+        r = hvd.allreduce_(t, name="ar.ip")
+        assert r is t
+        torch.testing.assert_close(t, torch.ones(5))
+
+    def test_allreduce_prescale(self):
+        t = torch.ones(4)
+        out = hvd.allreduce(t, prescale_factor=2.0, name="ar.pre")
+        torch.testing.assert_close(out, 2 * torch.ones(4))
+
+    def test_allreduce_compression_fp16(self):
+        t = torch.full((8,), 0.5)
+        out = hvd.allreduce(t, compression=hvd.Compression.fp16,
+                            name="ar.fp16")
+        assert out.dtype == torch.float32
+        torch.testing.assert_close(out, t)
+
+    def test_grouped_allreduce(self):
+        ts = [torch.ones(3), torch.arange(4.0)]
+        outs = hvd.grouped_allreduce(ts, name="gar")
+        for o, t in zip(outs, ts):
+            torch.testing.assert_close(o, t)
+
+    def test_allgather(self):
+        t = torch.arange(6.0).reshape(2, 3)
+        out = hvd.allgather(t)
+        assert out.shape == (2 * hvd.size(), 3)
+
+    def test_broadcast_inplace(self):
+        t = torch.randn(4, 4)
+        want = t.clone()
+        r = hvd.broadcast_(t, root_rank=0)
+        assert r is t
+        torch.testing.assert_close(t, want)
+
+    def test_alltoall(self):
+        t = torch.arange(8.0)
+        out = hvd.alltoall(t)
+        torch.testing.assert_close(out, t)
+
+    def test_alltoall_with_splits(self):
+        t = torch.arange(6.0)
+        out, rsplits = hvd.alltoall(t, splits=torch.tensor([6]))
+        torch.testing.assert_close(out, t)
+        assert int(rsplits.sum()) == 6
+
+    def test_reducescatter(self):
+        t = torch.arange(8.0)
+        out = hvd.reducescatter(t)
+        assert out.numel() == 8 // hvd.size()
+
+    def test_async_handle_lifecycle(self):
+        t = torch.ones(4)
+        h = hvd.allreduce_async(t, name="as.1")
+        out = hvd.synchronize(h)
+        torch.testing.assert_close(out, t)
+
+    def test_async_inplace(self):
+        t = torch.full((3,), 2.0)
+        h = hvd.allreduce_async_(t, name="as.2")
+        r = hvd.synchronize(h)
+        assert r is t
+        torch.testing.assert_close(t, torch.full((3,), 2.0))
+
+    def test_broadcast_object(self):
+        obj = {"a": torch.ones(2), "b": [1, 2, 3]}
+        out = hvd.broadcast_object(obj, root_rank=0)
+        torch.testing.assert_close(out["a"], obj["a"])
+        assert out["b"] == obj["b"]
+
+    def test_broadcast_parameters(self):
+        model = torch.nn.Linear(4, 2)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+
+class TestDistributedOptimizer:
+    def _model_and_data(self):
+        torch.manual_seed(0)
+        model = torch.nn.Sequential(
+            torch.nn.Linear(8, 16), torch.nn.ReLU(), torch.nn.Linear(16, 4)
+        )
+        x = torch.randn(32, 8)
+        y = torch.randint(0, 4, (32,))
+        return model, x, y
+
+    def test_trains(self):
+        model, x, y = self._model_and_data()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9),
+            named_parameters=model.named_parameters(),
+        )
+        losses = []
+        for _ in range(20):
+            opt.zero_grad()
+            loss = torch.nn.functional.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.9
+
+    def test_matches_plain_sgd_size1(self):
+        """At size 1, DistributedOptimizer must be numerically identical
+        to the wrapped optimizer."""
+        model1, x, y = self._model_and_data()
+        model2 = torch.nn.Sequential(
+            torch.nn.Linear(8, 16), torch.nn.ReLU(), torch.nn.Linear(16, 4)
+        )
+        model2.load_state_dict(model1.state_dict())
+
+        opt1 = hvd.DistributedOptimizer(
+            torch.optim.SGD(model1.parameters(), lr=0.1, momentum=0.9),
+            named_parameters=model1.named_parameters(),
+        )
+        opt2 = torch.optim.SGD(model2.parameters(), lr=0.1, momentum=0.9)
+        for _ in range(3):
+            for opt, model in ((opt1, model1), (opt2, model2)):
+                opt.zero_grad()
+                torch.nn.functional.cross_entropy(model(x), y).backward()
+                opt.step()
+        for p1, p2 in zip(model1.parameters(), model2.parameters()):
+            torch.testing.assert_close(p1, p2)
+
+    def test_backward_passes_per_step(self):
+        model, x, y = self._model_and_data()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters(),
+            backward_passes_per_step=2,
+        )
+        opt.zero_grad()
+        torch.nn.functional.cross_entropy(model(x[:16]), y[:16]).backward()
+        torch.nn.functional.cross_entropy(model(x[16:]), y[16:]).backward()
+        opt.step()  # accumulated 2 passes, then stepped
+
+    def test_too_many_passes_raises(self):
+        model, x, y = self._model_and_data()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters(),
+        )
+        opt.zero_grad()
+        torch.nn.functional.cross_entropy(model(x), y).backward()
+        with pytest.raises(AssertionError, match="more than"):
+            torch.nn.functional.cross_entropy(model(x), y).backward()
+
+    def test_zero_grad_mid_cycle_raises(self):
+        model, x, y = self._model_and_data()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters(),
+        )
+        opt.zero_grad()
+        torch.nn.functional.cross_entropy(model(x), y).backward()
+        with pytest.raises(AssertionError, match="zero_grad"):
+            opt.zero_grad()
+        opt.synchronize()  # clean up
+
+    def test_predivide_requires_average(self):
+        model, _, _ = self._model_and_data()
+        with pytest.raises(ValueError, match="predivide"):
+            hvd.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=model.named_parameters(),
+                op=hvd.Sum, gradient_predivide_factor=2.0,
+            )
+
+    def test_skip_synchronize(self):
+        model, x, y = self._model_and_data()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+        )
+        opt.zero_grad()
+        torch.nn.functional.cross_entropy(model(x), y).backward()
+        opt.synchronize()
+        with opt.skip_synchronize():
+            opt.step()
+
+    def test_isinstance_preserved(self):
+        model, _, _ = self._model_and_data()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+        )
+        assert isinstance(opt, torch.optim.SGD)
+
+
+class TestSyncBatchNorm:
+    def test_matches_batchnorm_size1(self):
+        torch.manual_seed(1)
+        x = torch.randn(8, 3, 4, 4)
+        bn = torch.nn.BatchNorm2d(3)
+        sbn = hvd.SyncBatchNorm(3)
+        sbn.load_state_dict(bn.state_dict())
+        bn.train(), sbn.train()
+        torch.testing.assert_close(sbn(x), bn(x))
+
+    def test_eval_mode(self):
+        sbn = hvd.SyncBatchNorm(3)
+        sbn.eval()
+        x = torch.randn(2, 3, 4)
+        assert sbn(x).shape == x.shape
+
+    def test_grad_flows(self):
+        sbn = hvd.SyncBatchNorm(4)
+        sbn.train()
+        x = torch.randn(6, 4, requires_grad=True)
+        sbn(x).sum().backward()
+        assert x.grad is not None
+        assert sbn.weight.grad is not None
