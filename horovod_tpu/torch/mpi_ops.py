@@ -12,6 +12,7 @@ integer handles compatible with ``synchronize``/``poll``.
 
 from __future__ import annotations
 
+import warnings
 from typing import List, Optional
 
 import numpy as np
@@ -33,6 +34,9 @@ Product = _hvt.Product
 _TORCH_HANDLES = {}  # handle -> (payload for post-processing)
 
 
+_warned_fp64 = False
+
+
 def _to_np(tensor: torch.Tensor) -> np.ndarray:
     t = tensor.detach()
     if not t.is_contiguous():
@@ -40,6 +44,18 @@ def _to_np(tensor: torch.Tensor) -> np.ndarray:
     if t.dtype == torch.bfloat16:
         # numpy has no bf16; round-trip via fp32 (values preserved).
         return t.to(torch.float32).numpy()
+    if t.dtype == torch.float64:
+        import jax
+        global _warned_fp64
+        if not jax.config.jax_enable_x64 and not _warned_fp64:
+            _warned_fp64 = True
+            warnings.warn(
+                "float64 tensor reduced without jax_enable_x64: the "
+                "collective runs at float32 wire precision and the result "
+                "is cast back to float64.  Set jax.config.update("
+                "'jax_enable_x64', True) for true-fp64 collectives.",
+                UserWarning, stacklevel=3,
+            )
     return t.numpy()
 
 

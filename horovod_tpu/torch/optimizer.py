@@ -110,7 +110,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         grad = p.grad
         if self._predivide != 1.0:
             prescale = 1.0 / self._predivide
-            postscale = self._predivide / _hvt.size()
+            # Average over the ranks that actually participate: the
+            # process set's size when one is supplied, else the world.
+            n = (self._process_set.size if self._process_set is not None
+                 else _hvt.size())
+            postscale = self._predivide / n
             op = mpi_ops.Sum
         else:
             prescale, postscale, op = 1.0, 1.0, self._op

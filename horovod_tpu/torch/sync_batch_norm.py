@@ -113,9 +113,13 @@ class _SyncBatchNormFn(torch.autograd.Function):
 
         # grads of weight/bias are LOCAL sums; autograd-level DP
         # averaging (DistributedOptimizer) handles their reduction like
-        # any other parameter grad.
-        grad_weight = sum_dy_xhat if weight is not None else None
-        grad_bias = sum_dy
+        # any other parameter grad.  With affine=False the forward's
+        # weight/bias inputs are None (not Variables), so autograd
+        # requires None gradients at those positions.
+        grad_weight = (sum_dy_xhat
+                       if weight is not None and ctx.needs_input_grad[1]
+                       else None)
+        grad_bias = sum_dy if ctx.needs_input_grad[2] else None
 
         packed = torch.cat([sum_dy, sum_dy_xhat])
         packed = mpi_ops.allreduce(packed, op=mpi_ops.Sum,

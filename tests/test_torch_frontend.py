@@ -37,6 +37,23 @@ class TestOps:
         assert out.shape == t.shape
         torch.testing.assert_close(out, t)
 
+    def test_fp64_precision_warning(self):
+        import warnings as _w
+        import horovod_tpu.torch.mpi_ops as mo
+        import jax
+        if jax.config.jax_enable_x64:
+            pytest.skip("x64 enabled: no precision loss to warn about")
+        mo._warned_fp64 = False
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            hvd.allreduce(torch.ones(4, dtype=torch.float64), name="w64")
+        assert any("float64" in str(r.message) for r in rec)
+        # warn-once contract
+        with _w.catch_warnings(record=True) as rec2:
+            _w.simplefilter("always")
+            hvd.allreduce(torch.ones(4, dtype=torch.float64), name="w64b")
+        assert not any("float64" in str(r.message) for r in rec2)
+
     def test_allreduce_noncontiguous(self):
         t = torch.arange(12.0).reshape(3, 4).t()  # non-contiguous view
         out = hvd.allreduce(t, name="ar.nc")
@@ -209,6 +226,34 @@ class TestDistributedOptimizer:
                 op=hvd.Sum, gradient_predivide_factor=2.0,
             )
 
+    def test_predivide_postscale_uses_process_set_size(self, monkeypatch):
+        # Average emulation must divide by the participating-rank count
+        # (the process set's size), not the world size.
+        model, _, _ = self._model_and_data()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            gradient_predivide_factor=2.0,
+        )
+
+        from horovod_tpu.core.process_set import ProcessSet
+        ps = ProcessSet([0, 1])
+        opt._process_set = ps
+
+        import horovod_tpu.torch.optimizer as opt_mod
+        monkeypatch.setattr(opt_mod._hvt, "size", lambda: 8)
+        seen = {}
+
+        def fake_async(grad, name, op, compression, prescale_factor,
+                       postscale_factor, process_set):
+            seen["post"] = postscale_factor
+            return 0
+        monkeypatch.setattr(opt_mod.mpi_ops, "allreduce_async_", fake_async)
+        p = opt._requires_update[0]
+        p.grad = torch.zeros_like(p)
+        opt._allreduce_grad_async(p)
+        assert seen["post"] == pytest.approx(2.0 / 2)
+
     def test_skip_synchronize(self):
         model, x, y = self._model_and_data()
         opt = hvd.DistributedOptimizer(
@@ -253,3 +298,20 @@ class TestSyncBatchNorm:
         sbn(x).sum().backward()
         assert x.grad is not None
         assert sbn.weight.grad is not None
+
+    def test_affine_false_backward(self):
+        # affine=False: forward's weight/bias are None — backward must
+        # return None grads at those slots or autograd raises.
+        from horovod_tpu.torch.sync_batch_norm import _SyncBatchNormFn
+        x = torch.randn(6, 4, requires_grad=True)
+        out = _SyncBatchNormFn.apply(
+            x, None, None, None, None, 1e-5, 0.1, None)
+        out.sum().backward()
+        assert x.grad is not None
+
+    def test_affine_false_module(self):
+        sbn = hvd.SyncBatchNorm(4, affine=False)
+        sbn.train()
+        x = torch.randn(6, 4, requires_grad=True)
+        sbn(x).sum().backward()
+        assert x.grad is not None
