@@ -1,0 +1,101 @@
+"""Data-parallel MNIST-style training with the pure-JAX API.
+
+The horovod_tpu analog of the reference's examples/pytorch/pytorch_mnist.py
+training flow: init -> shard data by rank -> broadcast initial params ->
+allreduce-averaged gradients each step.  Uses a synthetic MNIST-shaped
+dataset so it runs hermetically (no downloads) on CPU or TPU.
+
+Run:  hvtpurun -np 2 --cpu-devices 1 python examples/train_mnist.py
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvt
+
+
+def make_synthetic_mnist(n, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 784).astype(np.float32)
+    w_true = rng.randn(784, 10).astype(np.float32)
+    y = (x @ w_true).argmax(axis=1)
+    return x, y
+
+
+def init_params(key):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (784, 128)) * 0.05,
+        "b1": jnp.zeros((128,)),
+        "w2": jax.random.normal(k2, (128, 10)) * 0.05,
+        "b2": jnp.zeros((10,)),
+    }
+
+
+def loss_fn(params, x, y):
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    logits = h @ params["w2"] + params["b2"]
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(logp[jnp.arange(x.shape[0]), y])
+
+
+@jax.jit
+def grad_step(params, x, y):
+    return jax.value_and_grad(loss_fn)(params, x, y)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.5)
+    p.add_argument("--train-size", type=int, default=2048)
+    args = p.parse_args()
+
+    hvt.init()
+    rank, size = hvt.rank(), hvt.size()
+
+    # Per-rank shard of the data (DistributedSampler analog).
+    x, y = make_synthetic_mnist(args.train_size, seed=0)
+    shard = slice(rank * len(x) // size, (rank + 1) * len(x) // size)
+    x, y = x[shard], y[shard]
+
+    # Different seeds then broadcast -> verifies param sync visibly.
+    params = init_params(jax.random.PRNGKey(rank))
+    params = hvt.broadcast_object(params, root_rank=0)
+
+    steps = 0
+    for epoch in range(args.epochs):
+        perm = np.random.RandomState(epoch).permutation(len(x))
+        for i in range(0, len(x) - args.batch_size + 1, args.batch_size):
+            bx = jnp.asarray(x[perm[i:i + args.batch_size]])
+            by = jnp.asarray(y[perm[i:i + args.batch_size]])
+            loss, grads = grad_step(params, bx, by)
+            flat = hvt.grouped_allreduce(
+                jax.tree.leaves(grads), op=hvt.Average
+            )
+            grads = jax.tree.unflatten(jax.tree.structure(grads), flat)
+            params = jax.tree.map(
+                lambda p, g: p - args.lr * g, params, grads
+            )
+            steps += 1
+        if rank == 0:
+            print(f"epoch {epoch}: loss={float(loss):.4f}", flush=True)
+
+    # All ranks must end bit-identical (averaged grads from identical
+    # start): verify through an allgather of a param checksum.
+    csum = jnp.asarray([float(jax.tree.reduce(
+        lambda a, b: a + jnp.sum(b).astype(jnp.float64), params, 0.0
+    ))])
+    all_csums = np.asarray(hvt.allgather(csum))
+    assert np.allclose(all_csums, all_csums[0]), all_csums
+    if rank == 0:
+        print(f"final loss {float(loss):.4f}; ranks consistent "
+              f"({size} ranks, {steps} steps)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -358,13 +358,13 @@ def stop_timeline():
 
 def join(device=None) -> int:
     """Signal this rank has no more work this epoch (uneven final
-    batches; parity: hvd.join / EnqueueJoin).
+    batches; parity: hvd.join / EnqueueJoin + JoinOp).
 
-    All ranks must eventually call ``join``; returns the highest rank
-    that joined last.  The dynamic form (other ranks continuing
-    collectives while some have joined) is provided by the eager
-    mini-controller; the barrier form covers the common
-    end-of-epoch use.
+    While joined, this rank's controller keeps cycling and contributes
+    ZEROS to collectives the remaining ranks run (allreduce: zero
+    tensor; allgather/alltoall: zero rows), so their training steps
+    complete without stalling.  All ranks must eventually call
+    ``join``; it returns the rank that joined last, on every rank.
     """
     st = _state.require_init("join")
     if st.size == 1:

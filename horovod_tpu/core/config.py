@@ -96,6 +96,8 @@ class Config:
     # --- coordination service (replaces the Gloo HTTP rendezvous KV) ---
     coordinator_addr: Optional[str] = None
     coordinator_port: int = 0
+    # startup/rendezvous window (parity: horovodrun --start-timeout)
+    start_timeout: float = 600.0
 
     # --- controller (eager mini-controller) transport ---
     controller_addr: Optional[str] = None
@@ -105,6 +107,11 @@ class Config:
     elastic: bool = False
     elastic_timeout: float = 600.0
     elastic_discovery_interval: float = 1.0
+
+    # --- CPU-simulation mode (localhost-as-cluster testing; set by
+    # ``hvtpurun --cpu-devices N``): force the CPU platform with N XLA
+    # devices in this process before the backend is touched. ---
+    cpu_devices: int = 0
 
     @staticmethod
     def from_env() -> "Config":
@@ -140,6 +147,7 @@ class Config:
             cross_size=_env_int("CROSS_SIZE", 1),
             coordinator_addr=_env_str("COORDINATOR_ADDR"),
             coordinator_port=_env_int("COORDINATOR_PORT", 0),
+            start_timeout=_env_float("START_TIMEOUT", 600.0),
             controller_addr=_env_str("CONTROLLER_ADDR"),
             controller_port=_env_int("CONTROLLER_PORT", 0),
             elastic=_env_bool("ELASTIC", False),
@@ -147,4 +155,5 @@ class Config:
             elastic_discovery_interval=_env_float(
                 "ELASTIC_DISCOVERY_INTERVAL", 1.0
             ),
+            cpu_devices=_env_int("CPU_DEVICES", 0),
         )

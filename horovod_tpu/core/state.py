@@ -82,6 +82,14 @@ def init(config: Optional[Config] = None) -> GlobalState:
             return _state
         cfg = config or Config.from_env()
 
+        # CPU-simulation mode (hvtpurun --cpu-devices N): this sandbox's
+        # sitecustomize pre-imports jax with the TPU platform pinned, so
+        # env vars are read too early — the override must go through
+        # jax.config before any backend touch.
+        if cfg.cpu_devices > 0:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", cfg.cpu_devices)
+
         # Multi-process launch (set up by hvtpurun, like HOROVOD_RANK/SIZE
         # env from the reference launcher): join the JAX coordination
         # service — the TPU-native replacement for the Gloo HTTP
@@ -102,6 +110,7 @@ def init(config: Optional[Config] = None) -> GlobalState:
                 ),
                 num_processes=cfg.size,
                 process_id=cfg.rank,
+                initialization_timeout=int(cfg.start_timeout),
             )
             _state.distributed_initialized_by_us = True
 

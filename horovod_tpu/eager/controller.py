@@ -91,6 +91,11 @@ class OpFuture:
 # transports
 # --------------------------------------------------------------------------
 
+class TransportClosed(Exception):
+    """The transport was closed while a cycle was blocked on it —
+    a clean shutdown signal, not a failure."""
+
+
 class LocalTransport:
     """Single-process world: coordinator == the only member."""
 
@@ -108,7 +113,8 @@ class KVTransport:
     itself replaces the Gloo HTTP rendezvous of http_server.py)."""
 
     def __init__(self, rank: int, size: int, client=None,
-                 timeout_s: float = 600.0, namespace: str = "hvt_eager"):
+                 timeout_s: float = 600.0, namespace: str = "hvt_eager",
+                 poll_s: float = 1.0):
         if client is None:
             from jax._src import distributed as _jd
 
@@ -122,13 +128,39 @@ class KVTransport:
         self.size = size
         self.timeout_ms = int(timeout_s * 1000)
         self.ns = namespace
+        # Blocking gets are chunked into short polls so close() can
+        # unblock the cycle thread promptly at shutdown (the service
+        # has no cancellable get).
+        self.poll_s = poll_s
+        self._closed = threading.Event()
 
     def _set(self, key: str, blob: bytes):
         self._kv.key_value_set(key, base64.b64encode(blob).decode())
 
     def _get(self, key: str) -> bytes:
-        val = self._kv.blocking_key_value_get(key, self.timeout_ms)
-        return base64.b64decode(val)
+        deadline = time.monotonic() + self.timeout_ms / 1000.0
+        poll_ms = max(1, int(self.poll_s * 1000))
+        while True:
+            if self._closed.is_set():
+                raise TransportClosed(key)
+            try:
+                val = self._kv.blocking_key_value_get(key, poll_ms)
+                return base64.b64decode(val)
+            except Exception as e:
+                msg = str(e)
+                if self._closed.is_set() or "CANCELLED" in msg:
+                    # Service shut down under us — clean exit.
+                    raise TransportClosed(key) from None
+                retryable = (isinstance(e, TimeoutError)
+                             or "DEADLINE_EXCEEDED" in msg
+                             or "NOT_FOUND" in msg)
+                if not retryable:
+                    raise
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"coordination key {key!r} not posted within "
+                        f"{self.timeout_ms / 1000.0:.0f}s"
+                    ) from None
 
     def _delete(self, key: str):
         try:
@@ -155,7 +187,7 @@ class KVTransport:
         return self._get(resp_key)
 
     def close(self):
-        pass
+        self._closed.set()
 
 
 # --------------------------------------------------------------------------
@@ -165,7 +197,7 @@ class KVTransport:
 class _Payload:
     __slots__ = ("seq", "name", "future", "tensor", "rop", "prescale",
                  "postscale", "compressor", "splits", "kind",
-                 "process_set", "root_rank", "t_enqueue")
+                 "process_set", "psid", "root_rank", "t_enqueue")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -199,8 +231,12 @@ class EagerController:
             rank, size, fusion_threshold, cache_capacity,
             stall_warn_s, stall_abort_s,
         )
+        # Local mirror of process-set membership so the executor can
+        # skip responses scoped to sets this rank is not part of.
+        self._ps_ranks: Dict[int, List[int]] = {0: list(range(size))}
         if process_sets:
             for psid, ranks in process_sets.items():
+                self._ps_ranks[psid] = sorted(ranks)
                 if psid != 0:
                     self._ctrl.register_process_set(psid, list(ranks))
         self._transport = transport or (
@@ -217,6 +253,7 @@ class EagerController:
         self._payloads: Dict[int, _Payload] = {}
         self._by_name: Dict[str, int] = {}
         self._join_futures: List[OpFuture] = []
+        self._joined_local = False
         self._cycle = 0
         self._stall_logged: set = set()
         self._stop = threading.Event()
@@ -235,12 +272,14 @@ class EagerController:
 
     def stop(self):
         self._stop.set()
+        # Close the transport FIRST so a cycle thread blocked in a
+        # coordination-service get unblocks promptly (TransportClosed).
+        self._transport.close()
         thread_exited = True
         if self._thread is not None:
             self._thread.join(timeout=30)
             thread_exited = not self._thread.is_alive()
             self._thread = None
-        self._transport.close()
         # Fail anything still outstanding, like the reference's shutdown
         # path completing callbacks with an aborted status.
         with self._lock:
@@ -308,7 +347,7 @@ class EagerController:
             seq=None, name=name, future=fut, tensor=x,
             rop=op, prescale=prescale_factor, postscale=postscale_factor,
             compressor=compressor, splits=splits, kind=kind,
-            process_set=process_set, root_rank=root_rank,
+            process_set=process_set, psid=psid, root_rank=root_rank,
             t_enqueue=time.monotonic(),
         )
         with self._lock:
@@ -376,14 +415,20 @@ class EagerController:
     def register_process_set(self, psid: int, ranks: List[int]):
         """Mirror a newly-added process set into the coordination core
         (parity: ProcessSetTable additions reaching the controller)."""
+        self._ps_ranks[psid] = sorted(ranks)
         self._ctrl.register_process_set(psid, list(ranks))
 
     def join(self) -> OpFuture:
         """Parity: hvd.join / EnqueueJoin — resolves with the last rank
-        to join once every rank has."""
+        to join once every rank has.  While joined, this rank keeps
+        cycling and contributes ZEROS to collectives the remaining
+        ranks run (JoinOp semantics), so uneven final batches don't
+        stall them.
+        """
         fut = OpFuture("join")
         with self._lock:
             self._join_futures.append(fut)
+            self._joined_local = True
         self._ctrl.set_joined()
         self.start()
         return fut
@@ -395,6 +440,10 @@ class EagerController:
             t0 = time.monotonic()
             try:
                 self.run_cycle_once()
+            except TransportClosed:
+                # Clean shutdown while blocked on the wire; stop() fails
+                # any still-pending futures.
+                return
             except BaseException as e:  # noqa: BLE001 — must fail futures
                 self._thread_error = e
                 logger.exception("eager controller cycle failed")
@@ -478,21 +527,73 @@ class EagerController:
                 )
 
     # ---- execution (parity: PerformOperation dispatching to ops/*) ----
-    def _take_payloads(self, names: List[str]) -> List[_Payload]:
+    def _zero_payload(self, rs: wire.Response, i: int) -> _Payload:
+        """Synthetic zero-contribution payload for a tensor this (joined)
+        rank never enqueued (parity: JoinOp substituting a zero tensor
+        so the data-plane collective still has all mesh members).
+
+        The response's dtype is the WIRE dtype, so zeros built from it
+        line up element-for-element with peers' compressed buffers.
+        Allgather/alltoall contribute zero rows instead.
+        """
+        name = rs.tensor_names[i]
+        shape = tuple(rs.tensor_shapes[i]) if i < len(rs.tensor_shapes) else ()
+        dtype = jnp.dtype(wire.DTYPE_NAMES.get(rs.dtype, "float32"))
+        kind_map = {
+            wire.ALLREDUCE: "allreduce", wire.ALLGATHER: "allgather",
+            wire.BROADCAST: "broadcast", wire.ALLTOALL: "alltoall",
+            wire.REDUCESCATTER: "reducescatter", wire.BARRIER: "barrier",
+        }
+        kind = kind_map.get(rs.type, "allreduce")
+        splits = None
+        if kind in ("allgather", "alltoall"):
+            shape = (0,) + shape[1:]
+        if kind == "alltoall":
+            members = self._ps_ranks.get(rs.process_set_id)
+            p = len(members) if members else self.size
+            splits = [0] * p
+        fut = OpFuture(name)
+        fut.set_result(None)  # nobody waits on a joined rank's result
+        return _Payload(
+            seq=-1, name=name, future=fut,
+            tensor=jnp.zeros(shape, dtype),
+            rop=_WIRE_TO_RED.get(rs.red_op, ReduceOp.SUM),
+            prescale=1.0, postscale=1.0, compressor=NoneCompressor,
+            splits=splits, kind=kind, process_set=rs.process_set_id,
+            psid=rs.process_set_id, root_rank=rs.root_rank,
+            t_enqueue=time.monotonic(),
+        )
+
+    def _take_payloads(self, rs: wire.Response) -> List[_Payload]:
         out = []
         with self._lock:
-            for n in names:
-                seq = self._by_name.pop(n, None)
-                if seq is None:
+            for i, n in enumerate(rs.tensor_names):
+                seq = self._by_name.get(n)
+                if (seq is not None
+                        and self._payloads[seq].psid == rs.process_set_id):
+                    del self._by_name[n]
+                    out.append(self._payloads.pop(seq))
+                elif self._joined_local:
+                    out.append(self._zero_payload(rs, i))
+                else:
                     raise HorovodInternalError(
-                        f"response names unknown tensor {n!r}"
+                        f"response names unknown tensor {n!r} "
+                        f"(process set {rs.process_set_id})"
                     )
-                out.append(self._payloads.pop(seq))
         return out
+
+    def _member_of(self, psid: int) -> bool:
+        ranks = self._ps_ranks.get(psid)
+        return ranks is None or self.rank in ranks
 
     def _execute(self, rl: wire.ResponseList, finished: List[int]):
         for rs in rl.responses:
-            payloads = self._take_payloads(rs.tensor_names)
+            # Responses are broadcast to every rank; only member ranks
+            # of the response's process set execute it (parity: each
+            # set's communicator spans exactly its members).
+            if not self._member_of(rs.process_set_id):
+                continue
+            payloads = self._take_payloads(rs)
             if rs.error:
                 for p in payloads:
                     p.future.set_error(HorovodInternalError(rs.error))
@@ -509,6 +610,7 @@ class EagerController:
         if rl.join_last_rank >= 0:
             with self._lock:
                 futs, self._join_futures = self._join_futures, []
+                self._joined_local = False
             for f in futs:
                 f.set_result(rl.join_last_rank)
 

@@ -84,6 +84,7 @@ class PyController:
         # coordinator state
         self._message_table: Dict[str, dict] = {}
         self._joined_ranks: Set[int] = set()
+        self._last_joined_rank = -1
         self._shutdown_ranks: Set[int] = set()
         self._process_sets: Dict[int, List[int]] = {0: list(range(size))}
 
@@ -159,12 +160,21 @@ class PyController:
         return finished
 
     # ---- coordinator side ----
+    @staticmethod
+    def _table_key(e: wire.Entry) -> str:
+        """Coordination scoped per process set (same tensor name may be
+        pending in disjoint sets); must match Controller::TableKey —
+        sorted() on these strings == std::map byte order."""
+        return f"{e.process_set_id}\x01{e.name}"
+
     def ingest(self, blob: bytes):
         rl = wire.parse_request_list(blob)
         now = time.monotonic()
         with self._lock:
-            if rl.joined:
+            if rl.joined and rl.rank not in self._joined_ranks:
+                # Temporally-last joiner (parity: hvd.join() return value).
                 self._joined_ranks.add(rl.rank)
+                self._last_joined_rank = rl.rank
             if rl.shutdown:
                 self._shutdown_ranks.add(rl.rank)
             for rq in rl.requests:
@@ -173,9 +183,10 @@ class PyController:
                     cached = self._cache.entry_for_bit(rq.cache_bit)
                     if cached is not None:
                         e = wire.Entry(**{**cached.__dict__, "seq": rq.entry.seq})
-                pc = self._message_table.get(e.name)
+                key = self._table_key(e)
+                pc = self._message_table.get(key)
                 if pc is None:
-                    self._message_table[e.name] = {
+                    self._message_table[key] = {
                         "entry": e, "ranks": {rl.rank}, "first_seen": now,
                     }
                 else:
@@ -185,15 +196,26 @@ class PyController:
         ranks = self._process_sets.get(psid)
         return self.size if ranks is None else len(ranks)
 
+    def _member_ranks(self, psid: int) -> List[int]:
+        return self._process_sets.get(psid, list(range(self.size)))
+
+    def _present_count(self, pc: dict) -> int:
+        """Joined ranks count as implicitly ready (parity: EnqueueJoin /
+        JoinOp — joined ranks zero-contribute, so the rest never stall)."""
+        return sum(
+            1 for r in self._member_ranks(pc["entry"].process_set_id)
+            if r in pc["ranks"] or r in self._joined_ranks
+        )
+
     def compute_responses(self) -> bytes:
         with self._lock:
             out = wire.ResponseList()
-            # deterministic name order == std::map iteration in C++
+            # deterministic (psid, name) order == std::map iteration
             ready = [
-                name for name in sorted(self._message_table)
-                if len(self._message_table[name]["ranks"])
+                key for key in sorted(self._message_table)
+                if self._present_count(self._message_table[key])
                 >= self._required_ranks(
-                    self._message_table[name]["entry"].process_set_id)
+                    self._message_table[key]["entry"].process_set_id)
             ]
             group_counts: Dict[int, int] = collections.Counter(
                 self._message_table[n]["entry"].group_id
@@ -201,8 +223,9 @@ class PyController:
                 if self._message_table[n]["entry"].group_id >= 0
             )
             responses: List[wire.Response] = []
-            for name in ready:
-                e = self._message_table[name]["entry"]
+            for key in ready:
+                pc = self._message_table[key]
+                e = pc["entry"]
                 if e.group_id >= 0:
                     want = self._groups.get(e.group_id, -1)
                     if want > 0 and group_counts[e.group_id] < want:
@@ -210,15 +233,39 @@ class PyController:
                 rs = wire.Response(
                     type=e.type, red_op=e.red_op, dtype=e.dtype,
                     process_set_id=e.process_set_id, root_rank=e.root_rank,
-                    tensor_names=[name], tensor_shapes=[tuple(e.shape)],
+                    tensor_names=[e.name], tensor_shapes=[tuple(e.shape)],
                     total_bytes=e.nbytes,
                 )
+                # Zero substitution from joined ranks is only sound for
+                # additive semantics (must match Controller's C++ texts
+                # byte-for-byte for the cross-check tests).
+                used_joined = any(
+                    r not in pc["ranks"] and r in self._joined_ranks
+                    for r in self._member_ranks(e.process_set_id)
+                )
+                if used_joined:
+                    if (e.type == wire.BROADCAST and e.root_rank >= 0
+                            and e.root_rank not in pc["ranks"]
+                            and e.root_rank in self._joined_ranks):
+                        rs.error = (f"broadcast root rank {e.root_rank} "
+                                    "has joined")
+                    elif (e.type == wire.ALLREDUCE
+                          and e.red_op in (wire.RED_MIN, wire.RED_MAX,
+                                           wire.RED_PRODUCT,
+                                           wire.RED_ADASUM)):
+                        rs.error = (f"reduction op {e.red_op} does not "
+                                    "support joined-rank zero contribution")
+                    elif (e.type == wire.ALLREDUCE
+                          and e.dtype == wire.DTYPE_IDS["int8"]):
+                        rs.error = ("int8 wire format does not support "
+                                    "joined-rank zero contribution")
                 responses.append(rs)
-                del self._message_table[name]
+                del self._message_table[key]
             out.responses = self._fuse(responses)
             if len(self._joined_ranks) >= self.size and self.size > 0:
-                out.join_last_rank = max(self._joined_ranks)
+                out.join_last_rank = self._last_joined_rank
                 self._joined_ranks.clear()
+                self._last_joined_rank = -1
             if self._shutdown_ranks:
                 out.shutdown = True
             return wire.serialize_response_list(out)
@@ -267,19 +314,19 @@ class PyController:
         now = time.monotonic()
         out = []
         with self._lock:
-            for name in sorted(self._message_table):
-                pc = self._message_table[name]
+            for key in sorted(self._message_table):
+                pc = self._message_table[key]
                 waited = now - pc["first_seen"]
                 if waited < self.stall_warn_s:
                     continue
-                members = self._process_sets.get(
-                    pc["entry"].process_set_id, list(range(self.size))
-                )
+                members = self._member_ranks(pc["entry"].process_set_id)
+                present = [r for r in members
+                           if r in pc["ranks"] or r in self._joined_ranks]
                 out.append({
-                    "name": name,
+                    "name": pc["entry"].name,
                     "waiting_s": waited,
-                    "present": [r for r in members if r in pc["ranks"]],
-                    "missing": [r for r in members if r not in pc["ranks"]],
+                    "present": present,
+                    "missing": [r for r in members if r not in present],
                 })
         return out
 

@@ -196,11 +196,25 @@ std::vector<uint8_t> Controller::DrainRequests() {
   return SerializeRequestList(rl);
 }
 
+std::string Controller::TableKey(const Entry& e) {
+  // Coordination is scoped per process set: the same tensor name may be
+  // pending simultaneously in disjoint sets (parity: each ProcessSet in
+  // process_set.cc owns its own controller + MessageTable).  '\x01'
+  // cannot appear in a psid decimal string, so keys are unambiguous,
+  // and std::map's byte order matches Python's sorted() on the same
+  // strings (UTF-8 byte order == code-point order).
+  return std::to_string(e.process_set_id) + '\x01' + e.name;
+}
+
 void Controller::Ingest(const uint8_t* data, size_t len) {
   RequestList rl = ParseRequestList(data, len);
   std::lock_guard<std::mutex> g(mu_);
   double now = NowSeconds();
-  if (rl.joined) joined_ranks_.insert(rl.rank);
+  if (rl.joined && joined_ranks_.insert(rl.rank).second) {
+    // Track the temporally-last joiner (parity: hvd.join() returns the
+    // last rank that joined, not the largest rank id).
+    last_joined_rank_ = rl.rank;
+  }
   if (rl.shutdown) shutdown_ranks_.insert(rl.rank);
   for (const Request& rq : rl.requests) {
     Entry e = rq.entry;
@@ -213,32 +227,45 @@ void Controller::Ingest(const uint8_t* data, size_t len) {
         e = cached;
       }
     }
-    auto it = message_table_.find(e.name);
+    std::string key = TableKey(e);
+    auto it = message_table_.find(key);
     if (it == message_table_.end()) {
       // Parity: MessageTable insertion on first Request for a name.
       PendingCoordination pc;
       pc.entry = e;
       pc.first_seen_s = now;
       pc.ranks.insert(rl.rank);
-      message_table_.emplace(e.name, std::move(pc));
+      message_table_.emplace(std::move(key), std::move(pc));
     } else {
       it->second.ranks.insert(rl.rank);
     }
   }
 }
 
+int32_t Controller::PresentCount(const PendingCoordination& pc) const {
+  // Joined ranks count as implicitly ready for every pending tensor in
+  // their process sets (parity: operations.cc EnqueueJoin / JoinOp —
+  // a joined rank participates with a zero contribution, so remaining
+  // ranks' collectives never stall on it).
+  int32_t present = 0;
+  for (int32_t r : ProcessSetRanks(pc.entry.process_set_id)) {
+    if (pc.ranks.count(r) || joined_ranks_.count(r)) present++;
+  }
+  return present;
+}
+
 ResponseList Controller::BuildResponseList() {
   // Caller holds mu_.
   ResponseList out;
 
-  // 1. collect globally-ready names (every member rank reported).
-  //    message_table_ is a std::map → deterministic name order, the
-  //    analog of FuseResponses' stable response ordering.
+  // 1. collect globally-ready keys (every member rank reported, or is
+  //    joined).  message_table_ is a std::map → deterministic
+  //    (process set, name) order, the analog of FuseResponses' stable
+  //    response ordering.
   std::vector<std::string> ready;
   for (auto& kv : message_table_) {
     const PendingCoordination& pc = kv.second;
-    if (static_cast<int32_t>(pc.ranks.size()) >=
-        RequiredRanks(pc.entry.process_set_id)) {
+    if (PresentCount(pc) >= RequiredRanks(pc.entry.process_set_id)) {
       ready.push_back(kv.first);
     }
   }
@@ -260,18 +287,47 @@ ResponseList Controller::BuildResponseList() {
     admitted.push_back(n);
   }
 
-  // 3. one Response per tensor, then fuse.
+  // 3. one Response per tensor, then fuse.  Responses carry the BARE
+  //    tensor name; the set scope travels in process_set_id.
   for (const std::string& n : admitted) {
-    const Entry& e = message_table_[n].entry;
+    const PendingCoordination& pc = message_table_[n];
+    const Entry& e = pc.entry;
     Response rs;
     rs.type = e.type;
     rs.red_op = e.red_op;
     rs.dtype = e.dtype;
     rs.process_set_id = e.process_set_id;
     rs.root_rank = e.root_rank;
-    rs.tensor_names.push_back(n);
+    rs.tensor_names.push_back(e.name);
     rs.tensor_shapes.push_back(e.shape);
     rs.total_bytes = e.nbytes();
+    // Zero substitution from joined ranks is only sound for additive
+    // semantics; reject ops it would silently corrupt (min/max/product
+    // zeroed, adasum NaN from zero norms, broadcast root with no data,
+    // int8 wire needing the two-phase quantized kernel on every rank).
+    bool used_joined = false;
+    for (int32_t r : ProcessSetRanks(e.process_set_id)) {
+      if (!pc.ranks.count(r) && joined_ranks_.count(r)) used_joined = true;
+    }
+    if (used_joined) {
+      if (e.type == OpType::kBroadcast && e.root_rank >= 0 &&
+          !pc.ranks.count(e.root_rank) && joined_ranks_.count(e.root_rank)) {
+        rs.error = "broadcast root rank " + std::to_string(e.root_rank) +
+                   " has joined";
+      } else if (e.type == OpType::kAllreduce &&
+                 (e.red_op == RedOp::kMin || e.red_op == RedOp::kMax ||
+                  e.red_op == RedOp::kProduct ||
+                  e.red_op == RedOp::kAdasum)) {
+        rs.error = "reduction op " +
+                   std::to_string(static_cast<int>(e.red_op)) +
+                   " does not support joined-rank zero contribution";
+      } else if (e.type == OpType::kAllreduce &&
+                 e.dtype == DataType::kInt8) {
+        rs.error =
+            "int8 wire format does not support joined-rank zero "
+            "contribution";
+      }
+    }
     out.responses.push_back(std::move(rs));
     message_table_.erase(n);
   }
@@ -280,8 +336,9 @@ ResponseList Controller::BuildResponseList() {
   // 4. join: once every rank joined, emit the last joiner (parity:
   //    operations.cc join handling returns the last joined rank).
   if (static_cast<int32_t>(joined_ranks_.size()) >= size_ && size_ > 0) {
-    out.join_last_rank = *joined_ranks_.rbegin();
+    out.join_last_rank = last_joined_rank_;
     joined_ranks_.clear();
+    last_joined_rank_ = -1;
   }
   if (!shutdown_ranks_.empty()) out.shutdown = true;
   return out;
@@ -361,10 +418,11 @@ std::vector<StallEntry> Controller::CheckStalls() const {
     double waited = now - pc.first_seen_s;
     if (waited < stall_warn_s_) continue;
     StallEntry se;
-    se.name = kv.first;
+    se.name = pc.entry.name;
     se.waiting_s = waited;
     for (int32_t r : ProcessSetRanks(pc.entry.process_set_id)) {
-      if (pc.ranks.count(r))
+      // Joined ranks are implicitly present (they zero-contribute).
+      if (pc.ranks.count(r) || joined_ranks_.count(r))
         se.present_ranks.push_back(r);
       else
         se.missing_ranks.push_back(r);

@@ -164,8 +164,10 @@ class Controller {
     double first_seen_s = 0;
   };
 
+  static std::string TableKey(const Entry& e);
   int32_t RequiredRanks(int32_t psid) const;
   std::vector<int32_t> ProcessSetRanks(int32_t psid) const;
+  int32_t PresentCount(const PendingCoordination& pc) const;
   ResponseList BuildResponseList();
   void FuseResponses(std::vector<Response>* responses) const;
 
@@ -181,6 +183,7 @@ class Controller {
   // coordinator state
   std::map<std::string, PendingCoordination> message_table_;  // by name (ordered for determinism)
   std::set<int32_t> joined_ranks_;
+  int32_t last_joined_rank_ = -1;
   std::set<int32_t> shutdown_ranks_;
   std::unordered_map<int32_t, std::vector<int32_t>> process_sets_;
   mutable std::mutex mu_;

@@ -1,0 +1,131 @@
+"""Launcher / runner: ``hvtpurun`` CLI and the programmatic ``run()``.
+
+Parity surface: ``horovod/runner/`` — ``horovodrun`` (launch.py),
+``horovod.run()`` (``__init__.py``), host parsing, safe shell
+execution, and the elastic driver (horovod_tpu.elastic.driver).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import tempfile
+from typing import Any, Callable, Dict, List, Optional
+
+from .hosts import (  # noqa: F401
+    HostSlots,
+    SlotInfo,
+    get_host_assignments,
+    parse_host_spec,
+)
+from .launch import (  # noqa: F401
+    build_worker_env,
+    find_free_port,
+    launch_workers,
+    main,
+    parse_args,
+)
+
+
+class RunError(RuntimeError):
+    """A worker failed during ``run()``; carries the rank's traceback."""
+
+    def __init__(self, rank: int, worker_traceback: str):
+        super().__init__(
+            f"rank {rank} failed:\n{worker_traceback}"
+        )
+        self.rank = rank
+        self.worker_traceback = worker_traceback
+
+
+def _dump_fn(fn: Callable, args, kwargs, path: str):
+    try:
+        import cloudpickle as pickler
+    except ImportError:  # pragma: no cover - cloudpickle is available
+        import pickle as pickler
+    with open(path, "wb") as f:
+        f.write(pickler.dumps((fn, tuple(args), dict(kwargs or {}))))
+
+
+def run(
+    fn: Callable,
+    args: tuple = (),
+    kwargs: Optional[Dict[str, Any]] = None,
+    np: int = 2,
+    cpu_devices: Optional[int] = None,
+    env: Optional[Dict[str, str]] = None,
+    timeout: Optional[float] = 600.0,
+    start_timeout: Optional[float] = None,  # deprecated alias of timeout
+    extra_flags: Optional[List[str]] = None,
+    verbose: bool = False,
+) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` on ``np`` local worker processes and
+    return the per-rank results, ordered by rank.
+
+    Parity: ``horovod.run()`` (horovod/runner/__init__.py) — the
+    function rides cloudpickle to each rank; each rank's return value is
+    collected by the launcher.  ``cpu_devices`` forces the CPU platform
+    with that many XLA devices per worker (the localhost-as-cluster test
+    mode; SURVEY.md §4 pattern 2).  ``timeout`` is a hard deadline for
+    the whole job (None = unlimited) — unlike ``hvtpurun``, the
+    programmatic API defaults to bounded so test harnesses can't hang.
+    """
+    from . import launch as launch_mod
+
+    if start_timeout is not None:
+        timeout = start_timeout
+    with tempfile.TemporaryDirectory(prefix="hvtpurun_") as tmp:
+        fn_path = os.path.join(tmp, "fn.pkl")
+        out_dir = os.path.join(tmp, "results")
+        os.makedirs(out_dir)
+        _dump_fn(fn, args, kwargs, fn_path)
+        argv = ["-np", str(np)]
+        if cpu_devices is not None:
+            argv += ["--cpu-devices", str(cpu_devices)]
+        if verbose:
+            argv += ["--verbose"]
+        argv += extra_flags or []
+        argv += [
+            sys.executable, "-m", "horovod_tpu.runner.run_task",
+            fn_path, out_dir,
+        ]
+        ns = launch_mod.parse_args(argv)
+        base_env = dict(os.environ)
+        base_env.update(env or {})
+        host_spec = f"localhost:{np}"
+        slots = get_host_assignments(parse_host_spec(host_spec), np)
+        port = launch_mod.find_free_port()
+        code = launch_workers(
+            ns.command,
+            slots,
+            "127.0.0.1",
+            port,
+            args=ns,
+            base_env=base_env,
+            job_timeout=timeout,
+        )
+        # Collect every rank's payload FIRST, then report the most
+        # informative failure: a rank that wrote (ok=False, traceback)
+        # beats 'no result file' from a peer the launcher terminated.
+        payloads: Dict[int, tuple] = {}
+        for r in range(np):
+            path = os.path.join(out_dir, f"rank_{r}.pkl")
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    payloads[r] = pickle.load(f)
+        for r in range(np):
+            item = payloads.get(r)
+            if item is not None and not item[0]:
+                raise RunError(r, item[1])
+        for r in range(np):
+            if r not in payloads:
+                raise RunError(
+                    r,
+                    f"no result file (worker exit code {code}; it may "
+                    "have crashed or been terminated before writing "
+                    "results)",
+                )
+        if code != 0:
+            raise RunError(-1, f"launcher observed exit code {code}")
+        return [payloads[r][1] for r in range(np)]

@@ -1,0 +1,7 @@
+"""``python -m horovod_tpu.runner`` == ``hvtpurun``."""
+
+import sys
+
+from .launch import main
+
+sys.exit(main())

@@ -1,0 +1,280 @@
+"""``hvtpurun`` — the launcher CLI.
+
+Parity surface: ``horovod/runner/launch.py`` (``parse_args``, ``_run``)
+and ``horovod/runner/gloo_run.py`` (``launch_gloo``): compute rank
+assignments from the host spec, build each worker's environment
+(``HVTPU_RANK/SIZE/LOCAL_RANK/...`` — the HOROVOD_RANK/SIZE analog),
+spawn workers with rank-prefixed output piping, and propagate the first
+non-zero exit code after terminating survivors.
+
+TPU-native departure: there is no launcher-hosted HTTP rendezvous
+server (``runner/http/http_server.py``).  Rank 0's worker process hosts
+the JAX coordination service (a KV store + barrier over DCN); the
+launcher only picks the port and points every worker at it via
+``HVTPU_COORDINATOR_ADDR/PORT``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import socket
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from . import hosts as hosts_mod
+from . import safe_shell_exec
+from .hosts import SlotInfo
+
+
+def find_free_port(bind_addr: str = "127.0.0.1") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((bind_addr, 0))
+        return s.getsockname()[1]
+
+
+def _default_coordinator_addr(slots: List[SlotInfo]) -> str:
+    """Address workers use to reach rank 0's coordination service."""
+    host0 = slots[0].hostname
+    if hosts_mod.is_local_host(host0):
+        return "127.0.0.1"
+    return host0
+
+
+def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
+    """Parity: horovod/runner/launch.py parse_args — flags mirror the
+    HVTPU_*/HOROVOD_* env namespace (SURVEY.md §5.6 layer 2)."""
+    p = argparse.ArgumentParser(
+        prog="hvtpurun",
+        description="Launch a horovod_tpu job on N worker processes.",
+    )
+    p.add_argument("-np", "--num-proc", type=int, dest="np", default=None,
+                   help="number of worker processes (ranks)")
+    p.add_argument("-H", "--hosts", dest="hosts", default=None,
+                   help='host spec "h1:2,h2:2" (default: localhost:np)')
+    p.add_argument("--network-interface", dest="nic", default=None,
+                   help="address workers use to reach the coordinator "
+                        "(default: first host, or 127.0.0.1 if local)")
+    p.add_argument("--coordinator-port", type=int, default=0,
+                   help="coordination-service port (0 = pick a free one)")
+    p.add_argument("--start-timeout", type=float, default=600.0,
+                   help="seconds workers get to rendezvous at startup "
+                        "(exported as HVTPU_START_TIMEOUT; does NOT "
+                        "bound job duration)")
+    p.add_argument("--job-timeout", type=float, default=None,
+                   help="optional hard deadline for the WHOLE job; "
+                        "default: unlimited")
+    p.add_argument("--output-filename", default=None,
+                   help="directory for per-rank output files instead of "
+                        "prefixed piping (parity: horovodrun flag)")
+    p.add_argument("--verbose", action="store_true")
+    # engine knobs mirrored into env (layer-2 of the config scheme)
+    p.add_argument("--fusion-threshold-mb", type=float, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--autotune", action="store_true")
+    p.add_argument("--autotune-log", default=None)
+    p.add_argument("--compression", default=None,
+                   choices=["none", "fp16", "bf16", "int8"])
+    p.add_argument("--stall-check-time", type=float, default=None,
+                   help="seconds before warning about a stalled collective")
+    p.add_argument("--stall-shutdown-time", type=float, default=None,
+                   help="seconds before aborting a stalled collective")
+    p.add_argument("--log-level", default=None,
+                   choices=["trace", "debug", "info", "warning", "error",
+                            "fatal"])
+    # elastic (driven by runner.elastic once --host-discovery-script set)
+    p.add_argument("--host-discovery-script", default=None,
+                   help="script printing current 'host:slots' lines; "
+                        "enables elastic mode")
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--elastic-timeout", type=float, default=None)
+    # CPU-simulation mode (this sandbox / CI: N ranks on localhost CPU)
+    p.add_argument("--cpu-devices", type=int, default=None,
+                   help="force the CPU platform with this many XLA "
+                        "devices per worker (testing / CI)")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="worker command, e.g. python train.py")
+    args = p.parse_args(argv)
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if not args.host_discovery_script:
+        if args.np is None:
+            p.error("-np is required (unless --host-discovery-script)")
+    elif args.np is None:
+        args.np = args.min_np or 1
+    if not args.command:
+        p.error("no worker command given")
+    return args
+
+
+def build_worker_env(
+    base_env: Dict[str, str],
+    slot: SlotInfo,
+    coordinator_addr: str,
+    coordinator_port: int,
+    args: Optional[argparse.Namespace] = None,
+) -> Dict[str, str]:
+    """Per-rank environment (parity: the env block launch_gloo exports —
+    HOROVOD_RANK/SIZE/LOCAL_RANK/LOCAL_SIZE/CROSS_RANK/CROSS_SIZE plus
+    rendezvous address/port)."""
+    env = dict(base_env)
+    env.update(
+        HVTPU_RANK=str(slot.rank),
+        HVTPU_SIZE=str(slot.size),
+        HVTPU_LOCAL_RANK=str(slot.local_rank),
+        HVTPU_LOCAL_SIZE=str(slot.local_size),
+        HVTPU_CROSS_RANK=str(slot.cross_rank),
+        HVTPU_CROSS_SIZE=str(slot.cross_size),
+        HVTPU_COORDINATOR_ADDR=coordinator_addr,
+        HVTPU_COORDINATOR_PORT=str(coordinator_port),
+    )
+    if args is not None:
+        flag_env = {
+            "HVTPU_FUSION_THRESHOLD_MB": args.fusion_threshold_mb,
+            "HVTPU_CYCLE_TIME": args.cycle_time_ms,
+            "HVTPU_CACHE_CAPACITY": args.cache_capacity,
+            "HVTPU_TIMELINE": args.timeline_filename,
+            "HVTPU_AUTOTUNE_LOG": args.autotune_log,
+            "HVTPU_COMPRESSION": args.compression,
+            "HVTPU_STALL_CHECK_TIME_SECONDS": args.stall_check_time,
+            "HVTPU_STALL_SHUTDOWN_TIME_SECONDS": args.stall_shutdown_time,
+            "HVTPU_LOG_LEVEL": args.log_level,
+            "HVTPU_CPU_DEVICES": args.cpu_devices,
+            "HVTPU_ELASTIC_TIMEOUT": args.elastic_timeout,
+            "HVTPU_START_TIMEOUT": args.start_timeout,
+        }
+        for k, v in flag_env.items():
+            if v is not None:
+                env[k] = str(v)
+        if args.autotune:
+            env["HVTPU_AUTOTUNE"] = "1"
+        if args.timeline_mark_cycles:
+            env["HVTPU_TIMELINE_MARK_CYCLES"] = "1"
+    return env
+
+
+def build_ssh_command(
+    hostname: str,
+    command: Sequence[str],
+    env: Dict[str, str],
+    cwd: Optional[str] = None,
+    ssh_port: Optional[int] = None,
+) -> List[str]:
+    """Remote worker command line (parity: get_remote_command /
+    get_ssh_command in horovod/runner/util/remote.py).  Only the
+    HVTPU_*/JAX_*/XLA_* env subset is forwarded, like the reference
+    forwarding its own namespace with ``env`` on the remote shell.
+    """
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}"
+        for k, v in sorted(env.items())
+        if k.startswith(("HVTPU_", "HOROVOD_", "JAX_", "XLA_", "TPU_"))
+    )
+    inner = " ".join(shlex.quote(c) for c in command)
+    if cwd:
+        inner = f"cd {shlex.quote(cwd)} && env {exports} {inner}"
+    else:
+        inner = f"env {exports} {inner}"
+    ssh = ["ssh", "-o", "PasswordAuthentication=no",
+           "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    return ssh + [hostname, inner]
+
+
+def launch_workers(
+    command: Sequence[str],
+    slots: List[SlotInfo],
+    coordinator_addr: str,
+    coordinator_port: int,
+    args: Optional[argparse.Namespace] = None,
+    base_env: Optional[Dict[str, str]] = None,
+    job_timeout: Optional[float] = None,
+    output_dir: Optional[str] = None,
+) -> int:
+    """Spawn one worker per slot and wait (parity: launch_gloo).
+
+    ``job_timeout`` is an optional hard deadline for the whole job;
+    startup/rendezvous timeouts are the workers' business
+    (HVTPU_START_TIMEOUT -> jax.distributed initialization_timeout).
+    """
+    base_env = dict(base_env if base_env is not None else os.environ)
+    stdout_lock = threading.Lock()
+    workers: List[safe_shell_exec.WorkerProcess] = []
+    try:
+        for slot in slots:
+            env = build_worker_env(
+                base_env, slot, coordinator_addr, coordinator_port, args
+            )
+            if hosts_mod.is_local_host(slot.hostname):
+                cmd = list(command)
+            else:
+                cmd = build_ssh_command(
+                    slot.hostname, command, env, cwd=os.getcwd()
+                )
+            workers.append(
+                safe_shell_exec.WorkerProcess(
+                    slot.rank, cmd, env,
+                    output_dir=output_dir,
+                    stdout_lock=stdout_lock,
+                )
+            )
+    except Exception:
+        for w in workers:
+            w.terminate()
+        raise
+
+    def _on_failure(w, code):
+        print(
+            f"hvtpurun: rank {w.rank} exited with code {code}; "
+            "terminating remaining workers",
+            file=sys.stderr,
+        )
+
+    return safe_shell_exec.wait_for_any_failure_or_all_done(
+        workers, timeout=job_timeout, on_failure=_on_failure
+    )
+
+
+def _run(args: argparse.Namespace) -> int:
+    """Parity: horovod/runner/launch.py _run — static vs elastic split."""
+    if args.host_discovery_script:
+        from ..elastic.driver import run_elastic
+
+        return run_elastic(args)
+    host_spec = args.hosts or f"localhost:{args.np}"
+    slots = hosts_mod.get_host_assignments(
+        hosts_mod.parse_host_spec(host_spec), args.np
+    )
+    coordinator_addr = args.nic or _default_coordinator_addr(slots)
+    port = args.coordinator_port or find_free_port()
+    if args.verbose:
+        print(
+            f"hvtpurun: {args.np} ranks on {host_spec}, "
+            f"coordinator {coordinator_addr}:{port}",
+            file=sys.stderr,
+        )
+    return launch_workers(
+        args.command,
+        slots,
+        coordinator_addr,
+        port,
+        args=args,
+        job_timeout=args.job_timeout,
+        output_dir=args.output_filename,
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    return _run(parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Worker entry for the programmatic ``horovod_tpu.runner.run()`` API.
+
+Parity surface: ``horovod/runner/__init__.py`` (``run``) +
+``horovod/runner/task_fn.py`` — the launcher pickles the user function,
+each rank unpickles and calls it, and per-rank return values are
+pickled back for the launcher to collect.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import traceback
+
+
+def _load(path: str):
+    with open(path, "rb") as f:
+        blob = f.read()
+    try:
+        import cloudpickle
+
+        return cloudpickle.loads(blob)
+    except ImportError:
+        return pickle.loads(blob)
+
+
+def main(fn_path: str, out_dir: str) -> int:
+    rank = int(os.environ.get("HVTPU_RANK", "0"))
+    result_path = os.path.join(out_dir, f"rank_{rank}.pkl")
+    try:
+        fn, args, kwargs = _load(fn_path)
+        result = fn(*args, **kwargs)
+        payload = (True, result)
+        code = 0
+    except BaseException:
+        payload = (False, traceback.format_exc())
+        code = 1
+    tmp = result_path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f)
+    os.replace(tmp, result_path)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2]))

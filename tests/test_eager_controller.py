@@ -199,6 +199,87 @@ class TestMultiRankNegotiation:
         finally:
             stop_world(ctrls)
 
+    def test_join_unblocks_remaining_ranks(self, hvt):
+        """VERDICT round-1 Missing #4: after rank 1 joins, rank 0's
+        subsequent collectives complete (rank 1 implicitly ready with a
+        zero contribution) instead of stalling until abort."""
+        ctrls = make_world(2)
+        try:
+            # both ranks run one normal batch
+            f0 = ctrls[0].enqueue("allreduce", jnp.ones(4), name="b0")
+            f1 = ctrls[1].enqueue("allreduce", jnp.ones(4), name="b0")
+            f0.result(timeout=20), f1.result(timeout=20)
+            # rank 1 exhausts its data and joins
+            jf1 = ctrls[1].join()
+            # rank 0 keeps training: 2 more steps, must NOT stall
+            for step in range(2):
+                f = ctrls[0].enqueue(
+                    "allreduce", jnp.ones(4), name=f"late{step}"
+                )
+                f.result(timeout=20)
+            assert not jf1.done()  # join still pending (rank 0 not joined)
+            jf0 = ctrls[0].join()
+            # rank 0 joined last -> join() returns 0 on every rank
+            assert jf0.result(timeout=20) == 0
+            assert jf1.result(timeout=20) == 0
+        finally:
+            stop_world(ctrls)
+
+    def test_join_unblocks_allgather_and_broadcast(self, hvt):
+        ctrls = make_world(2)
+        try:
+            ctrls[1].join()
+            fg = ctrls[0].enqueue("allgather", jnp.ones((2, 3)), name="g")
+            fb = ctrls[0].enqueue("broadcast", jnp.ones(3), name="bc",
+                                  root_rank=0)
+            fg.result(timeout=20)
+            fb.result(timeout=20)
+            ctrls[0].join().result(timeout=20)
+        finally:
+            stop_world(ctrls)
+
+    def test_same_name_in_disjoint_process_sets(self):
+        """The coordination table is scoped per process set: the same
+        tensor name pending in two disjoint sets must not collide
+        (parity: each ProcessSet owns its own controller/MessageTable).
+        Driven at the protocol level: 4 ranks, sets {0,2} and {1,3},
+        all four report tensor 'x' — the coordinator must emit TWO
+        responses, one per set, each only when ITS members reported."""
+        from horovod_tpu.native.fallback import PyController
+
+        coord = PyController(0, 4, fusion_threshold=1 << 20)
+        coord.register_process_set(1, [0, 2])
+        coord.register_process_set(2, [1, 3])
+        workers = []
+        for r in range(4):
+            c = PyController(r, 4, fusion_threshold=1 << 20)
+            c.register_process_set(1, [0, 2])
+            c.register_process_set(2, [1, 3])
+            workers.append(c)
+        # ranks 0 and 1 report 'x' for their respective sets
+        workers[0].enqueue(1, "x", wire.ALLREDUCE, wire.RED_SUM, 6, (2,),
+                           process_set_id=1)
+        workers[1].enqueue(1, "x", wire.ALLREDUCE, wire.RED_SUM, 6, (5,),
+                           process_set_id=2)
+        coord.ingest(workers[0].drain_requests())
+        coord.ingest(workers[1].drain_requests())
+        rl = wire.parse_response_list(coord.compute_responses())
+        assert rl.responses == []  # neither set complete yet
+        # remaining members report
+        workers[2].enqueue(1, "x", wire.ALLREDUCE, wire.RED_SUM, 6, (2,),
+                           process_set_id=1)
+        workers[3].enqueue(1, "x", wire.ALLREDUCE, wire.RED_SUM, 6, (5,),
+                           process_set_id=2)
+        coord.ingest(workers[2].drain_requests())
+        coord.ingest(workers[3].drain_requests())
+        rl = wire.parse_response_list(coord.compute_responses())
+        assert len(rl.responses) == 2
+        by_ps = {rs.process_set_id: rs for rs in rl.responses}
+        assert by_ps[1].tensor_names == ["x"]
+        assert by_ps[1].tensor_shapes == [(2,)]
+        assert by_ps[2].tensor_names == ["x"]
+        assert by_ps[2].tensor_shapes == [(5,)]
+
     def test_steady_state_cache_and_fusion(self, hvt):
         ctrls = make_world(2, fusion_threshold=1 << 20)
         try:

@@ -1,0 +1,55 @@
+"""End-to-end CLI launches: ``hvtpurun -np N python examples/...`` as a
+real subprocess invocation — the reference's `horovodrun -np 2 python
+train.py` acceptance path (VERDICT round-1 task 1 'done when')."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import horovod_tpu
+
+pytestmark = pytest.mark.multiprocess
+
+_REPO = os.path.dirname(os.path.dirname(horovod_tpu.__file__))
+
+
+def _hvtpurun(args, timeout=300):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner"] + args,
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_REPO,
+    )
+
+
+def test_cli_jax_mnist_2proc():
+    res = _hvtpurun([
+        "-np", "2", "--cpu-devices", "1", "--",
+        sys.executable, os.path.join(_REPO, "examples", "train_mnist.py"),
+        "--epochs", "1", "--train-size", "256", "--batch-size", "64",
+    ])
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "ranks consistent (2 ranks" in res.stdout
+
+
+def test_cli_torch_mnist_2proc():
+    res = _hvtpurun([
+        "-np", "2", "--cpu-devices", "1", "--",
+        sys.executable, os.path.join(_REPO, "examples", "pytorch_mnist.py"),
+        "--epochs", "1", "--train-size", "256", "--batch-size", "64",
+    ])
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "ranks consistent (2 ranks)" in res.stdout
+
+
+def test_cli_failure_exit_code():
+    res = _hvtpurun([
+        "-np", "2", "--cpu-devices", "1", "--",
+        sys.executable, "-c", "import sys, os; "
+        "sys.exit(3 if os.environ['HVTPU_RANK'] == '1' else 0)",
+    ])
+    assert res.returncode == 3
+    assert "rank 1 exited with code 3" in res.stderr

@@ -1,0 +1,292 @@
+"""REAL multi-process integration tests: N worker processes launched by
+the runner, speaking through the actual JAX coordination service
+(KVTransport) and the cross-process XLA CPU data plane (gloo-backed
+collectives).
+
+This is the analog of the reference's ``test/parallel/*`` suite running
+under ``horovodrun -np N`` on localhost (SURVEY.md §4 patterns 1-2):
+test bodies are SPMD — every rank runs the same function — and the
+launcher is the real one, not a mock.  Each test bundles many asserts
+into one launch because process spawn + rendezvous costs seconds.
+"""
+
+import os
+
+import pytest
+
+import horovod_tpu
+from horovod_tpu.runner import RunError, run
+
+pytestmark = pytest.mark.multiprocess
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(horovod_tpu.__file__))
+_ENV = {"PYTHONPATH": _REPO_ROOT + os.pathsep
+        + os.environ.get("PYTHONPATH", "")}
+
+
+def _run(body, np=2, cpu_devices=1, **kw):
+    return run(body, np=np, cpu_devices=cpu_devices, env=_ENV,
+               start_timeout=300.0, **kw)
+
+
+def test_sync_collectives_2proc():
+    """The full sync eager op matrix across 2 real processes."""
+
+    def body():
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvt
+
+        hvt.init()
+        r, s = hvt.rank(), hvt.size()
+        assert s == 2
+        out = {}
+
+        # allreduce (sum + average + prescale)
+        x = jnp.full((3,), float(r + 1))
+        out["sum"] = np.asarray(hvt.allreduce(x, op=hvt.Sum)).tolist()
+        out["avg"] = np.asarray(hvt.allreduce(x, op=hvt.Average)).tolist()
+        out["pre"] = np.asarray(
+            hvt.allreduce(x, op=hvt.Sum, prescale_factor=2.0)
+        ).tolist()
+
+        # ragged allgather: rank r contributes r+1 rows of value r
+        g = hvt.allgather(jnp.full((r + 1, 2), float(r)))
+        out["gather"] = np.asarray(g).tolist()
+
+        # broadcast from rank 1
+        b = hvt.broadcast(jnp.full((2,), float(r * 10)), root_rank=1)
+        out["bcast"] = np.asarray(b).tolist()
+
+        # alltoall with variable splits: rank 0 sends [1 row, 2 rows],
+        # rank 1 sends [3 rows, 1 row]
+        splits = [1, 2] if r == 0 else [3, 1]
+        t = jnp.arange(sum(splits), dtype=jnp.float32) + 100 * r
+        recv, rsplits = hvt.alltoall(t, splits=splits)
+        out["a2a"] = np.asarray(recv).tolist()
+        out["a2a_splits"] = np.asarray(rsplits).tolist()
+
+        # reducescatter, uneven dim0 (5 rows over 2 ranks -> 3/2)
+        rs = hvt.reducescatter(jnp.ones((5, 2)), op=hvt.Sum)
+        out["rs_shape"] = list(rs.shape)
+
+        # barrier
+        hvt.barrier()
+        return (r, out)
+
+    results = _run(body, np=2)
+    for r, out in results:
+        assert out["sum"] == [3.0, 3.0, 3.0]
+        assert out["avg"] == [1.5, 1.5, 1.5]
+        assert out["pre"] == [6.0, 6.0, 6.0]
+        assert out["gather"] == [[0.0, 0.0], [1.0, 1.0], [1.0, 1.0]]
+        assert out["bcast"] == [10.0, 10.0]
+        # rank 0 receives: its own first chunk [100*0+0], rank 1's first
+        # chunk (3 rows). rank 1 receives rank 0's second chunk (2 rows)
+        # + its own second chunk (1 row).
+        if r == 0:
+            assert out["a2a"] == [0.0, 100.0, 101.0, 102.0]
+            assert out["a2a_splits"] == [1, 3]
+        else:
+            assert out["a2a"] == [1.0, 2.0, 103.0]
+            assert out["a2a_splits"] == [2, 1]
+        assert out["rs_shape"] == ([3, 2] if r == 0 else [2, 2])
+
+
+def test_async_controller_negotiation_2proc():
+    """Ranks enqueue async ops in DIFFERENT orders; the controller must
+    negotiate one execution order through the real KVTransport (the
+    core Horovod property — never before exercised across processes)."""
+
+    def body():
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvt
+
+        hvt.init()
+        r = hvt.rank()
+        names = ["a", "b", "c", "d"] if r == 0 else ["d", "c", "b", "a"]
+        handles = {
+            n: hvt.allreduce_async(
+                jnp.full((8,), float((r + 1) * (i + 1))), name=n,
+                op=hvt.Sum,
+            )
+            for i, n in enumerate(names)
+        }
+        vals = {n: float(np.asarray(hvt.synchronize(h))[0])
+                for n, h in handles.items()}
+
+        # grouped allreduce: members only execute together
+        g = hvt.grouped_allreduce_async(
+            [jnp.full((2,), float(r)), jnp.full((3,), float(r + 1))],
+            names=["g1", "g2"], op=hvt.Sum,
+        )
+        grouped = [np.asarray(hvt.synchronize(h)).tolist() for h in g]
+
+        # async broadcast + ragged allgather through the controller
+        hb = hvt.broadcast_async(jnp.full((2,), float(r)), root_rank=0,
+                                 name="bc")
+        hg = hvt.allgather_async(jnp.full((r + 2,), 1.0), name="ag")
+        bcast = np.asarray(hvt.synchronize(hb)).tolist()
+        gath = np.asarray(hvt.synchronize(hg)).tolist()
+        return (r, vals, grouped, bcast, gath)
+
+    results = _run(body, np=2)
+    for r, vals, grouped, bcast, gath in results:
+        # rank0 enqueued (i+1), rank1 enqueued 2(i+1) with names reversed:
+        # a: r0 gives 1, r1 gives 2*4=8 -> 9 ... pair by NAME not order.
+        assert vals == {"a": 1.0 + 8.0, "b": 2.0 + 6.0,
+                        "c": 3.0 + 4.0, "d": 4.0 + 2.0}
+        assert grouped == [[1.0, 1.0], [3.0, 3.0, 3.0]]
+        assert bcast == [0.0, 0.0]
+        assert gath == [1.0] * 5
+
+
+def test_process_sets_and_fusion_4proc():
+    """Process-set-scoped collectives + fused small tensors, 4 procs."""
+
+    def body():
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvt
+
+        hvt.init()
+        r, s = hvt.rank(), hvt.size()
+        assert s == 4
+        evens = hvt.add_process_set([0, 2])
+        odds = hvt.add_process_set([1, 3])
+        mine = evens if r % 2 == 0 else odds
+
+        # sync collective scoped to the set
+        v = float(np.asarray(
+            hvt.allreduce(jnp.asarray([float(r)]), op=hvt.Sum,
+                          process_set=mine)
+        )[0])
+
+        # async: many small tensors -> the controller fuses them into
+        # one flat wire buffer per cycle (FusionBufferManager parity)
+        handles = [
+            hvt.allreduce_async(jnp.full((4,), float(r + i)),
+                                name=f"t{i}", op=hvt.Sum)
+            for i in range(6)
+        ]
+        fused = [float(np.asarray(hvt.synchronize(h))[0]) for h in handles]
+
+        # set-scoped async allgather
+        hg = hvt.allgather_async(jnp.asarray([float(r)]), name="ps_ag",
+                                 process_set=mine)
+        ps_gather = np.asarray(hvt.synchronize(hg)).tolist()
+        return (r, v, fused, ps_gather)
+
+    results = _run(body, np=4)
+    for r, v, fused, ps_gather in results:
+        expected_set = 0.0 + 2.0 if r % 2 == 0 else 1.0 + 3.0
+        assert v == expected_set
+        assert fused == [float(sum(rr + i for rr in range(4)))
+                         for i in range(6)]
+        assert ps_gather == ([0.0, 2.0] if r % 2 == 0 else [1.0, 3.0])
+
+
+def test_torch_optimizer_2proc():
+    """The torch frontend end-to-end across processes: broadcast
+    parameters, DistributedOptimizer averaging gradients."""
+
+    def body():
+        import numpy as np
+        import torch
+
+        import horovod_tpu.torch as hvd
+
+        hvd.init()
+        r = hvd.rank()
+        torch.manual_seed(1234 + r)  # different init per rank
+        model = torch.nn.Linear(4, 2)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        w0 = model.weight.detach().clone().numpy()
+
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        opt = hvd.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters()
+        )
+        torch.manual_seed(r)  # different data per rank
+        x = torch.randn(8, 4)
+        y = torch.randn(8, 2)
+        for _ in range(2):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+        return (r, w0.tolist(), model.weight.detach().numpy().tolist())
+
+    results = _run(body, np=2)
+    (r0, w0_init, w0_final), (r1, w1_init, w1_final) = results
+    # broadcast made initial params identical; averaged grads keep them
+    # identical through steps despite different per-rank data
+    assert w0_init == w1_init
+    assert w0_final == w1_final
+    assert w0_final != w0_init  # training moved
+
+
+def test_join_uneven_batches_2proc():
+    """JoinOp semantics across real processes: rank 1 exhausts its data
+    after 1 batch and joins; rank 0 runs 2 more batches whose
+    allreduces must complete with rank 1 contributing zeros (sum keeps
+    only rank 0's grads; average still divides by world size —
+    reference join semantics)."""
+
+    def body():
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvt
+
+        hvt.init()
+        r = hvt.rank()
+        out = {}
+        # batch 0: everyone participates
+        h = hvt.allreduce_async(jnp.full((4,), float(r + 1)), name="b0",
+                                op=hvt.Sum)
+        out["b0"] = float(np.asarray(hvt.synchronize(h))[0])
+        if r == 1:
+            last = hvt.join()  # out of data
+            out["join_last"] = last
+        else:
+            # two uneven extra batches
+            h1 = hvt.allreduce_async(jnp.full((4,), 10.0), name="b1",
+                                     op=hvt.Sum)
+            out["b1"] = float(np.asarray(hvt.synchronize(h1))[0])
+            h2 = hvt.allreduce_async(jnp.full((4,), 8.0), name="b2",
+                                     op=hvt.Average)
+            out["b2"] = float(np.asarray(hvt.synchronize(h2))[0])
+            out["join_last"] = hvt.join()
+        return (r, out)
+
+    results = _run(body, np=2)
+    for r, out in results:
+        assert out["b0"] == 3.0
+        # rank 1 joined first, rank 0 last -> join() returns 0 everywhere
+        assert out["join_last"] == 0
+        if r == 0:
+            assert out["b1"] == 10.0  # rank 1 contributed zeros
+            assert out["b2"] == 4.0   # (8 + 0) / 2: zeros count in avg
+
+
+def test_worker_failure_propagates():
+    """One rank raising must fail the job with that rank's traceback
+    and terminate the peers (reference: launcher exit-code handling)."""
+
+    def body():
+        import horovod_tpu as hvt
+
+        hvt.init()
+        if hvt.rank() == 1:
+            raise RuntimeError("deliberate-worker-crash")
+        return hvt.rank()
+
+    with pytest.raises(RunError) as err:
+        _run(body, np=2)
+    assert "deliberate-worker-crash" in str(err.value)

@@ -227,6 +227,69 @@ class TestNativePythonAgreement:
                 py_fins = [c.apply_responses(py_resp) for c in py]
                 assert nat_fins == py_fins
 
+    def test_join_semantics_bytes_identical(self):
+        """Joined-rank implicit readiness, the per-set table keys, and
+        the joined-zero-contribution error texts must agree between the
+        C++ and Python controllers byte-for-byte."""
+        nat = make_pair(ncore.NativeController, size=2, fusion=1 << 10)
+        py = make_pair(fallback.PyController, size=2, fusion=1 << 10)
+        for pair in (nat, py):
+            pair[1].set_joined()
+            # rank 0 alone: sum unlocks via implicit readiness, min and
+            # int8 produce error responses, broadcast from joined root 1
+            # errors too.
+            pair[0].enqueue(1, "ok_sum", wire.ALLREDUCE, wire.RED_SUM,
+                            6, (4,))
+            pair[0].enqueue(2, "bad_min", wire.ALLREDUCE, wire.RED_MIN,
+                            6, (4,))
+            pair[0].enqueue(3, "bad_int8", wire.ALLREDUCE, wire.RED_SUM,
+                            1, (4,))
+            pair[0].enqueue(4, "bad_root", wire.BROADCAST, wire.RED_SUM,
+                            6, (4,), 0, -1, 1)
+        nat_blobs = [c.drain_requests() for c in nat]
+        py_blobs = [c.drain_requests() for c in py]
+        assert nat_blobs == py_blobs
+        for b in nat_blobs:
+            nat[0].ingest(b)
+        for b in py_blobs:
+            py[0].ingest(b)
+        nat_resp = nat[0].compute_responses()
+        py_resp = py[0].compute_responses()
+        assert nat_resp == py_resp
+        rl = wire.parse_response_list(py_resp)
+        by_name = {rs.tensor_names[0]: rs for rs in rl.responses}
+        assert by_name["ok_sum"].error == ""
+        assert "does not support joined-rank" in by_name["bad_min"].error
+        assert "int8 wire format" in by_name["bad_int8"].error
+        assert by_name["bad_root"].error == "broadcast root rank 1 has joined"
+
+    def test_per_process_set_table_keys_bytes_identical(self):
+        """Same tensor name in two disjoint sets -> two responses; C++
+        and Python must order and serialize them identically."""
+        nat = make_pair(ncore.NativeController, size=4, fusion=1 << 10)
+        py = make_pair(fallback.PyController, size=4, fusion=1 << 10)
+        for pair in (nat, py):
+            for c in pair:
+                c.register_process_set(1, [0, 2])
+                c.register_process_set(2, [1, 3])
+            pair[0].enqueue(1, "x", wire.ALLREDUCE, wire.RED_SUM, 6, (2,), 1)
+            pair[2].enqueue(1, "x", wire.ALLREDUCE, wire.RED_SUM, 6, (2,), 1)
+            pair[1].enqueue(1, "x", wire.ALLREDUCE, wire.RED_SUM, 6, (5,), 2)
+            pair[3].enqueue(1, "x", wire.ALLREDUCE, wire.RED_SUM, 6, (5,), 2)
+        nat_blobs = [c.drain_requests() for c in nat]
+        py_blobs = [c.drain_requests() for c in py]
+        assert nat_blobs == py_blobs
+        for b in nat_blobs:
+            nat[0].ingest(b)
+        for b in py_blobs:
+            py[0].ingest(b)
+        nat_resp = nat[0].compute_responses()
+        py_resp = py[0].compute_responses()
+        assert nat_resp == py_resp
+        rl = wire.parse_response_list(py_resp)
+        assert len(rl.responses) == 2
+        assert sorted(rs.process_set_id for rs in rl.responses) == [1, 2]
+
     def test_cross_impl_fleet(self):
         """Rank 0 native + rank 1 Python coordinate successfully."""
         c0 = ncore.NativeController(0, 2, 1 << 20)

@@ -1,0 +1,145 @@
+"""Launcher unit tests — command-line/env capture with no real spawn
+(reference pattern: test/single/test_run.py asserting constructed
+cmdlines and env handling via mocks; SURVEY.md §4 pattern 3).
+"""
+
+import argparse
+
+import pytest
+
+from horovod_tpu.runner import hosts as hosts_mod
+from horovod_tpu.runner import launch as launch_mod
+
+
+class TestHostParsing:
+    def test_parse_simple(self):
+        hs = hosts_mod.parse_host_spec("h1:2,h2:4")
+        assert [(h.hostname, h.slots) for h in hs] == [("h1", 2), ("h2", 4)]
+
+    def test_parse_default_slot(self):
+        hs = hosts_mod.parse_host_spec("h1,h2:3")
+        assert [(h.hostname, h.slots) for h in hs] == [("h1", 1), ("h2", 3)]
+
+    def test_parse_rejects_bad_slots(self):
+        with pytest.raises(ValueError):
+            hosts_mod.parse_host_spec("h1:0")
+        with pytest.raises(ValueError):
+            hosts_mod.parse_host_spec("")
+
+    def test_assignments_host_major(self):
+        slots = hosts_mod.get_host_assignments(
+            hosts_mod.parse_host_spec("a:2,b:2"), 4
+        )
+        assert [(s.hostname, s.rank, s.local_rank) for s in slots] == [
+            ("a", 0, 0), ("a", 1, 1), ("b", 2, 0), ("b", 3, 1)
+        ]
+        # cross communicator: ranks with the same local_rank across hosts
+        assert [(s.cross_rank, s.cross_size) for s in slots] == [
+            (0, 2), (0, 2), (1, 2), (1, 2)
+        ]
+        assert all(s.local_size == 2 and s.size == 4 for s in slots)
+
+    def test_assignments_partial_fill(self):
+        slots = hosts_mod.get_host_assignments(
+            hosts_mod.parse_host_spec("a:4,b:4"), 5
+        )
+        assert [s.hostname for s in slots] == ["a"] * 4 + ["b"]
+        assert slots[4].local_rank == 0 and slots[4].local_size == 1
+        # local_rank 0 exists on both hosts -> cross_size 2 for those
+        assert slots[0].cross_size == 2 and slots[4].cross_size == 2
+        assert slots[1].cross_size == 1  # local_rank 1 only on host a
+
+    def test_oversubscription_rejected(self):
+        with pytest.raises(ValueError, match="exceeds available slots"):
+            hosts_mod.get_host_assignments(
+                hosts_mod.parse_host_spec("a:2"), 3
+            )
+
+
+class TestParseArgs:
+    def test_minimal(self):
+        args = launch_mod.parse_args(["-np", "2", "python", "train.py"])
+        assert args.np == 2
+        assert args.command == ["python", "train.py"]
+
+    def test_flag_mirroring_and_separator(self):
+        args = launch_mod.parse_args(
+            ["-np", "4", "--fusion-threshold-mb", "32",
+             "--cycle-time-ms", "2.5", "--timeline-filename", "/tmp/t.json",
+             "--autotune", "--compression", "fp16", "--cpu-devices", "1",
+             "--", "python", "-m", "mymod"]
+        )
+        assert args.command == ["python", "-m", "mymod"]
+        assert args.fusion_threshold_mb == 32.0
+        assert args.autotune and args.compression == "fp16"
+
+    def test_np_required_without_discovery(self):
+        with pytest.raises(SystemExit):
+            launch_mod.parse_args(["python", "x.py"])
+
+    def test_command_required(self):
+        with pytest.raises(SystemExit):
+            launch_mod.parse_args(["-np", "2"])
+
+
+class TestWorkerEnv:
+    def _slot(self, rank=1):
+        return hosts_mod.SlotInfo(
+            hostname="localhost", rank=rank, size=4,
+            local_rank=rank, local_size=4, cross_rank=0, cross_size=1,
+        )
+
+    def test_env_block(self):
+        env = launch_mod.build_worker_env(
+            {"PATH": "/bin"}, self._slot(), "10.0.0.1", 9999
+        )
+        assert env["HVTPU_RANK"] == "1"
+        assert env["HVTPU_SIZE"] == "4"
+        assert env["HVTPU_LOCAL_RANK"] == "1"
+        assert env["HVTPU_COORDINATOR_ADDR"] == "10.0.0.1"
+        assert env["HVTPU_COORDINATOR_PORT"] == "9999"
+        assert env["PATH"] == "/bin"  # base env preserved
+
+    def test_flags_mirrored_to_env(self):
+        args = launch_mod.parse_args(
+            ["-np", "4", "--fusion-threshold-mb", "32",
+             "--cycle-time-ms", "2.5", "--autotune",
+             "--stall-check-time", "5", "--log-level", "debug",
+             "--cpu-devices", "2", "python", "x.py"]
+        )
+        env = launch_mod.build_worker_env({}, self._slot(), "h", 1, args)
+        assert env["HVTPU_FUSION_THRESHOLD_MB"] == "32.0"
+        assert env["HVTPU_CYCLE_TIME"] == "2.5"
+        assert env["HVTPU_AUTOTUNE"] == "1"
+        assert env["HVTPU_STALL_CHECK_TIME_SECONDS"] == "5.0"
+        assert env["HVTPU_LOG_LEVEL"] == "debug"
+        assert env["HVTPU_CPU_DEVICES"] == "2"
+        # unset flags must not leak empty env vars
+        assert "HVTPU_TIMELINE" not in env
+        assert "HVTPU_COMPRESSION" not in env
+
+
+class TestSshCommand:
+    def test_ssh_cmdline(self):
+        cmd = launch_mod.build_ssh_command(
+            "worker-3",
+            ["python", "train.py", "--lr", "0.1"],
+            {"HVTPU_RANK": "3", "HVTPU_SIZE": "8", "PATH": "/bin",
+             "JAX_PLATFORMS": "tpu", "SECRET": "x"},
+            cwd="/job",
+        )
+        assert cmd[0] == "ssh"
+        assert "worker-3" in cmd
+        remote = cmd[-1]
+        # only the framework namespace is forwarded
+        assert "HVTPU_RANK=3" in remote and "HVTPU_SIZE=8" in remote
+        assert "JAX_PLATFORMS=tpu" in remote
+        assert "SECRET" not in remote and "PATH=/bin" not in remote
+        assert remote.startswith("cd /job && env ")
+        assert remote.endswith("python train.py --lr 0.1")
+
+
+class TestFreePort:
+    def test_find_free_port(self):
+        p1 = launch_mod.find_free_port()
+        assert 1024 < p1 < 65536
