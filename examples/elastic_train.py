@@ -1,0 +1,68 @@
+"""Elastic data-parallel training example.
+
+The horovod_tpu analog of the reference's elastic examples
+(examples/elastic/pytorch/pytorch_mnist_elastic.py shape): state
+commits every epoch survive worker loss and world resizes.
+
+Run:
+  hvtpurun --host-discovery-script ./discover.sh --min-np 2 \
+      --cpu-devices 1 python examples/elastic_train.py
+where discover.sh prints e.g. "localhost:4".
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvt
+import horovod_tpu.elastic as elastic
+
+
+def main():
+    hvt.init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(512, 32).astype(np.float32)
+    w_true = rng.randn(32, 1).astype(np.float32)
+    y = x @ w_true
+
+    params = {"w": jnp.zeros((32, 1))}
+    state = elastic.JaxState(params=params, epoch=0)
+
+    @jax.jit
+    def grad_fn(p, bx, by):
+        def loss(p):
+            return jnp.mean((bx @ p["w"] - by) ** 2)
+
+        return jax.value_and_grad(loss)(p)
+
+    @elastic.run
+    def train(state):
+        while state.epoch < 8:
+            # shard batches by the CURRENT world (resizes survive)
+            n = len(x) // hvt.size()
+            lo = hvt.rank() * n
+            bx, by = jnp.asarray(x[lo:lo + n]), jnp.asarray(y[lo:lo + n])
+            loss, grads = grad_fn(state.params, bx, by)
+            grads = {
+                k: hvt.allreduce(g, op=hvt.Average)
+                for k, g in grads.items()
+            }
+            state.params = jax.tree.map(
+                lambda p, g: p - 0.3 * g, state.params, grads
+            )
+            state.epoch += 1
+            state.commit()
+            if hvt.rank() == 0:
+                print(
+                    f"epoch {state.epoch}: loss={float(loss):.5f} "
+                    f"(world size {hvt.size()})",
+                    flush=True,
+                )
+        if hvt.rank() == 0:
+            print("elastic training complete", flush=True)
+
+    train(state)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,7 @@ from typing import Optional
 import jax
 
 from . import comm, core
+from . import elastic  # noqa: F401  (hvt.elastic.State/run parity surface)
 from .api import functions as _functions
 from .api import optimizer as _optimizer
 from .api.handles import manager as _handle_manager

@@ -66,8 +66,10 @@ def broadcast_object(obj: Any, root_rank: int = 0, process_set=None) -> Any:
     pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
     payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
 
+    # uint32 size header: stays exact without jax_enable_x64 (bounds
+    # one pickled object at 4 GiB, same as the reference's int wire).
     size = eager.broadcast(
-        jnp.asarray(payload.size, jnp.int64),
+        jnp.asarray(payload.size, jnp.uint32),
         root_rank=root_rank,
         process_set=process_set,
     )
@@ -92,7 +94,7 @@ def allgather_object(obj: Any, process_set=None):
     payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
     gathered_sizes = np.asarray(
         eager.allgather(
-            jnp.asarray([payload.size], jnp.int64), process_set=process_set
+            jnp.asarray([payload.size], jnp.uint32), process_set=process_set
         )
     )
     blob = np.asarray(

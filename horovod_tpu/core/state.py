@@ -82,6 +82,15 @@ def init(config: Optional[Config] = None) -> GlobalState:
             return _state
         cfg = config or Config.from_env()
 
+        # Elastic worker: install the driver-notification (SIGUSR1)
+        # handler BEFORE the (potentially long) rendezvous below, so a
+        # membership change during startup sets the flag instead of
+        # killing the process with the default disposition.
+        if cfg.elastic:
+            from ..elastic.worker import _install_sigusr1_handler
+
+            _install_sigusr1_handler()
+
         # CPU-simulation mode (hvtpurun --cpu-devices N): this sandbox's
         # sitecustomize pre-imports jax with the TPU platform pinned, so
         # env vars are read too early — the override must go through

@@ -1,0 +1,41 @@
+"""Elastic training: dynamic world membership with commit/restore state.
+
+Parity surface: ``hvd.elastic`` — ``horovod/common/elastic.py`` (State,
+ObjectState, run), ``horovod/runner/elastic/`` (ElasticDriver,
+discovery, worker notification).  See state.py / worker.py / driver.py
+for the TPU-native restart-based design (SURVEY.md §7.2 hard part 3:
+elasticity at slice granularity with checkpoint-based resync).
+
+Worker-side usage (same shape as the reference)::
+
+    import horovod_tpu as hvt
+    import horovod_tpu.elastic as elastic
+
+    hvt.init()
+    state = elastic.JaxState(params=params, opt_state=opt_state,
+                             epoch=0, batch=0)
+
+    @elastic.run
+    def train(state):
+        while state.epoch < EPOCHS:
+            ...train one epoch from state.batch...
+            state.epoch += 1
+            state.commit()
+
+    train(state)
+
+Launcher-side: ``hvtpurun --host-discovery-script ./discover.sh
+--min-np 2 --max-np 8 python train.py``.
+"""
+
+from ..core.exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from .state import JaxState, ObjectState, State  # noqa: F401
+from .worker import RESET_EXIT_CODE, run  # noqa: F401
+
+__all__ = [
+    "State", "ObjectState", "JaxState", "run", "RESET_EXIT_CODE",
+    "HorovodInternalError", "HostsUpdatedInterrupt",
+]

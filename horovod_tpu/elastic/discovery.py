@@ -1,0 +1,89 @@
+"""Host discovery for elastic training.
+
+Parity surface: ``horovod/runner/elastic/discovery.py``
+(``HostDiscoveryScript``, ``HostManager``) — a user-provided executable
+prints the currently-available ``host:slots`` lines; the driver polls it
+on an interval and reacts to diffs, maintaining a blacklist of hosts
+that failed.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Dict, List, Optional, Set
+
+from ..runner import hosts as hosts_mod
+
+
+class HostDiscoveryScript:
+    """Runs the user's discovery script and parses its output (parity:
+    HostDiscoveryScript.find_available_hosts_and_slots)."""
+
+    def __init__(self, script: str, timeout: float = 30.0):
+        self.script = script
+        self.timeout = timeout
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.run(
+            self.script, shell=True, capture_output=True, text=True,
+            timeout=self.timeout,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"host discovery script failed ({out.returncode}): "
+                f"{out.stderr.strip()[:500]}"
+            )
+        slots: Dict[str, int] = {}
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            hs = hosts_mod.parse_host_spec(line)
+            for h in hs:
+                slots[h.hostname] = slots.get(h.hostname, 0) + h.slots
+        return slots
+
+
+class HostManager:
+    """Tracks current hosts, computes diffs, maintains the blacklist
+    (parity: HostManager + the blacklist in
+    horovod/runner/elastic/registration.py)."""
+
+    def __init__(self, discovery: HostDiscoveryScript):
+        self._discovery = discovery
+        self.current: Dict[str, int] = {}
+        self.last_found: Dict[str, int] = {}
+        self.blacklist: Set[str] = set()
+
+    def blacklist_host(self, hostname: str):
+        self.blacklist.add(hostname)
+
+    def refresh(self) -> bool:
+        """Poll discovery; returns True if the effective host set
+        changed (additions or removals, after blacklist filtering)."""
+        found = self._discovery.find_available_hosts_and_slots()
+        self.last_found = dict(found)
+        effective = {
+            h: s for h, s in found.items() if h not in self.blacklist
+        }
+        changed = effective != self.current
+        self.current = effective
+        return changed
+
+    def exhausted(self, min_np: int) -> bool:
+        """True when the last discovery succeeded yet EVERY discovered
+        host is blacklisted — hosts never leave the blacklist, so
+        unless discovery produces brand-new hosts the wait is hopeless
+        and the driver should fail fast instead of burning the full
+        elastic timeout."""
+        del min_np  # reserved for smarter policies
+        return (bool(self.last_found)
+                and all(h in self.blacklist for h in self.last_found))
+
+    def available_slots(self) -> int:
+        return sum(self.current.values())
+
+    def host_spec(self) -> str:
+        return ",".join(
+            f"{h}:{s}" for h, s in sorted(self.current.items())
+        )

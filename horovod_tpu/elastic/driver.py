@@ -1,0 +1,256 @@
+"""Launcher-side elastic driver.
+
+Parity surface: ``horovod/runner/elastic/driver.py`` (``ElasticDriver``)
++ ``horovod/runner/launch.py`` (``_run_elastic``): poll a host-discovery
+script on an interval, keep min_np ≤ world ≤ max_np workers running,
+notify workers on membership change, blacklist repeatedly-failing
+hosts, and restart the job from committed state.
+
+TPU-native mapping (restart-based elasticity, see elastic/state.py):
+instead of the reference's in-process Gloo re-rendezvous, the driver
+relaunches the whole worker set on a fresh coordination-service port;
+workers resume from the durable commit (``HVTPU_ELASTIC_STATE_DIR``).
+Driver→worker "hosts updated" notification is SIGUSR1 (the analog of
+``WorkerNotificationClient``); workers exit with ``RESET_EXIT_CODE`` at
+the next commit boundary and the driver rebuilds the world.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from ..runner import hosts as hosts_mod
+from ..runner import safe_shell_exec
+from ..runner.launch import (
+    _default_coordinator_addr,
+    build_ssh_command,
+    build_worker_env,
+    find_free_port,
+)
+from .discovery import HostDiscoveryScript, HostManager
+from .worker import RESET_EXIT_CODE
+
+# A host is blacklisted after this many consecutive crashed (not
+# reset-requested) workers (parity: registration.py blacklist policy).
+BLACKLIST_THRESHOLD = 3
+
+_TERM_CODES = (-signal.SIGTERM, 128 + signal.SIGTERM)
+# SIGUSR1 arriving before the worker installed its handler kills the
+# process with the default disposition; classify that as a reset
+# request, not a crash, so healthy hosts don't collect strikes.
+_USR1_CODES = (-signal.SIGUSR1, 128 + signal.SIGUSR1)
+
+
+class ElasticDriver:
+    """One elastic job: discovery loop + worker lifecycle + restarts."""
+
+    def __init__(
+        self,
+        command: List[str],
+        discovery: HostDiscoveryScript,
+        min_np: int,
+        max_np: Optional[int] = None,
+        discovery_interval: float = 1.0,
+        elastic_timeout: float = 600.0,
+        args: Optional[argparse.Namespace] = None,
+        state_dir: Optional[str] = None,
+        verbose: bool = False,
+    ):
+        self.command = command
+        self.hosts = HostManager(discovery)
+        self.min_np = min_np
+        self.max_np = max_np
+        self.interval = discovery_interval
+        self.elastic_timeout = elastic_timeout
+        self.args = args
+        self.state_dir = state_dir or tempfile.mkdtemp(
+            prefix="hvtpu_elastic_"
+        )
+        self.verbose = verbose
+        self._crash_counts: Dict[str, int] = {}
+
+    def _log(self, msg: str):
+        if self.verbose:
+            print(f"hvtpu.elastic.driver: {msg}", file=sys.stderr,
+                  flush=True)
+
+    def _refresh_hosts(self) -> bool:
+        """Poll discovery, swallowing transient script failures (a slow
+        or briefly-failing discovery script must not kill a healthy
+        job — the whole point of elasticity)."""
+        try:
+            return self.hosts.refresh()
+        except Exception as e:  # noqa: BLE001 — includes TimeoutExpired
+            self._log(f"discovery error (ignored): {e}")
+            return False
+
+    def _wait_for_min_hosts(self) -> bool:
+        deadline = time.monotonic() + self.elastic_timeout
+        while time.monotonic() < deadline:
+            self._refresh_hosts()
+            if self.hosts.available_slots() >= self.min_np:
+                return True
+            if self.hosts.exhausted(self.min_np):
+                # every discovered host is blacklisted and blacklists
+                # are permanent: waiting cannot help
+                self._log("all discovered hosts blacklisted; giving up")
+                return False
+            time.sleep(self.interval)
+        return False
+
+    def _spawn(self, slots: List[hosts_mod.SlotInfo], port: int
+               ) -> List[safe_shell_exec.WorkerProcess]:
+        base_env = dict(os.environ)
+        base_env["HVTPU_ELASTIC"] = "1"
+        base_env["HVTPU_ELASTIC_STATE_DIR"] = self.state_dir
+        # One coordinator address for the whole world (rank 0's host),
+        # exactly like the static launch path.
+        coordinator_addr = _default_coordinator_addr(slots)
+        workers = []
+        import threading
+
+        lock = threading.Lock()
+        for slot in slots:
+            env = build_worker_env(
+                base_env, slot, coordinator_addr, port, self.args,
+            )
+            if hosts_mod.is_local_host(slot.hostname):
+                cmd = list(self.command)
+            else:
+                cmd = build_ssh_command(
+                    slot.hostname, self.command, env, cwd=os.getcwd()
+                )
+            workers.append(
+                safe_shell_exec.WorkerProcess(
+                    slot.rank, cmd, env, stdout_lock=lock
+                )
+            )
+        return workers
+
+    def _notify_hosts_updated(self, workers):
+        self._log("hosts updated; signalling workers (SIGUSR1)")
+        for w in workers:
+            if w.poll() is None:
+                try:
+                    os.kill(w.proc.pid, signal.SIGUSR1)
+                except ProcessLookupError:
+                    pass
+
+    def run(self) -> int:
+        """Main loop (parity: ElasticDriver.start + _run_elastic)."""
+        while True:
+            if not self._wait_for_min_hosts():
+                print(
+                    f"hvtpu.elastic: fewer than min_np={self.min_np} "
+                    f"slots available for {self.elastic_timeout}s; "
+                    "giving up",
+                    file=sys.stderr,
+                )
+                return 1
+            np_now = self.hosts.available_slots()
+            if self.max_np is not None:
+                np_now = min(np_now, self.max_np)
+            spec = self.hosts.host_spec()
+            slots = hosts_mod.get_host_assignments(
+                hosts_mod.parse_host_spec(spec), np_now
+            )
+            port = find_free_port()
+            self._log(
+                f"launching {np_now} workers on {spec} (port {port})"
+            )
+            workers = self._spawn(slots, port)
+            outcome = self._supervise(workers, slots)
+            if outcome == "done":
+                return 0
+            if outcome == "failed":
+                return 1
+            # outcome == "restart": loop around, re-discover, relaunch
+
+    def _supervise(self, workers, slots) -> str:
+        """Watch one incarnation. Returns 'done' | 'restart' | 'failed'."""
+        notified = False
+        while True:
+            time.sleep(self.interval)
+            # 1. check worker exits
+            running, done_ok, reset_req, crashed = [], [], [], []
+            for w in workers:
+                code = w.poll()
+                if code is None:
+                    running.append(w)
+                elif code == 0:
+                    done_ok.append(w)
+                elif code == RESET_EXIT_CODE or code in _USR1_CODES:
+                    reset_req.append(w)
+                elif code in _TERM_CODES and notified:
+                    reset_req.append(w)
+                else:
+                    crashed.append((w, code))
+            if not running:
+                if crashed or reset_req:
+                    return self._finish_incarnation(workers, slots, crashed)
+                return "done"
+            if crashed or reset_req:
+                # A peer is gone: remaining workers would stall in
+                # collectives. Tell them to reset at the commit
+                # boundary, then escalate to SIGTERM.
+                return self._finish_incarnation(workers, slots, crashed)
+            # 2. poll discovery for membership changes
+            if self._refresh_hosts() and not notified:
+                cur = self.hosts.available_slots()
+                if cur != len(slots) and cur >= 1:
+                    self._notify_hosts_updated(workers)
+                    notified = True
+
+    def _finish_incarnation(self, workers, slots, crashed) -> str:
+        by_rank_host = {s.rank: s.hostname for s in slots}
+        for w, code in crashed:
+            host = by_rank_host.get(w.rank, "?")
+            self._crash_counts[host] = self._crash_counts.get(host, 0) + 1
+            self._log(
+                f"rank {w.rank} on {host} crashed with {code} "
+                f"({self._crash_counts[host]} strikes)"
+            )
+            if self._crash_counts[host] >= BLACKLIST_THRESHOLD:
+                self._log(f"blacklisting {host}")
+                self.hosts.blacklist_host(host)
+        # grace period for the rest to exit at a commit boundary
+        self._notify_hosts_updated(workers)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if all(w.poll() is not None for w in workers):
+                break
+            time.sleep(0.2)
+        for w in workers:
+            w.terminate()
+        for w in workers:
+            try:
+                w.wait(timeout=10)
+            except Exception:
+                pass
+        return "restart"
+
+
+def run_elastic(args: argparse.Namespace) -> int:
+    """Entry from ``hvtpurun --host-discovery-script ...`` (parity:
+    launch.py _run_elastic)."""
+    discovery = HostDiscoveryScript(args.host_discovery_script)
+    driver = ElasticDriver(
+        command=args.command,
+        discovery=discovery,
+        min_np=args.min_np or args.np or 1,
+        max_np=args.max_np,
+        discovery_interval=(
+            float(os.environ.get("HVTPU_ELASTIC_DISCOVERY_INTERVAL", 0)
+                  or 1.0)
+        ),
+        elastic_timeout=args.elastic_timeout or 600.0,
+        args=args,
+        verbose=args.verbose,
+    )
+    return driver.run()

@@ -1,0 +1,206 @@
+"""Elastic state objects: commit / restore / sync.
+
+Parity surface: ``horovod/common/elastic.py`` (``State``, ``ObjectState``)
+— user-visible training state that can be committed at batch boundaries,
+rolled back after a failure, and synchronized to newly-joined workers.
+
+TPU-native departure (SURVEY.md §7.2 hard part 3): the JAX coordination
+service cannot resize a live world, so elastic reconfiguration is
+**restart-based**: the elastic driver relaunches workers on membership
+change, and ``commit()`` therefore persists a snapshot to a durable
+per-job directory (``HVTPU_ELASTIC_STATE_DIR``, set by the driver) in
+addition to the in-memory copy the reference keeps.  ``sync()`` after a
+restart loads the newest committed snapshot and broadcasts it from the
+lowest rank that has one — the "checkpoint-based resync" idiom the
+survey prescribes for TPU slices (orbax-style rank-0 checkpointing).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import tempfile
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core import state as core_state
+from ..core.exceptions import HostsUpdatedInterrupt
+
+
+def _state_dir() -> Optional[str]:
+    return os.environ.get("HVTPU_ELASTIC_STATE_DIR") or None
+
+
+def _commit_path(dirname: str) -> str:
+    return os.path.join(dirname, "state_commit.pkl")
+
+
+class State:
+    """Base elastic state (parity: horovod/common/elastic.py State).
+
+    Subclasses implement ``save``/``restore_impl``/``sync_impl`` over
+    their payload; this base owns commit bookkeeping, reset callbacks,
+    and the host-update check raised at commit boundaries.
+    """
+
+    def __init__(self):
+        self._reset_callbacks: List[Callable[[], None]] = []
+        self._host_messages = _HostUpdateFlag.instance()
+        self._synced = False
+
+    def register_reset_callbacks(self, callbacks):
+        """Parity: State.register_reset_callbacks — called after a world
+        reconfiguration so the user can rebuild derived objects
+        (e.g. learning-rate schedules that depend on world size)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self._synced = False
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self):
+        """Snapshot state (memory + durable dir) then check for host
+        updates (parity: State.commit = save + check_host_updates)."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        """Raise HostsUpdatedInterrupt at a commit boundary if the
+        driver signalled a membership change (SIGUSR1-based analog of
+        the reference's WorkerNotificationManager)."""
+        if self._host_messages.consume():
+            raise HostsUpdatedInterrupt(skip_sync=False)
+
+    # -- overridable payload hooks --
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+
+class _HostUpdateFlag:
+    """Process-wide flag set by the elastic worker signal handler
+    (horovod_tpu.elastic.worker installs it); consumed at commit."""
+
+    _inst: Optional["_HostUpdateFlag"] = None
+
+    def __init__(self):
+        self.flag = False
+
+    @classmethod
+    def instance(cls) -> "_HostUpdateFlag":
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+    def set(self):
+        self.flag = True
+
+    def consume(self) -> bool:
+        f, self.flag = self.flag, False
+        return f
+
+
+class ObjectState(State):
+    """Elastic state holding arbitrary picklable attributes (parity:
+    horovod/common/elastic.py ObjectState): ``state.epoch``,
+    ``state.batch`` etc. become tracked attributes."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._saved: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._tracked = list(kwargs)
+        self.save_to_memory()
+
+    # -- payload capture --
+    def _capture(self) -> Dict[str, Any]:
+        return {k: copy.deepcopy(getattr(self, k)) for k in self._tracked}
+
+    def _apply(self, payload: Dict[str, Any]):
+        for k, v in payload.items():
+            setattr(self, k, v)
+
+    def save_to_memory(self):
+        self._saved = self._capture()
+
+    def save(self):
+        self.save_to_memory()
+        d = _state_dir()
+        if d and core_state.global_state().rank == 0:
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(self._to_disk_payload(), f)
+            os.replace(tmp, _commit_path(d))
+
+    def restore(self):
+        """Roll back to the last commit (parity: State.restore after
+        HorovodInternalError)."""
+        self._apply(copy.deepcopy(self._saved))
+        self.on_reset()
+
+    def sync(self):
+        """Make every rank identical: after a restart, load the durable
+        commit (if any) on rank 0, then broadcast rank 0's payload
+        (parity: ObjectState.sync broadcasting from rank 0)."""
+        from ..api import functions as api_functions
+
+        st = core_state.require_init("elastic state sync")
+        if st.rank == 0:
+            d = _state_dir()
+            if d and os.path.exists(_commit_path(d)) and not self._synced:
+                with open(_commit_path(d), "rb") as f:
+                    self._from_disk_payload(pickle.load(f))
+        payload = api_functions.broadcast_object(
+            self._capture(), root_rank=0
+        )
+        self._apply(payload)
+        self.save_to_memory()
+        self._synced = True
+
+    # -- disk representation hooks (subclasses with non-picklable
+    #    payloads override these) --
+    def _to_disk_payload(self):
+        return self._capture()
+
+    def _from_disk_payload(self, payload):
+        self._apply(payload)
+
+
+class JaxState(ObjectState):
+    """Elastic state for JAX training loops: tracked attributes may be
+    pytrees of jax Arrays (params, optimizer state) alongside plain
+    Python scalars.  TPU-native analog of the reference's per-framework
+    TorchState/TensorFlowKerasState.
+
+    Arrays are pulled to host numpy for the durable snapshot so a
+    restarted world (possibly a different device count) can load it.
+    """
+
+    def _to_disk_payload(self):
+        import jax
+        import numpy as np
+
+        return jax.tree.map(
+            lambda x: np.asarray(x) if hasattr(x, "dtype") else x,
+            self._capture(),
+        )
+
+    def _from_disk_payload(self, payload):
+        import jax.numpy as jnp
+
+        def back(x):
+            import numpy as np
+
+            return jnp.asarray(x) if isinstance(x, np.ndarray) else x
+
+        import jax
+
+        self._apply(jax.tree.map(back, payload))

@@ -1,0 +1,106 @@
+"""Worker-side elastic machinery: the ``@hvt.elastic.run`` decorator and
+the driver-notification channel.
+
+Parity surface: ``horovod/common/elastic.py`` (``run_fn``) and
+``horovod/runner/elastic/worker.py`` (``WorkerNotificationManager``).
+
+TPU-native mapping: the reference keeps worker processes alive across
+membership changes and re-runs Gloo rendezvous in-process; the JAX
+coordination service cannot do that, so reconfiguration is
+**restart-based** (see elastic/state.py).  The decorator therefore
+terminates the process with a dedicated exit code when the world must
+change, and the elastic driver relaunches everyone; committed state is
+reloaded through ``state.sync()`` in the fresh incarnation.  Driver →
+worker notification rides SIGUSR1 instead of the reference's HTTP
+notification service — same commit-boundary semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import signal
+import sys
+
+from ..core import state as core_state
+from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from .state import State, _HostUpdateFlag
+
+# Exit code the driver interprets as "re-rendezvous requested" (worker
+# hit a recoverable elastic event); anything else non-zero is a crash.
+RESET_EXIT_CODE = 73
+
+
+def _install_sigusr1_handler():
+    """SIGUSR1 from the driver == 'hosts updated' (parity: the
+    WorkerNotificationService HTTP callback setting the host flag)."""
+
+    def handler(signum, frame):
+        _HostUpdateFlag.instance().set()
+
+    try:
+        signal.signal(signal.SIGUSR1, handler)
+    except ValueError:
+        # non-main thread (e.g. tests importing under a runner thread):
+        # notifications degrade to driver-initiated restarts only.
+        pass
+
+
+def run(func):
+    """Decorator for elastic training functions (parity:
+    ``hvd.elastic.run`` / run_fn).
+
+    Usage::
+
+        @hvt.elastic.run
+        def train(state, ...):
+            while state.epoch < epochs:
+                ...
+                state.commit()
+
+    On ``HorovodInternalError`` (a peer died mid-collective) the state
+    rolls back to the last commit and the process exits with
+    RESET_EXIT_CODE so the driver rebuilds the world; on
+    ``HostsUpdatedInterrupt`` (driver signalled membership change) the
+    current (committed) state stands and the process exits likewise.
+    In the relaunched incarnation ``state.sync()`` restores progress
+    from the durable commit.
+    """
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        _install_sigusr1_handler()
+        if not core_state.initialized():
+            raise RuntimeError(
+                "hvt.init() must be called before an elastic run"
+            )
+        try:
+            state.sync()
+            return func(state, *args, **kwargs)
+        except HorovodInternalError:
+            # Peer loss mid-collective: roll back so the durable commit
+            # reflects the last good step, then ask for a new world.
+            state.restore()
+            _exit_for_reset("collective failure")
+        except HostsUpdatedInterrupt:
+            _exit_for_reset("hosts updated")
+
+    return wrapper
+
+
+def _exit_for_reset(reason: str):
+    print(
+        f"hvtpu.elastic: requesting world reset ({reason}); "
+        f"exiting {RESET_EXIT_CODE} for driver relaunch",
+        file=sys.stderr,
+        flush=True,
+    )
+    try:
+        core_state.shutdown()
+    except Exception:
+        pass
+    # os._exit: the coordination client's channels may be wedged (peer
+    # death); a normal exit could hang in atexit grpc teardown.
+    sys.stderr.flush()
+    sys.stdout.flush()
+    os._exit(RESET_EXIT_CODE)

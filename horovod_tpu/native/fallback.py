@@ -249,13 +249,13 @@ class PyController:
                             and e.root_rank in self._joined_ranks):
                         rs.error = (f"broadcast root rank {e.root_rank} "
                                     "has joined")
-                    elif (e.type == wire.ALLREDUCE
+                    elif (e.type in (wire.ALLREDUCE, wire.REDUCESCATTER)
                           and e.red_op in (wire.RED_MIN, wire.RED_MAX,
                                            wire.RED_PRODUCT,
                                            wire.RED_ADASUM)):
                         rs.error = (f"reduction op {e.red_op} does not "
                                     "support joined-rank zero contribution")
-                    elif (e.type == wire.ALLREDUCE
+                    elif (e.type in (wire.ALLREDUCE, wire.REDUCESCATTER)
                           and e.dtype == wire.DTYPE_IDS["int8"]):
                         rs.error = ("int8 wire format does not support "
                                     "joined-rank zero contribution")

@@ -314,14 +314,16 @@ ResponseList Controller::BuildResponseList() {
           !pc.ranks.count(e.root_rank) && joined_ranks_.count(e.root_rank)) {
         rs.error = "broadcast root rank " + std::to_string(e.root_rank) +
                    " has joined";
-      } else if (e.type == OpType::kAllreduce &&
+      } else if ((e.type == OpType::kAllreduce ||
+                  e.type == OpType::kReducescatter) &&
                  (e.red_op == RedOp::kMin || e.red_op == RedOp::kMax ||
                   e.red_op == RedOp::kProduct ||
                   e.red_op == RedOp::kAdasum)) {
         rs.error = "reduction op " +
                    std::to_string(static_cast<int>(e.red_op)) +
                    " does not support joined-rank zero contribution";
-      } else if (e.type == OpType::kAllreduce &&
+      } else if ((e.type == OpType::kAllreduce ||
+                  e.type == OpType::kReducescatter) &&
                  e.dtype == DataType::kInt8) {
         rs.error =
             "int8 wire format does not support joined-rank zero "

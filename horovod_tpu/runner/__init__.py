@@ -56,7 +56,7 @@ def run(
     cpu_devices: Optional[int] = None,
     env: Optional[Dict[str, str]] = None,
     timeout: Optional[float] = 600.0,
-    start_timeout: Optional[float] = None,  # deprecated alias of timeout
+    start_timeout: Optional[float] = None,  # rendezvous window (env)
     extra_flags: Optional[List[str]] = None,
     verbose: bool = False,
 ) -> List[Any]:
@@ -70,11 +70,11 @@ def run(
     mode; SURVEY.md §4 pattern 2).  ``timeout`` is a hard deadline for
     the whole job (None = unlimited) — unlike ``hvtpurun``, the
     programmatic API defaults to bounded so test harnesses can't hang.
+    ``start_timeout`` only bounds the workers' rendezvous window
+    (parity: horovod.run's start_timeout), not job duration.
     """
     from . import launch as launch_mod
 
-    if start_timeout is not None:
-        timeout = start_timeout
     with tempfile.TemporaryDirectory(prefix="hvtpurun_") as tmp:
         fn_path = os.path.join(tmp, "fn.pkl")
         out_dir = os.path.join(tmp, "results")
@@ -85,6 +85,8 @@ def run(
             argv += ["--cpu-devices", str(cpu_devices)]
         if verbose:
             argv += ["--verbose"]
+        if start_timeout is not None:
+            argv += ["--start-timeout", str(start_timeout)]
         argv += extra_flags or []
         argv += [
             sys.executable, "-m", "horovod_tpu.runner.run_task",

@@ -37,9 +37,21 @@ def find_free_port(bind_addr: str = "127.0.0.1") -> int:
 
 
 def _default_coordinator_addr(slots: List[SlotInfo]) -> str:
-    """Address workers use to reach rank 0's coordination service."""
+    """Address workers use to reach rank 0's coordination service.
+
+    Loopback is only usable when EVERY worker is local; a mixed spec
+    needs a routable address the user must provide
+    (--network-interface), since guessing NICs silently hangs remote
+    workers until the rendezvous timeout.
+    """
     host0 = slots[0].hostname
     if hosts_mod.is_local_host(host0):
+        if any(not hosts_mod.is_local_host(s.hostname) for s in slots):
+            raise ValueError(
+                "rank 0 is on localhost but other workers are remote; "
+                "pass --network-interface with an address remote hosts "
+                "can reach"
+            )
         return "127.0.0.1"
     return host0
 

@@ -88,6 +88,7 @@ from .functions import (  # noqa: E402
 )
 from .optimizer import DistributedOptimizer  # noqa: E402
 from .sync_batch_norm import SyncBatchNorm  # noqa: E402
+from . import elastic  # noqa: E402  (hvd.elastic.TorchState parity)
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",

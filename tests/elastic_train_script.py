@@ -1,0 +1,56 @@
+"""Elastic training script used by the fault-injection integration
+tests (the analog of the reference's test/integration/elastic_common.py
+worker script).  Trains EPOCHS epochs with a commit per epoch; can
+crash a given rank once at a given epoch (marker-file gated).
+"""
+
+import os
+import sys
+import time
+
+import horovod_tpu as hvt
+import horovod_tpu.elastic as elastic
+
+
+def main():
+    hvt.init()
+    epochs = int(os.environ.get("ELASTIC_EPOCHS", "6"))
+    sleep_s = float(os.environ.get("EPOCH_SLEEP", "0.3"))
+    state = elastic.ObjectState(epoch=0, total=0.0)
+
+    @elastic.run
+    def train(state):
+        import jax.numpy as jnp
+
+        while state.epoch < epochs:
+            out = hvt.allreduce(jnp.ones(4), op=hvt.Sum)
+            state.total += float(out[0])
+            if hvt.rank() == 0:
+                print(
+                    f"EPOCH epoch={state.epoch} size={hvt.size()} "
+                    f"total={state.total}",
+                    flush=True,
+                )
+            crash_marker = os.environ.get("CRASH_MARKER")
+            if (
+                crash_marker
+                and hvt.rank() == int(os.environ.get("CRASH_RANK", "1"))
+                and state.epoch == int(os.environ.get("CRASH_EPOCH", "2"))
+                and not os.path.exists(crash_marker)
+            ):
+                open(crash_marker, "w").close()
+                print(f"CRASHING rank={hvt.rank()}", file=sys.stderr,
+                      flush=True)
+                os._exit(1)
+            state.epoch += 1
+            time.sleep(sleep_s)
+            state.commit()
+        if hvt.rank() == 0:
+            print(f"DONE size={hvt.size()} epoch={state.epoch}",
+                  flush=True)
+
+    train(state)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""Elastic training tests.
+
+Unit layer (reference pattern: test/single/test_elastic_driver.py —
+fake discovery scripts writing host lists to tmp files, no real
+cluster): state commit/restore, discovery parsing, host manager
+blacklist.  Integration layer lives in test_elastic_integration.py.
+"""
+
+import os
+
+import pytest
+
+import horovod_tpu as hvt
+import horovod_tpu.elastic as elastic
+from horovod_tpu.elastic.discovery import HostDiscoveryScript, HostManager
+
+
+class TestObjectState:
+    def test_commit_restore_roundtrip(self, hvt):
+        state = elastic.ObjectState(epoch=0, batch=0, items=[1, 2])
+        state.epoch = 3
+        state.batch = 7
+        state.items.append(3)
+        state.commit()
+        state.epoch = 99
+        state.items.append(99)
+        state.restore()
+        assert state.epoch == 3 and state.batch == 7
+        assert state.items == [1, 2, 3]
+
+    def test_restore_without_commit_returns_initial(self, hvt):
+        state = elastic.ObjectState(epoch=5)
+        state.epoch = 10
+        state.restore()
+        assert state.epoch == 5
+
+    def test_reset_callbacks_fire_on_restore(self, hvt):
+        state = elastic.ObjectState(epoch=0)
+        fired = []
+        state.register_reset_callbacks([lambda: fired.append(1)])
+        state.commit()
+        state.restore()
+        assert fired == [1]
+
+    def test_commit_persists_to_state_dir(self, hvt, tmp_path,
+                                          monkeypatch):
+        monkeypatch.setenv("HVTPU_ELASTIC_STATE_DIR", str(tmp_path))
+        state = elastic.ObjectState(epoch=0)
+        state.epoch = 4
+        state.commit()
+        assert (tmp_path / "state_commit.pkl").exists()
+        # a fresh state syncs from the durable commit
+        state2 = elastic.ObjectState(epoch=0)
+        state2.sync()
+        assert state2.epoch == 4
+
+    def test_jax_state_roundtrips_arrays(self, hvt, tmp_path,
+                                         monkeypatch):
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("HVTPU_ELASTIC_STATE_DIR", str(tmp_path))
+        params = {"w": jnp.arange(4.0), "b": jnp.zeros(2)}
+        state = elastic.JaxState(params=params, epoch=1)
+        state.commit()
+        state.params = {"w": jnp.zeros(4), "b": jnp.ones(2)}
+        state.restore()
+        assert float(state.params["w"][3]) == 3.0
+        fresh = elastic.JaxState(params={"w": jnp.zeros(4),
+                                         "b": jnp.zeros(2)}, epoch=0)
+        fresh.sync()
+        assert fresh.epoch == 1
+        assert float(fresh.params["w"][2]) == 2.0
+
+    def test_host_update_flag_raises_at_commit(self, hvt):
+        from horovod_tpu.elastic.state import _HostUpdateFlag
+
+        state = elastic.ObjectState(epoch=0)
+        _HostUpdateFlag.instance().set()
+        with pytest.raises(elastic.HostsUpdatedInterrupt):
+            state.commit()
+        # flag consumed: next commit is clean
+        state.commit()
+
+
+class TestTorchState:
+    def test_model_optimizer_roundtrip(self, hvt):
+        import torch
+
+        from horovod_tpu.torch.elastic import TorchState
+
+        model = torch.nn.Linear(3, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        state = TorchState(model=model, optimizer=opt, epoch=0)
+        w0 = model.weight.detach().clone()
+        state.commit()
+        with torch.no_grad():
+            model.weight += 1.0
+        state.epoch = 9
+        state.restore()
+        assert torch.allclose(model.weight, w0)
+        assert state.epoch == 0
+
+    def test_elastic_sampler_reshards_and_skips(self, hvt):
+        from horovod_tpu.torch.elastic import ElasticSampler
+
+        data = list(range(20))
+        s = ElasticSampler(data, shuffle=False)
+        assert len(s) == 20  # world size 1
+        s.record_batch(0, 4)
+        sd = s.state_dict()
+        s2 = ElasticSampler(data, shuffle=False)
+        s2.load_state_dict(sd)
+        assert len(s2) == 16
+        assert set(iter(s2)).isdisjoint(set(range(4)))
+
+
+class TestDiscovery:
+    def _script(self, tmp_path, content):
+        p = tmp_path / "discover.sh"
+        p.write_text(f"#!/bin/sh\n{content}\n")
+        p.chmod(0o755)
+        return str(p)
+
+    def test_parse_hosts_and_slots(self, tmp_path):
+        script = self._script(
+            tmp_path, 'echo "hostA:2"; echo "hostB:3"; echo "hostA:1"'
+        )
+        d = HostDiscoveryScript(script)
+        assert d.find_available_hosts_and_slots() == {
+            "hostA": 3, "hostB": 3
+        }
+
+    def test_script_failure_raises(self, tmp_path):
+        script = self._script(tmp_path, "echo boom >&2; exit 3")
+        with pytest.raises(RuntimeError, match="boom"):
+            HostDiscoveryScript(script).find_available_hosts_and_slots()
+
+    def test_host_manager_diff_and_blacklist(self, tmp_path):
+        hosts_file = tmp_path / "hosts.txt"
+        hosts_file.write_text("a:2\nb:2\n")
+        script = self._script(tmp_path, f'cat "{hosts_file}"')
+        mgr = HostManager(HostDiscoveryScript(script))
+        assert mgr.refresh() is True  # {} -> {a,b}
+        assert mgr.available_slots() == 4
+        assert mgr.refresh() is False  # unchanged
+        hosts_file.write_text("a:2\n")
+        assert mgr.refresh() is True
+        assert mgr.host_spec() == "a:2"
+        mgr.blacklist_host("a")
+        hosts_file.write_text("a:2\nb:1\n")
+        assert mgr.refresh() is True
+        assert mgr.available_slots() == 1  # a filtered out
+        assert mgr.host_spec() == "b:1"

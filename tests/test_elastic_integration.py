@@ -1,0 +1,112 @@
+"""Elastic fault-injection integration tests (reference pattern:
+test/integration/elastic_common.py — launch real `horovodrun
+--host-discovery-script` jobs on localhost, kill workers / mutate the
+discovery output mid-run, assert recovery).
+
+Here: `hvtpurun --host-discovery-script` with CPU workers.  World
+reconfiguration is restart-based (see horovod_tpu/elastic/): workers
+exit RESET_EXIT_CODE at commit boundaries and the driver relaunches
+them; progress resumes from the durable commit.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import horovod_tpu
+
+pytestmark = pytest.mark.multiprocess
+
+_REPO = os.path.dirname(os.path.dirname(horovod_tpu.__file__))
+_SCRIPT = os.path.join(_REPO, "tests", "elastic_train_script.py")
+
+
+def _make_discovery(tmp_path, spec: str):
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text(spec + "\n")
+    script = tmp_path / "discover.sh"
+    script.write_text(f'#!/bin/sh\ncat "{hosts_file}"\n')
+    script.chmod(0o755)
+    return hosts_file, str(script)
+
+
+def _launch(discovery_script, extra_env=None, min_np=2, max_np=None,
+            epochs=6, sleep_s=0.3):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_EPOCHS"] = str(epochs)
+    env["EPOCH_SLEEP"] = str(sleep_s)
+    env["HVTPU_ELASTIC_DISCOVERY_INTERVAL"] = "0.2"
+    env.update(extra_env or {})
+    cmd = [
+        sys.executable, "-m", "horovod_tpu.runner",
+        "--host-discovery-script", discovery_script,
+        "--min-np", str(min_np),
+        "--cpu-devices", "1", "--verbose",
+    ]
+    if max_np:
+        cmd += ["--max-np", str(max_np)]
+    cmd += ["--", sys.executable, _SCRIPT]
+    return subprocess.Popen(
+        cmd, env=env, cwd=_REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def test_worker_crash_recovers_from_commit(tmp_path):
+    """Kill one worker mid-run (self-crash, one incarnation only): the
+    driver must relaunch and training must RESUME from the committed
+    epoch, not restart from zero."""
+    _, disc = _make_discovery(tmp_path, "localhost:2")
+    marker = tmp_path / "crashed.marker"
+    proc = _launch(
+        disc,
+        extra_env={
+            "CRASH_MARKER": str(marker),
+            "CRASH_RANK": "1",
+            "CRASH_EPOCH": "2",
+        },
+        min_np=2, epochs=5,
+    )
+    out, _ = proc.communicate(timeout=240)
+    assert proc.returncode == 0, out[-3000:]
+    assert marker.exists(), "crash injection never fired"
+    # worker output arrives rank-prefixed ("[0]<stdout>:EPOCH ...")
+    epochs_seen = [
+        int(ln.split("epoch=")[1].split()[0])
+        for ln in out.splitlines() if "EPOCH epoch=" in ln
+    ]
+    # the crash happened at epoch 2; the relaunched incarnation must
+    # resume from the commit (>= 2), never replay epochs 0/1
+    crash_at = epochs_seen.index(2)
+    assert all(e >= 2 for e in epochs_seen[crash_at:]), out[-3000:]
+    assert epochs_seen[0] == 0, out[-3000:]  # first incarnation from 0
+    assert "DONE size=2 epoch=5" in out, out[-3000:]
+
+
+def test_discovery_shrink_resizes_world(tmp_path):
+    """Rewrite the discovery output mid-run (3 -> 2 slots): the driver
+    must notify workers (SIGUSR1), relaunch at the new size, and the
+    job must finish with size=2 while keeping committed progress."""
+    hosts_file, disc = _make_discovery(tmp_path, "localhost:3")
+    proc = _launch(disc, min_np=2, epochs=10, sleep_s=0.4)
+    shrunk = False
+    lines = []
+    start = time.monotonic()
+    for line in proc.stdout:
+        lines.append(line.rstrip())
+        if not shrunk and "EPOCH epoch=1 " in line:
+            hosts_file.write_text("localhost:2\n")
+            shrunk = True
+        if time.monotonic() - start > 240:
+            proc.kill()
+            pytest.fail("timeout:\n" + "\n".join(lines[-40:]))
+    proc.wait(timeout=30)
+    out = "\n".join(lines)
+    assert proc.returncode == 0, out[-3000:]
+    assert shrunk, out[-2000:]
+    assert any("size=3" in ln for ln in lines), out[-3000:]
+    assert "DONE size=2 epoch=10" in out, out[-3000:]
