@@ -1,0 +1,120 @@
+"""Shared Keras implementation (parity: horovod/_keras/__init__.py).
+
+``create_distributed_optimizer`` uses the reference's dynamic-subclass
+trick: build a subclass of the user's optimizer class that allreduces
+gradients in ``apply`` before delegating to the original math, then
+rebuild the instance ``from_config``.  Works for Keras 3 (``apply`` is
+the single funnel ``apply_gradients`` and ``model.fit`` go through).
+
+``backward_passes_per_step > 1`` implements local gradient aggregation
+(parity: horovod/tensorflow/aggregation_helper.py
+LocalGradientAggregationHelper): gradients accumulate in tf.Variables
+for N micro-steps; every N-th step the accumulated (optionally
+averaged) gradient is allreduced and applied, other steps apply zeros
+so optimizer bookkeeping (iterations) still advances.  Sparse
+IndexedSlices gradients are densified when aggregating.
+"""
+
+from __future__ import annotations
+
+
+def create_distributed_optimizer(optimizer, name=None, compression=None,
+                                 op=None, gradient_predivide_factor=1.0,
+                                 backward_passes_per_step=1,
+                                 average_aggregated_gradients=True,
+                                 process_set=None):
+    import tensorflow as tf
+
+    from ..tensorflow import Average, allreduce
+    from ..tensorflow.compression import Compression
+    from ..tensorflow.mpi_ops import predivide_scaling
+
+    compression = compression or Compression.none
+    op = op if op is not None else Average
+    bpps = int(backward_passes_per_step)
+    if bpps < 1:
+        raise ValueError(
+            f"backward_passes_per_step must be >= 1, got {bpps}"
+        )
+
+    base_cls = optimizer.__class__
+
+    class _DistributedOptimizer(base_cls):
+        """Allreduce-averaging subclass (parity: _keras
+        create_distributed_optimizer's generated class)."""
+
+        _hvtpu_distributed = True
+        _hvtpu_backward_passes_per_step = bpps
+
+        def apply(self, grads, trainable_variables=None, **kwargs):
+            grads = list(grads)
+            if bpps == 1:
+                grads = self._hvtpu_allreduce_grads(grads)
+                return super().apply(grads, trainable_variables, **kwargs)
+            eff = self._hvtpu_aggregate(grads)
+            return super().apply(eff, trainable_variables, **kwargs)
+
+        def _hvtpu_allreduce_grads(self, grads):
+            eff_op, prescale, postscale = predivide_scaling(
+                op, gradient_predivide_factor, process_set
+            )
+            out = []
+            for g in grads:
+                if g is None:
+                    out.append(None)
+                    continue
+                out.append(allreduce(
+                    g, op=eff_op, compression=compression,
+                    prescale_factor=prescale, postscale_factor=postscale,
+                    process_set=process_set,
+                ))
+            return out
+
+        def _hvtpu_aggregate(self, grads):
+            import tensorflow as tf
+
+            if not hasattr(self, "_hvtpu_acc"):
+                self._hvtpu_counter = tf.Variable(
+                    0, dtype=tf.int64, trainable=False,
+                    name="hvtpu_agg_counter",
+                )
+                self._hvtpu_acc = [
+                    None if g is None else tf.Variable(
+                        tf.zeros_like(tf.convert_to_tensor(g)),
+                        trainable=False, name=f"hvtpu_agg_{i}",
+                    )
+                    for i, g in enumerate(grads)
+                ]
+            self._hvtpu_counter.assign_add(1)
+            for acc, g in zip(self._hvtpu_acc, grads):
+                if acc is not None and g is not None:
+                    acc.assign_add(tf.convert_to_tensor(g))
+            is_sync = tf.equal(self._hvtpu_counter % bpps, 0)
+            live_acc = [a for a in self._hvtpu_acc if a is not None]
+
+            def do_sync():
+                gs = [a.read_value() for a in live_acc]
+                if average_aggregated_gradients:
+                    gs = [g / float(bpps) for g in gs]
+                gs = self._hvtpu_allreduce_grads(gs)
+                with tf.control_dependencies(gs):
+                    resets = [a.assign(tf.zeros_like(a)) for a in live_acc]
+                with tf.control_dependencies(resets):
+                    return [tf.identity(g) for g in gs]
+
+            def no_sync():
+                # zeros keep super().apply's bookkeeping advancing
+                # without moving variables
+                return [tf.zeros_like(a) for a in live_acc]
+
+            synced = tf.cond(is_sync, do_sync, no_sync)
+            out, it = [], iter(synced)
+            for a in self._hvtpu_acc:
+                out.append(None if a is None else next(it))
+            return out
+
+    _DistributedOptimizer.__name__ = "Distributed" + base_cls.__name__
+    config = optimizer.get_config()
+    if name is not None:
+        config["name"] = name
+    return _DistributedOptimizer.from_config(config)

@@ -1,0 +1,90 @@
+"""Keras frontend (parity: ``horovod/keras/__init__.py`` +
+``horovod/tensorflow/keras/``): ``hvd.DistributedOptimizer`` for keras
+optimizers, callbacks, and the shared engine surface.
+
+Usage (only the import changes vs. the reference)::
+
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+    opt = keras.optimizers.SGD(0.01 * hvd.size())
+    opt = hvd.DistributedOptimizer(opt)
+    model.compile(optimizer=opt, ...)
+    model.fit(..., callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+    ])
+"""
+
+from __future__ import annotations
+
+import horovod_tpu as _hvt
+
+from ..tensorflow import (  # noqa: F401
+    Adasum,
+    Average,
+    Compression,
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    Max,
+    Min,
+    Product,
+    ProcessSet,
+    Sum,
+    add_process_set,
+    allgather,
+    allgather_object,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    broadcast_object,
+    broadcast_variables,
+    ccl_built,
+    cross_rank,
+    cross_size,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    grouped_allreduce,
+    init,
+    is_initialized,
+    join,
+    local_rank,
+    local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rank,
+    remove_process_set,
+    rocm_built,
+    shutdown,
+    size,
+    start_timeline,
+    stop_timeline,
+    xla_built,
+)
+from . import callbacks  # noqa: F401
+
+
+def DistributedOptimizer(optimizer, name=None,
+                         device_dense="", device_sparse="",
+                         compression=Compression.none,
+                         sparse_as_dense=False, op=Average,
+                         gradient_predivide_factor: float = 1.0,
+                         backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = True,
+                         process_set=None):
+    """Wrap a keras optimizer with gradient allreduce (parity:
+    horovod.keras.DistributedOptimizer)."""
+    from .._keras import create_distributed_optimizer
+
+    return create_distributed_optimizer(
+        optimizer, name=name, compression=compression, op=op,
+        gradient_predivide_factor=gradient_predivide_factor,
+        backward_passes_per_step=backward_passes_per_step,
+        average_aggregated_gradients=average_aggregated_gradients,
+        process_set=process_set,
+    )

@@ -1,0 +1,261 @@
+"""TensorFlow frontend: the ``horovod.tensorflow``-compatible surface
+on the TPU engine.
+
+Parity surface: ``horovod/tensorflow/__init__.py`` —
+``hvd.init/rank/size``, eager+graph collectives (mpi_ops.py here),
+``DistributedGradientTape``, ``DistributedOptimizer``,
+``broadcast_variables``, object helpers, ``Compression``.  A tf.keras
+user switches with only the import line changed
+(``import horovod.tensorflow as hvd`` →
+``import horovod_tpu.tensorflow as hvd``).
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+import horovod_tpu as _hvt
+
+# ---- lifecycle / topology (shared engine state) ----
+init = _hvt.init
+shutdown = _hvt.shutdown
+is_initialized = _hvt.is_initialized
+rank = _hvt.rank
+size = _hvt.size
+local_rank = _hvt.local_rank
+local_size = _hvt.local_size
+cross_rank = _hvt.cross_rank
+cross_size = _hvt.cross_size
+mpi_enabled = _hvt.mpi_enabled
+mpi_built = _hvt.mpi_built
+mpi_threads_supported = _hvt.mpi_threads_supported
+gloo_enabled = _hvt.gloo_enabled
+gloo_built = _hvt.gloo_built
+nccl_built = _hvt.nccl_built
+ddl_built = _hvt.ddl_built
+ccl_built = _hvt.ccl_built
+cuda_built = _hvt.cuda_built
+rocm_built = _hvt.rocm_built
+xla_built = _hvt.xla_built
+start_timeline = _hvt.start_timeline
+stop_timeline = _hvt.stop_timeline
+ProcessSet = _hvt.ProcessSet
+add_process_set = _hvt.add_process_set
+remove_process_set = _hvt.remove_process_set
+HorovodInternalError = _hvt.core.exceptions.HorovodInternalError
+HostsUpdatedInterrupt = _hvt.core.exceptions.HostsUpdatedInterrupt
+
+from .compression import Compression  # noqa: E402
+from . import mpi_ops  # noqa: E402
+from .mpi_ops import (  # noqa: E402
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    grouped_allreduce,
+    join,
+    reducescatter,
+)
+
+
+# ---------------------------------------------------------------------------
+# variable / object helpers
+# ---------------------------------------------------------------------------
+
+def broadcast_variables(variables, root_rank: int = 0, process_set=None):
+    """Assign every variable its root-rank value (parity:
+    hvd.broadcast_variables)."""
+    for var in variables:
+        var.assign(
+            broadcast(tf.convert_to_tensor(var), root_rank=root_rank,
+                      process_set=process_set)
+        )
+
+
+def broadcast_object(obj, root_rank: int = 0, process_set=None):
+    from ..api import functions as _functions
+
+    return _functions.broadcast_object(obj, root_rank=root_rank,
+                                       process_set=process_set)
+
+
+def allgather_object(obj, process_set=None):
+    from ..api import functions as _functions
+
+    return _functions.allgather_object(obj, process_set=process_set)
+
+
+# ---------------------------------------------------------------------------
+# DistributedGradientTape (the TF2 training idiom)
+# ---------------------------------------------------------------------------
+
+class _DistributedGradientTape:
+    """Parity: hvd.DistributedGradientTape — tape whose ``gradient()``
+    allreduces every gradient before returning it.
+
+    A delegating proxy rather than a tf.GradientTape subclass: the
+    real tape's internals (the pywrap tape handle) stay untouched, so
+    ``watch``/``jacobian``/context-manager use all behave exactly like
+    the wrapped tape.  (``isinstance(dtape, tf.GradientTape)`` is
+    False — same trade the reference's wrapper effectively makes by
+    rebuilding tape internals per TF version.)
+    """
+
+    def __init__(self, tape: tf.GradientTape, device_dense="",
+                 device_sparse="", compression=Compression.none,
+                 sparse_as_dense=False, op=Average,
+                 gradient_predivide_factor: float = 1.0,
+                 num_groups: int = 0, process_set=None):
+        self.__dict__["_tape"] = tape
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+        self._op = op
+        self._predivide = gradient_predivide_factor
+        self._process_set = process_set
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_tape"], item)
+
+    def __enter__(self):
+        self.__dict__["_tape"].__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self.__dict__["_tape"].__exit__(*exc)
+
+    def _allreduce_one(self, grad):
+        if grad is None:
+            return None
+        if isinstance(grad, tf.IndexedSlices) and self._sparse_as_dense:
+            grad = tf.convert_to_tensor(grad)
+        op, prescale, postscale = mpi_ops.predivide_scaling(
+            self._op, self._predivide, self._process_set
+        )
+        return allreduce(
+            grad, op=op, compression=self._compression,
+            prescale_factor=prescale, postscale_factor=postscale,
+            process_set=self._process_set,
+        )
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self.__dict__["_tape"].gradient(
+            target, sources, output_gradients
+        )
+        if isinstance(grads, (list, tuple)):
+            return type(grads)(self._allreduce_one(g) for g in grads)
+        return self._allreduce_one(grads)
+
+
+def DistributedGradientTape(gradtape, device_dense="", device_sparse="",
+                            compression=Compression.none,
+                            sparse_as_dense=False, op=Average,
+                            gradient_predivide_factor: float = 1.0,
+                            num_groups: int = 0, process_set=None):
+    """Parity: hvd.DistributedGradientTape(tape)."""
+    return _DistributedGradientTape(
+        gradtape, device_dense, device_sparse, compression,
+        sparse_as_dense, op, gradient_predivide_factor, num_groups,
+        process_set,
+    )
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer
+# ---------------------------------------------------------------------------
+
+def DistributedOptimizer(optimizer, name=None, device_dense="",
+                         device_sparse="", compression=Compression.none,
+                         sparse_as_dense=False, op=Average,
+                         gradient_predivide_factor: float = 1.0,
+                         backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = True,
+                         num_groups: int = 0, process_set=None):
+    """Wrap an optimizer so gradients are allreduced before being
+    applied (parity: hvd.DistributedOptimizer for TF).
+
+    Keras (2 or 3) optimizers are wrapped via the dynamic-subclass
+    trick of horovod/_keras/__init__.py (create_distributed_optimizer);
+    tf.compat.v1 optimizers get their ``compute_gradients`` wrapped.
+    """
+    import keras as _keras_pkg
+
+    if isinstance(optimizer, _keras_pkg.optimizers.Optimizer):
+        from .._keras import create_distributed_optimizer
+
+        return create_distributed_optimizer(
+            optimizer, name=name, compression=compression, op=op,
+            gradient_predivide_factor=gradient_predivide_factor,
+            backward_passes_per_step=backward_passes_per_step,
+            average_aggregated_gradients=average_aggregated_gradients,
+            process_set=process_set,
+        )
+    if isinstance(optimizer, tf.compat.v1.train.Optimizer):
+        return _LegacyDistributedOptimizer(
+            optimizer, compression=compression, op=op,
+            process_set=process_set,
+        )
+    raise ValueError(
+        f"unsupported optimizer type {type(optimizer)!r}; expected a "
+        "keras optimizer or tf.compat.v1.train.Optimizer"
+    )
+
+
+class _LegacyDistributedOptimizer(tf.compat.v1.train.Optimizer):
+    """compute_gradients-wrapping path (parity: the v1 optimizer wrap
+    in horovod/tensorflow/__init__.py)."""
+
+    def __init__(self, optimizer, compression=Compression.none,
+                 op=Average, process_set=None):
+        self._optimizer = optimizer
+        self._compression = compression
+        self._op = op
+        self._process_set = process_set
+        super().__init__(name="HvtpuDistributed", use_locking=False)
+
+    def compute_gradients(self, *args, **kwargs):
+        gradvars = self._optimizer.compute_gradients(*args, **kwargs)
+        return [
+            (
+                allreduce(g, op=self._op, compression=self._compression,
+                          process_set=self._process_set)
+                if g is not None else None,
+                v,
+            )
+            for g, v in gradvars
+        ]
+
+    def apply_gradients(self, *args, **kwargs):
+        return self._optimizer.apply_gradients(*args, **kwargs)
+
+    def get_slot(self, *args, **kwargs):
+        return self._optimizer.get_slot(*args, **kwargs)
+
+    def get_slot_names(self, *args, **kwargs):
+        return self._optimizer.get_slot_names(*args, **kwargs)
+
+    def variables(self, *args, **kwargs):
+        return self._optimizer.variables(*args, **kwargs)
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size",
+    "mpi_enabled", "mpi_built", "mpi_threads_supported", "gloo_enabled",
+    "gloo_built", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
+    "rocm_built", "xla_built",
+    "start_timeline", "stop_timeline",
+    "ProcessSet", "add_process_set", "remove_process_set",
+    "HorovodInternalError", "HostsUpdatedInterrupt",
+    "Sum", "Average", "Adasum", "Min", "Max", "Product",
+    "allreduce", "grouped_allreduce", "allgather", "broadcast",
+    "alltoall", "reducescatter", "barrier", "join",
+    "broadcast_variables", "broadcast_object", "allgather_object",
+    "Compression", "DistributedGradientTape", "DistributedOptimizer",
+]

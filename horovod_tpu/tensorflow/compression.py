@@ -1,0 +1,39 @@
+"""Gradient compression intents for the TF frontend (parity:
+horovod/tensorflow/compression.py).  Like the torch frontend, the
+actual wire codec runs inside the engine; these classes express user
+intent and are mapped onto the engine codec at the op boundary."""
+
+from __future__ import annotations
+
+
+class Compressor:
+    """Interface parity: compress/decompress are identity at the TF
+    layer — the engine compresses on the wire."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class NoneCompressor(Compressor):
+    pass
+
+
+class FP16Compressor(Compressor):
+    pass
+
+
+class BF16Compressor(Compressor):
+    """TPU-native extension: bfloat16 wire format."""
+
+
+class Compression:
+    """Parity: hvd.Compression.{none,fp16} (+ TPU-native bf16)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

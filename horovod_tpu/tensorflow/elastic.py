@@ -1,0 +1,53 @@
+"""TF/Keras elastic state (parity: ``horovod/tensorflow/elastic.py``
+``TensorFlowKerasState``): capture model + optimizer weights for
+commit/rollback and broadcast them on sync."""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+import numpy as np
+
+from ..elastic.state import ObjectState
+
+
+class TensorFlowKerasState(ObjectState):
+    """Elastic state for a keras model (+ optional optimizer) plus
+    plain attributes (parity: TensorFlowKerasState(model, optimizer,
+    batch=0, epoch=0))."""
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        self._model_handle = model
+        self._opt_handle = optimizer
+        super().__init__(**kwargs)
+        self.model = model
+        self.optimizer = optimizer
+        self.save_to_memory()
+
+    def _capture(self) -> Dict[str, Any]:
+        payload = {
+            k: copy.deepcopy(getattr(self, k)) for k in self._tracked
+        }
+        payload["__model_weights__"] = [
+            np.asarray(w) for w in self._model_handle.get_weights()
+        ]
+        if self._opt_handle is not None:
+            opt_vars = self._opt_handle.variables
+            if callable(opt_vars):  # legacy optimizers: method not prop
+                opt_vars = opt_vars()
+            payload["__opt_vars__"] = [np.asarray(v) for v in opt_vars]
+        return payload
+
+    def _apply(self, payload: Dict[str, Any]):
+        for k, v in payload.items():
+            if k == "__model_weights__":
+                self._model_handle.set_weights(list(v))
+            elif k == "__opt_vars__":
+                opt_vars = self._opt_handle.variables
+                if callable(opt_vars):
+                    opt_vars = opt_vars()
+                for var, val in zip(opt_vars, v):
+                    var.assign(val)
+            else:
+                setattr(self, k, v)

@@ -1,0 +1,223 @@
+"""TF-tensor collectives over the TPU engine.
+
+Parity surface: ``horovod/tensorflow/mpi_ops.py`` + the C++ custom-op
+binding ``horovod/tensorflow/mpi_ops.cc`` (``HorovodAllreduceOp`` …).
+
+Adapter design: the reference registers TF custom kernels; here the
+boundary is tf ↔ numpy ↔ jax.  Eager tensors convert directly; inside
+a ``tf.function`` graph the ops route through ``tf.py_function`` (the
+engine executes eagerly mid-graph), keeping user code with
+``@tf.function`` training steps working unchanged — the role
+``xla_mpi_ops.cc``'s CustomCall plays in the reference.
+``tf.IndexedSlices`` gradients take the values+indices allgather path
+like the reference's sparse handling.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu as _hvt
+
+from .compression import BF16Compressor, Compression, FP16Compressor
+
+Sum = _hvt.Sum
+Average = _hvt.Average
+Adasum = _hvt.Adasum
+Min = _hvt.Min
+Max = _hvt.Max
+Product = _hvt.Product
+
+
+def _engine_compression(compression):
+    from ..comm.compression import Compression as EngineCompression
+
+    if compression is FP16Compressor or compression is Compression.fp16:
+        return EngineCompression.fp16
+    if compression is BF16Compressor or compression is Compression.bf16:
+        return EngineCompression.bf16
+    return EngineCompression.none
+
+
+def _participant_count(process_set) -> int:
+    """Number of ranks the collective spans (set size, or world)."""
+    if process_set is None:
+        return _hvt.size()
+    if isinstance(process_set, int):
+        st = _hvt.core.state.require_init("process-set lookup")
+        return st.process_set_table.get(process_set).size
+    return process_set.size
+
+
+def predivide_scaling(op, gradient_predivide_factor: float, process_set):
+    """Reference semantics for gradient_predivide_factor: Average
+    becomes Sum with the averaging split into prescale=1/factor and
+    postscale=factor/N over the participating ranks (parity:
+    horovod/torch/optimizer.py + horovod/tensorflow/__init__.py).
+    Returns (op, prescale, postscale).  Shared by the tape and the
+    keras optimizer so the math cannot drift apart.
+    """
+    if gradient_predivide_factor == 1.0 or op != Average:
+        return op, 1.0, 1.0
+    n = _participant_count(process_set)
+    return (Sum, 1.0 / gradient_predivide_factor,
+            gradient_predivide_factor / n)
+
+
+def _np(t) -> np.ndarray:
+    if isinstance(t, tf.Tensor) or isinstance(t, tf.Variable):
+        return t.numpy()
+    return np.asarray(t)
+
+
+def _graph_op(fn, inputs, out_dtype, out_shape=None):
+    """Run ``fn`` (numpy-level engine call) inside a TF graph via
+    tf.py_function; in eager mode call it directly."""
+    if tf.executing_eagerly():
+        return tf.convert_to_tensor(fn(*[_np(i) for i in inputs]))
+    out = tf.py_function(
+        lambda *ts: tf.convert_to_tensor(fn(*[t.numpy() for t in ts])),
+        inputs, Tout=out_dtype,
+    )
+    if out_shape is not None:
+        out.set_shape(out_shape)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, average=None, op=None, name=None,
+              compression=Compression.none,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set=None):
+    """Averaged (by default) allreduce (parity: hvd.allreduce for TF).
+
+    ``tf.IndexedSlices`` inputs return IndexedSlices assembled from an
+    allgather of values and indices (the reference's sparse path).
+    """
+    if isinstance(tensor, tf.IndexedSlices):
+        # parity: _allreduce of IndexedSlices = allgather values+indices
+        # (sum = concatenated contributions, scatter-added at apply;
+        # average divides values by the PARTICIPATING rank count).
+        # Pre/postscale distribute over the sum, so they apply directly
+        # to this rank's values.
+        values = allgather(tensor.values, process_set=process_set)
+        indices = allgather(tensor.indices, process_set=process_set)
+        from ..comm.reduce_ops import ReduceOp, normalize_op
+
+        rop = normalize_op(op, average)
+        scale = prescale_factor * postscale_factor
+        if rop == ReduceOp.AVERAGE:
+            scale /= _participant_count(process_set)
+        elif rop != ReduceOp.SUM:
+            raise NotImplementedError(
+                f"IndexedSlices allreduce supports Sum/Average, got {rop}"
+            )
+        if scale != 1.0:
+            values = values * tf.cast(scale, values.dtype)
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+
+    def impl(x):
+        return np.asarray(_hvt.allreduce(
+            x, op=op, average=average, name=name,
+            compression=_engine_compression(compression),
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            process_set=process_set,
+        ))
+
+    return _graph_op(impl, [tensor], tensor.dtype, tensor.shape)
+
+
+def grouped_allreduce(tensors: List, average=None, op=None,
+                      compression=Compression.none, process_set=None):
+    if tf.executing_eagerly():
+        outs = _hvt.grouped_allreduce(
+            [_np(t) for t in tensors], op=op, average=average,
+            compression=_engine_compression(compression),
+            process_set=process_set,
+        )
+        return [tf.convert_to_tensor(np.asarray(o)) for o in outs]
+    return [
+        allreduce(t, average=average, op=op, compression=compression,
+                  process_set=process_set)
+        for t in tensors
+    ]
+
+
+def allgather(tensor, name=None, process_set=None):
+    """Concatenate along dim 0 across ranks (ragged dim 0 supported)."""
+
+    def impl(x):
+        return np.asarray(
+            _hvt.allgather(x, process_set=process_set, name=name)
+        )
+
+    shape = tf.TensorShape([None]).concatenate(tensor.shape[1:]) \
+        if tensor.shape.rank is not None and tensor.shape.rank > 0 else None
+    return _graph_op(impl, [tensor], tensor.dtype, shape)
+
+
+def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):
+    def impl(x):
+        return np.asarray(_hvt.broadcast(
+            x, root_rank=root_rank, process_set=process_set, name=name
+        ))
+
+    return _graph_op(impl, [tensor], tensor.dtype, tensor.shape)
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    """Parity: hvd.alltoall — returns (output, received_splits) when
+    splits is given, else just the output."""
+    if splits is None:
+        def impl(x):
+            return np.asarray(_hvt.alltoall(
+                x, None, process_set=process_set, name=name
+            ))
+
+        shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
+        return _graph_op(impl, [tensor], tensor.dtype, shape)
+
+    if tf.executing_eagerly():
+        out, rsplits = _hvt.alltoall(
+            _np(tensor), _np(splits), process_set=process_set, name=name
+        )
+        return (tf.convert_to_tensor(np.asarray(out)),
+                tf.convert_to_tensor(np.asarray(rsplits)))
+
+    out, rsplits = tf.py_function(
+        lambda t, s: tuple(
+            tf.convert_to_tensor(np.asarray(r))
+            for r in _hvt.alltoall(t.numpy(), s.numpy(),
+                                   process_set=process_set, name=name)
+        ),
+        [tensor, splits], Tout=[tensor.dtype, tf.int32],
+    )
+    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    return out, rsplits
+
+
+def reducescatter(tensor, op=None, name=None, process_set=None):
+    def impl(x):
+        return np.asarray(_hvt.reducescatter(
+            x, op=op, process_set=process_set, name=name
+        ))
+
+    shape = tf.TensorShape([None]).concatenate(tensor.shape[1:]) \
+        if tensor.shape.rank is not None and tensor.shape.rank > 0 else None
+    return _graph_op(impl, [tensor], tensor.dtype, shape)
+
+
+def barrier(process_set=None):
+    _hvt.barrier(process_set=process_set)
+
+
+def join(device=None) -> int:
+    return _hvt.join(device)

@@ -117,21 +117,18 @@ class TestSingleProcess:
         np.testing.assert_allclose(np.asarray(outs[1]), 2.0)
 
     def test_duplicate_pending_name_fails(self, hvt):
-        ctrl = None
-        from horovod_tpu.eager import get_controller
-
-        ctrl = get_controller()
-        # enqueue directly with manual pause so the first is still pending
-        f1 = ctrl.enqueue("allreduce", jnp.ones(2), name="dup")
-        f2 = ctrl.enqueue("allreduce", jnp.ones(2), name="dup")
-        # one of them errors with the duplicate-name status
+        # manual mode: no background cycle can drain the first enqueue
+        # between the two calls (that made this racy before)
+        ctrl = EagerController(0, 1, manual=True)
         try:
-            f2.result(timeout=10)
-            dup_failed = False
-        except HorovodInternalError:
-            dup_failed = True
-        f1.result(timeout=10)
-        assert dup_failed
+            f1 = ctrl.enqueue("allreduce", jnp.ones(2), name="dup")
+            f2 = ctrl.enqueue("allreduce", jnp.ones(2), name="dup")
+            with pytest.raises(HorovodInternalError, match="duplicate"):
+                f2.result(timeout=5)
+            ctrl.run_cycle_once()
+            f1.result(timeout=5)
+        finally:
+            ctrl.stop()
 
     def test_join_single(self, hvt):
         assert hvt.join() == 0

@@ -1,0 +1,267 @@
+"""TensorFlow / Keras frontend tests (parity model:
+test/parallel/test_tensorflow.py + test_tensorflow2_keras.py; the
+multi-rank data path is covered in test_multiprocess_tf.py).
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("keras")
+
+import horovod_tpu.tensorflow as hvd_tf  # noqa: E402
+import horovod_tpu.keras as hvd_keras  # noqa: E402
+
+
+class TestTfOps:
+    def test_allreduce_eager(self, hvt):
+        out = hvd_tf.allreduce(tf.constant([1.0, 2.0]), op=hvd_tf.Sum)
+        assert isinstance(out, tf.Tensor)
+        np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
+
+    def test_allreduce_graph_mode(self, hvt):
+        @tf.function
+        def step(t):
+            return hvd_tf.allreduce(t, op=hvd_tf.Average)
+
+        out = step(tf.constant([[2.0, 4.0]]))
+        np.testing.assert_allclose(out.numpy(), [[2.0, 4.0]])
+        assert out.shape == (1, 2)
+
+    def test_allgather_and_broadcast(self, hvt):
+        g = hvd_tf.allgather(tf.ones((3, 2)))
+        assert g.shape == (3, 2)
+        b = hvd_tf.broadcast(tf.constant([7.0]), root_rank=0)
+        np.testing.assert_allclose(b.numpy(), [7.0])
+
+    def test_alltoall_with_splits(self, hvt):
+        out, rsplits = hvd_tf.alltoall(
+            tf.constant([1.0, 2.0, 3.0]), splits=tf.constant([3])
+        )
+        np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
+        assert rsplits.numpy().tolist() == [3]
+
+    def test_indexed_slices_allreduce(self, hvt):
+        s = tf.IndexedSlices(
+            values=tf.ones((2, 4)), indices=tf.constant([1, 3]),
+            dense_shape=tf.constant([5, 4]),
+        )
+        r = hvd_tf.allreduce(s, op=hvd_tf.Average)
+        assert isinstance(r, tf.IndexedSlices)
+        np.testing.assert_allclose(r.values.numpy(), np.ones((2, 4)))
+        assert r.indices.numpy().tolist() == [1, 3]
+
+    def test_broadcast_variables(self, hvt):
+        v1 = tf.Variable([1.0, 2.0])
+        v2 = tf.Variable([[3.0]])
+        hvd_tf.broadcast_variables([v1, v2], root_rank=0)
+        np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+
+    def test_broadcast_object_roundtrip(self, hvt):
+        obj = {"step": 12, "name": "x"}
+        assert hvd_tf.broadcast_object(obj, root_rank=0) == obj
+        assert hvd_tf.allgather_object(obj) == [obj]
+
+    def test_build_info_surface(self, hvt):
+        assert hvd_tf.xla_built()
+        assert not hvd_tf.nccl_built()
+        assert hvd_tf.size() == 1 and hvd_tf.rank() == 0
+
+
+class TestDistributedGradientTape:
+    def test_gradients_pass_through(self, hvt):
+        w = tf.Variable([[1.0], [2.0]])
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(tf.matmul(tf.ones((4, 2)), w))
+        dtape = hvd_tf.DistributedGradientTape(tape)
+        (g,) = dtape.gradient(loss, [w])
+        np.testing.assert_allclose(g.numpy().ravel(), [4.0, 4.0])
+
+    def test_none_gradient_preserved(self, hvt):
+        w = tf.Variable([1.0])
+        unused = tf.Variable([1.0])
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(w * 2.0)
+        dtape = hvd_tf.DistributedGradientTape(tape)
+        g = dtape.gradient(loss, [w, unused])
+        assert g[1] is None
+        np.testing.assert_allclose(g[0].numpy(), [2.0])
+
+    def test_predivide_average_equivalence(self, hvt):
+        w = tf.Variable([3.0])
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(w * 5.0)
+        dtape = hvd_tf.DistributedGradientTape(
+            tape, gradient_predivide_factor=2.0
+        )
+        (g,) = dtape.gradient(loss, [w])
+        # predivide splits the averaging; single rank -> same value
+        np.testing.assert_allclose(g.numpy(), [5.0])
+
+    def test_context_manager_and_watch(self, hvt):
+        """The proxy must preserve tape recording semantics: context
+        manager entry/exit, watch() of a non-variable tensor."""
+        x = tf.constant([2.0, 3.0])
+        with hvd_tf.DistributedGradientTape(tf.GradientTape()) as dtape:
+            dtape.watch(x)
+            y = tf.reduce_sum(x * x)
+        g = dtape.gradient(y, x)
+        np.testing.assert_allclose(g.numpy(), [4.0, 6.0])
+
+    def test_sparse_predivide_scaling(self, hvt):
+        """IndexedSlices with gradient_predivide_factor must still
+        average (Sum + pre/postscale == Average at size 1)."""
+        emb = tf.Variable(tf.ones((4, 2)))
+        with tf.GradientTape() as tape:
+            rows = tf.gather(emb, [0, 2])
+            loss = tf.reduce_sum(rows * 3.0)
+        dtape = hvd_tf.DistributedGradientTape(
+            tape, gradient_predivide_factor=2.0
+        )
+        (g,) = dtape.gradient(loss, [emb])
+        assert isinstance(g, tf.IndexedSlices)
+        np.testing.assert_allclose(g.values.numpy(),
+                                   np.full((2, 2), 3.0))
+
+
+class TestKerasOptimizer:
+    def test_wrap_preserves_config(self, hvt):
+        opt = keras.optimizers.SGD(learning_rate=0.25, momentum=0.9)
+        dopt = hvd_keras.DistributedOptimizer(opt)
+        assert type(dopt).__name__ == "DistributedSGD"
+        assert dopt._hvtpu_distributed
+        assert float(np.asarray(dopt.learning_rate)) == 0.25
+        assert isinstance(dopt, keras.optimizers.Optimizer)
+
+    def test_fit_converges(self, hvt):
+        rng = np.random.RandomState(0)
+        x = rng.rand(128, 8).astype(np.float32)
+        y = x @ rng.rand(8, 1).astype(np.float32)
+        model = keras.Sequential([keras.layers.Dense(1)])
+        dopt = hvd_keras.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=0.2)
+        )
+        model.compile(optimizer=dopt, loss="mse")
+        hist = model.fit(x, y, epochs=4, batch_size=32, verbose=0)
+        losses = hist.history["loss"]
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_backward_passes_per_step_aggregates(self, hvt):
+        """bpps=2: variables move only every 2nd apply, by the
+        averaged accumulated gradient (LocalGradientAggregationHelper
+        parity)."""
+        opt = hvd_keras.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=1.0),
+            backward_passes_per_step=2,
+        )
+        v = tf.Variable([10.0])
+        opt.apply([tf.constant([2.0])], [v])   # micro-step: no move
+        np.testing.assert_allclose(v.numpy(), [10.0])
+        opt.apply([tf.constant([4.0])], [v])   # sync: avg(2,4)=3
+        np.testing.assert_allclose(v.numpy(), [7.0])
+        opt.apply([tf.constant([6.0])], [v])   # accumulation restarted
+        np.testing.assert_allclose(v.numpy(), [7.0])
+        opt.apply([tf.constant([0.0])], [v])   # sync: avg(6,0)=3
+        np.testing.assert_allclose(v.numpy(), [4.0])
+
+    def test_backward_passes_per_step_in_fit(self, hvt):
+        rng = np.random.RandomState(0)
+        x = rng.rand(64, 4).astype(np.float32)
+        y = x @ rng.rand(4, 1).astype(np.float32)
+        model = keras.Sequential([keras.layers.Dense(1)])
+        dopt = hvd_keras.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=0.4),
+            backward_passes_per_step=2,
+        )
+        model.compile(optimizer=dopt, loss="mse")
+        hist = model.fit(x, y, epochs=4, batch_size=16, verbose=0)
+        assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+    def test_v1_optimizer_wrap(self, hvt):
+        v1_opt = tf.compat.v1.train.GradientDescentOptimizer(0.1)
+        dopt = hvd_tf.DistributedOptimizer(v1_opt)
+        assert dopt.get_slot_names() == v1_opt.get_slot_names()
+
+    def test_unsupported_optimizer_rejected(self, hvt):
+        with pytest.raises(ValueError, match="unsupported optimizer"):
+            hvd_tf.DistributedOptimizer(object())
+
+
+class TestTensorFlowKerasState:
+    def test_commit_restore_roundtrip(self, hvt):
+        from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+        model = keras.Sequential([keras.layers.Dense(2)])
+        model.build((None, 3))
+        state = TensorFlowKerasState(model, epoch=0)
+        w0 = [w.copy() for w in model.get_weights()]
+        state.commit()
+        model.set_weights([w + 1.0 for w in model.get_weights()])
+        state.epoch = 5
+        state.restore()
+        for a, b in zip(model.get_weights(), w0):
+            np.testing.assert_allclose(a, b)
+        assert state.epoch == 0
+
+    def test_sync_broadcasts(self, hvt):
+        from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+        model = keras.Sequential([keras.layers.Dense(2)])
+        model.build((None, 3))
+        state = TensorFlowKerasState(model, epoch=3)
+        state.sync()
+        assert state.epoch == 3  # size-1 world: identity
+
+
+class TestKerasCallbacks:
+    def _model(self):
+        model = keras.Sequential([keras.layers.Dense(1)])
+        model.compile(
+            optimizer=keras.optimizers.SGD(learning_rate=0.1),
+            loss="mse",
+        )
+        return model
+
+    def _data(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(64, 4).astype(np.float32)
+        return x, x @ rng.rand(4, 1).astype(np.float32)
+
+    def test_broadcast_callback_runs(self, hvt):
+        x, y = self._data()
+        model = self._model()
+        cb = hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)
+        model.fit(x, y, epochs=1, batch_size=32, verbose=0,
+                  callbacks=[cb])
+        assert cb.broadcast_done
+
+    def test_metric_average_callback(self, hvt):
+        x, y = self._data()
+        model = self._model()
+        model.fit(x, y, epochs=1, batch_size=32, verbose=0,
+                  callbacks=[hvd_keras.callbacks.MetricAverageCallback()])
+
+    def test_lr_warmup_reaches_size_multiple(self, hvt):
+        x, y = self._data()
+        model = self._model()
+        cb = hvd_keras.callbacks.LearningRateWarmupCallback(
+            warmup_epochs=2, initial_lr=0.1
+        )
+        model.fit(x, y, epochs=3, batch_size=32, verbose=0,
+                  callbacks=[cb])
+        # world size 1: warmup multiplier ends at 1.0
+        assert float(np.asarray(model.optimizer.learning_rate)) \
+            == pytest.approx(0.1)
+
+    def test_lr_schedule_staircase(self, hvt):
+        x, y = self._data()
+        model = self._model()
+        cb = hvd_keras.callbacks.LearningRateScheduleCallback(
+            multiplier=lambda epoch: 0.5 ** epoch, start_epoch=0,
+            initial_lr=0.1,
+        )
+        model.fit(x, y, epochs=3, batch_size=32, verbose=0,
+                  callbacks=[cb])
+        # epoch 2 multiplier: 0.25
+        assert float(np.asarray(model.optimizer.learning_rate)) \
+            == pytest.approx(0.025)
