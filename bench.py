@@ -34,8 +34,13 @@ from horovod_tpu.models import ResNet50
 A100_BASELINE_IMG_PER_SEC_PER_CHIP = 2500.0
 
 BATCH_PER_CHIP = int(os.environ.get("HVTPU_BENCH_BATCH", "256"))
-WARMUP = int(os.environ.get("HVTPU_BENCH_WARMUP", "5"))
-ITERS = int(os.environ.get("HVTPU_BENCH_ITERS", "30"))
+WARMUP = int(os.environ.get("HVTPU_BENCH_WARMUP", "2"))
+ITERS = int(os.environ.get("HVTPU_BENCH_ITERS", "6"))
+# Training steps fused into one device dispatch via lax.scan — the
+# standard TPU train-loop shape (amortizes host->device dispatch, which
+# on a tunneled/remote chip costs tens of ms per call; real training
+# loops batch steps exactly like this).
+STEPS_PER_CALL = int(os.environ.get("HVTPU_BENCH_STEPS_PER_CALL", "32"))
 
 
 def main():
@@ -74,13 +79,29 @@ def main():
         ).mean()
         return loss, mutated["batch_stats"]
 
-    def body(params, batch_stats, opt_state, x, y):
+    def one_step(params, batch_stats, opt_state, x, y):
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params, batch_stats, x, y)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, jax.lax.pmean(loss, "world")
+
+    def body(params, batch_stats, opt_state, x, y):
+        # STEPS_PER_CALL optimizer steps in one dispatch (lax.scan keeps
+        # it one compiled program; XLA reuses buffers across steps).
+        def scan_step(carry, _):
+            params, batch_stats, opt_state = carry
+            params, batch_stats, opt_state, loss = one_step(
+                params, batch_stats, opt_state, x, y
+            )
+            return (params, batch_stats, opt_state), loss
+
+        (params, batch_stats, opt_state), losses = jax.lax.scan(
+            scan_step, (params, batch_stats, opt_state), None,
+            length=STEPS_PER_CALL,
+        )
+        return params, batch_stats, opt_state, losses[-1]
 
     step = jax.jit(
         jax.shard_map(
@@ -118,8 +139,14 @@ def main():
     if not np.isfinite(final_loss):
         raise RuntimeError(f"non-finite loss {final_loss}; benchmark invalid")
 
-    img_per_sec = global_batch * ITERS / elapsed
+    img_per_sec = global_batch * ITERS * STEPS_PER_CALL / elapsed
     img_per_sec_per_chip = img_per_sec / n_dev
+    # MFU: ~23.8 GFLOP per image for this step (XLA cost analysis:
+    # 6.08e12 flops at batch 256) against v5e's 197 TFLOP/s bf16 peak.
+    # The step is HBM-bound (77 GB accessed/step), so MFU is the
+    # honest context for the img/s number, not the target.
+    flops_per_img = 23.8e9
+    mfu = img_per_sec_per_chip * flops_per_img / 197e12
     print(
         json.dumps(
             {
@@ -129,6 +156,12 @@ def main():
                 "vs_baseline": round(
                     img_per_sec_per_chip / A100_BASELINE_IMG_PER_SEC_PER_CHIP,
                     4,
+                ),
+                "mfu_est": round(mfu, 4),
+                "notes": (
+                    f"{STEPS_PER_CALL} steps/dispatch via lax.scan; "
+                    "TPU-fast BatchNorm (flattened 2-D stats, bf16 "
+                    "normalize pass); HBM-bandwidth-bound step"
                 ),
             }
         )

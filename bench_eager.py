@@ -1,0 +1,103 @@
+"""Eager-path micro-benchmark: allreduce GB/s vs tensor size, fused vs
+unfused, through the torch frontend adapter (VERDICT round-1 task 5).
+
+The reference measures its eager path with
+examples/pytorch/pytorch_synthetic_benchmark.py; this is the
+collective-level equivalent.  Runs single-process by default (adapter +
+engine dispatch overheads dominate — the quantity of interest for the
+zero-copy work); pass --np 2+ to run the same sweep across real worker
+processes via the runner.
+
+Prints one JSON line per configuration:
+  {"bench": "eager_allreduce", "nbytes": ..., "mode": "sync|async_fused",
+   "gbps": ..., "us_per_op": ...}
+"""
+
+import argparse
+import json
+import time
+
+
+def run_sweep(sizes_mb, iters, warmup=3):
+    import numpy as np
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    results = []
+    for mb in sizes_mb:
+        n = int(mb * (1 << 20) / 4)
+        t = torch.ones(n, dtype=torch.float32)
+
+        # sync path
+        for _ in range(warmup):
+            hvd.allreduce(t, op=hvd.Sum, name=f"warm.{n}")
+        t0 = time.perf_counter()
+        for i in range(iters):
+            hvd.allreduce(t, op=hvd.Sum, name=f"sync.{n}")
+        dt = (time.perf_counter() - t0) / iters
+        results.append({
+            "bench": "eager_allreduce", "nbytes": n * 4, "mode": "sync",
+            "gbps": n * 4 / dt / 1e9, "us_per_op": dt * 1e6,
+        })
+
+        # async fused path: 8 tensors of n/8 through the controller
+        k = 8
+        chunk = torch.ones(max(n // k, 1), dtype=torch.float32)
+        for _ in range(warmup):
+            hs = [hvd.allreduce_async(chunk, op=hvd.Sum,
+                                      name=f"wa.{n}.{i}")
+                  for i in range(k)]
+            for h in hs:
+                hvd.synchronize(h)
+        t0 = time.perf_counter()
+        for it in range(iters):
+            hs = [hvd.allreduce_async(chunk, op=hvd.Sum,
+                                      name=f"as.{n}.{i}")
+                  for i in range(k)]
+            for h in hs:
+                hvd.synchronize(h)
+        dt = (time.perf_counter() - t0) / iters
+        total = chunk.numel() * 4 * k
+        results.append({
+            "bench": "eager_allreduce", "nbytes": total,
+            "mode": "async_fused", "gbps": total / dt / 1e9,
+            "us_per_op": dt * 1e6 / k,
+        })
+    return results
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes-mb", default="0.25,1,4,16,64")
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--np", type=int, default=1,
+                   help="worker processes (1 = in-process)")
+    p.add_argument("--cpu-devices", type=int, default=None)
+    args = p.parse_args()
+    sizes = [float(s) for s in args.sizes_mb.split(",")]
+
+    if args.np == 1:
+        if args.cpu_devices:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        results = run_sweep(sizes, args.iters)
+    else:
+        from horovod_tpu.runner import run as hvt_run
+
+        per_rank = hvt_run(
+            run_sweep, args=(sizes, args.iters), np=args.np,
+            cpu_devices=args.cpu_devices or 1,
+        )
+        results = per_rank[0]
+        for r in results:
+            r["np"] = args.np
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

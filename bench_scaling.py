@@ -1,0 +1,135 @@
+"""Scaling-efficiency harness: throughput vs device count, the
+measurement behind the reference's headline '~90% scaling efficiency'
+claims (README.rst Benchmarks / docs/benchmarks.rst methodology:
+synthetic data, images/sec at N workers over images/sec at 1 worker
+times N).
+
+Sweeps a DP training step over 1..N devices of one mesh and prints one
+JSON line per point:
+
+  {"bench": "scaling", "devices": d, "img_per_sec": ...,
+   "efficiency_vs_linear": ...}
+
+Default run uses the 8-device virtual CPU mesh (mechanics; this sandbox
+has a single real TPU chip — on a pod, run unmodified for real ICI
+numbers).  --platform tpu keeps whatever devices the default backend
+exposes.
+"""
+
+import argparse
+import json
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--platform", default="cpu", choices=["cpu", "tpu"])
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual device count for --platform cpu")
+    p.add_argument("--batch-per-device", type=int, default=64)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--model", default="mlp", choices=["mlp", "resnet18"])
+    args = p.parse_args()
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.devices)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import horovod_tpu as hvt
+
+    hvt.init()
+    all_devs = jax.devices()
+
+    if args.model == "mlp":
+        from horovod_tpu.models.mlp import MLP
+
+        model = MLP(features=(1024, 1024, 256), num_classes=100)
+        x_shape = (784,)
+    else:
+        from horovod_tpu.models import ResNet18
+
+        model = ResNet18(num_classes=100, dtype=jnp.bfloat16)
+        x_shape = (64, 64, 3)
+
+    rng = jax.random.PRNGKey(0)
+
+    def throughput(devs):
+        d = len(devs)
+        mesh = Mesh(np.array(devs), ("dp",))
+        gb = args.batch_per_device * d
+        x = jax.random.normal(rng, (gb,) + x_shape,
+                              jnp.bfloat16 if args.model == "resnet18"
+                              else jnp.float32)
+        y = jax.random.randint(rng, (gb,), 0, 100)
+        variables = model.init(rng, x[:2]) if args.model == "mlp" else \
+            model.init(rng, x[:2], train=True)
+
+        tx = hvt.DistributedOptimizer(optax.sgd(0.1), axis_name="dp")
+        params = variables["params"]
+        extra = {k: v for k, v in variables.items() if k != "params"}
+        opt_state = tx.init(params)
+
+        def loss_fn(params, x, y):
+            if extra:
+                logits, _ = model.apply(
+                    {"params": params, **extra}, x, train=True,
+                    mutable=list(extra),
+                )
+            else:
+                logits = model.apply({"params": params}, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+
+        def body(params, opt_state, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state,
+                    jax.lax.pmean(loss, "dp"))
+
+        step = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), P(), P("dp"), P("dp")),
+                out_specs=(P(), P(), P()),
+                check_vma=False,
+            ),
+            donate_argnums=(0, 1),
+        )
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, x, y)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            params, opt_state, loss = step(params, opt_state, x, y)
+        float(loss)
+        dt = time.perf_counter() - t0
+        return gb * args.iters / dt
+
+    results = []
+    base = None
+    d = 1
+    while d <= len(all_devs):
+        ips = throughput(all_devs[:d])
+        if base is None:
+            base = ips
+        eff = ips / (base * d)
+        results.append({
+            "bench": "scaling", "model": args.model, "devices": d,
+            "img_per_sec": round(ips, 1),
+            "efficiency_vs_linear": round(eff, 4),
+        })
+        d *= 2
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -51,8 +51,9 @@ def create_distributed_optimizer(optimizer, name=None, compression=None,
             if bpps == 1:
                 grads = self._hvtpu_allreduce_grads(grads)
                 return super().apply(grads, trainable_variables, **kwargs)
-            eff = self._hvtpu_aggregate(grads)
-            return super().apply(eff, trainable_variables, **kwargs)
+            return self._hvtpu_aggregate_apply(
+                grads, trainable_variables, **kwargs
+            )
 
         def _hvtpu_allreduce_grads(self, grads):
             eff_op, prescale, postscale = predivide_scaling(
@@ -70,9 +71,19 @@ def create_distributed_optimizer(optimizer, name=None, compression=None,
                 ))
             return out
 
-        def _hvtpu_aggregate(self, grads):
+        def _hvtpu_aggregate_apply(self, grads, trainable_variables,
+                                   **kwargs):
+            """Accumulate for bpps micro-steps; every bpps-th step
+            allreduce the (optionally averaged) aggregate and run the
+            REAL apply — other steps skip the base apply entirely, so
+            stateful optimizers (Adam m/v, momentum) and
+            ``iterations`` only advance on aggregate steps (parity:
+            LocalGradientAggregationHelper skipping non-sync applies).
+            """
             import tensorflow as tf
 
+            if trainable_variables is not None and not self.built:
+                self.build(trainable_variables)
             if not hasattr(self, "_hvtpu_acc"):
                 self._hvtpu_counter = tf.Variable(
                     0, dtype=tf.int64, trainable=False,
@@ -97,21 +108,19 @@ def create_distributed_optimizer(optimizer, name=None, compression=None,
                 if average_aggregated_gradients:
                     gs = [g / float(bpps) for g in gs]
                 gs = self._hvtpu_allreduce_grads(gs)
-                with tf.control_dependencies(gs):
-                    resets = [a.assign(tf.zeros_like(a)) for a in live_acc]
-                with tf.control_dependencies(resets):
-                    return [tf.identity(g) for g in gs]
+                full, it = [], iter(gs)
+                for a in self._hvtpu_acc:
+                    full.append(None if a is None else next(it))
+                base_cls.apply(self, full, trainable_variables, **kwargs)
+                for a in live_acc:
+                    a.assign(tf.zeros_like(a))
+                return tf.constant(True)
 
             def no_sync():
-                # zeros keep super().apply's bookkeeping advancing
-                # without moving variables
-                return [tf.zeros_like(a) for a in live_acc]
+                return tf.constant(False)
 
-            synced = tf.cond(is_sync, do_sync, no_sync)
-            out, it = [], iter(synced)
-            for a in self._hvtpu_acc:
-                out.append(None if a is None else next(it))
-            return out
+            tf.cond(is_sync, do_sync, no_sync)
+            return None
 
     _DistributedOptimizer.__name__ = "Distributed" + base_cls.__name__
     config = optimizer.get_config()

@@ -37,7 +37,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import state as core_state
-from ..core.topology import PROC_AXIS
+from ..core.topology import DCN_AXIS, ICI_AXIS, PROC_AXIS
 from . import spmd
 from .compression import NoneCompressor
 from .reduce_ops import ReduceOp, normalize_op
@@ -66,11 +66,47 @@ def _local_device(mesh: Mesh) -> jax.Device:
 def _stack_global(x, mesh: Mesh):
     """Global (P, *shape) array, shard p = process p's tensor."""
     p = mesh.devices.size
-    sharding = NamedSharding(mesh, P(PROC_AXIS))
+    spec = P(tuple(mesh.axis_names)) if len(mesh.axis_names) > 1 \
+        else P(mesh.axis_names[0])
+    sharding = NamedSharding(mesh, spec)
     local = jax.device_put(x[None], _local_device(mesh))
     return jax.make_array_from_single_device_arrays(
         (p,) + tuple(x.shape), sharding, [local]
     )
+
+
+def _hierarchical_mesh_or_none(st, ps, p: int):
+    """The (dcn, ici) eager mesh when hierarchical allreduce applies:
+    global process set, a true 2-D (multi-host AND multi-process-per-
+    host) topology covering all ranks.
+
+    Rank assignment is host-major (runner/hosts.py), so reshaping the
+    rank-ordered device list to (cross_size, local_size) puts same-host
+    processes along the fast ``ici`` axis (parity: the local/cross
+    communicator split of NCCLHierarchicalAllreduce).  Cached on the
+    ProcessSet instance (like its flat proc mesh), so a shutdown/init
+    cycle — which rebuilds the table — can never serve a mesh of dead
+    device objects.
+    """
+    cfg = st.config
+    if (cfg is None or not cfg.hierarchical_allreduce
+            or ps.process_set_id != 0
+            # launcher-certified uniform layout: rank-local sizes alone
+            # can't prove every host has the same slot count, and a
+            # non-uniform job must not split ranks between hierarchical
+            # and flat programs
+            or cfg.uniform_local_size <= 1
+            or st.local_size != cfg.uniform_local_size
+            or st.cross_size <= 1
+            or st.cross_size * st.local_size != p):
+        return None
+    mesh = getattr(ps, "_hier_proc_mesh", None)
+    if mesh is None:
+        flat = ps.proc_mesh().devices.reshape(-1)
+        grid = flat.reshape(st.cross_size, st.local_size)
+        mesh = Mesh(grid, (DCN_AXIS, ICI_AXIS))
+        ps._hier_proc_mesh = mesh
+    return mesh
 
 
 @functools.lru_cache(maxsize=None)
@@ -96,6 +132,42 @@ def _jitted(kind: str, mesh: Mesh, static: Tuple):
                 body,
                 mesh=mesh,
                 in_specs=(P(PROC_AXIS), P(), P()),
+                out_specs=P(),
+                check_vma=False,
+            )(stacked, prescale, postscale)
+
+        return jax.jit(fn)
+
+    if kind == "allreduce_hier":
+        # Two-stage reduce over the (dcn, ici) grid: combine within a
+        # host/slice first (fast links), then across hosts (parity:
+        # NCCLHierarchicalAllreduce's intra-node reduce + inter-node
+        # allreduce + intra-node broadcast, which XLA lowers from the
+        # two psums).
+        (rop, compression) = static
+
+        def fn(stacked, prescale, postscale):
+            def body(shard, pre, post):
+                # dim0 is sharded over BOTH mesh axes -> local (1, ...)
+                x = shard[0]
+                x = x * pre.astype(x.dtype)
+                out = spmd.allreduce(
+                    x, axis_name=ICI_AXIS, op=rop, compression=compression
+                )
+                # the cross-host stage is the slow link hierarchical
+                # allreduce exists to economize — compress it too
+                out = spmd.allreduce(
+                    out, axis_name=DCN_AXIS, op=rop,
+                    compression=compression,
+                )
+                # AVERAGE: each stage divides by its own axis size; the
+                # product is the full world divisor, nothing to fix.
+                return out * post.astype(out.dtype)
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P((DCN_AXIS, ICI_AXIS)), P(), P()),
                 out_specs=P(),
                 check_vma=False,
             )(stacked, prescale, postscale)
@@ -219,8 +291,19 @@ def allreduce(
             # averaging / sum over one participant is identity
             out = out * jnp.asarray(postscale_factor, out.dtype)
         else:
-            stacked = _stack_global(x, mesh)
-            fn = _jitted("allreduce", mesh, (rop, compression))
+            # Adasum is per-pair math (not two-stage associative), and
+            # integer AVERAGE floor-divides per stage which differs
+            # from a single flat division — both stay on the flat path.
+            int_avg = (rop == ReduceOp.AVERAGE
+                       and jnp.issubdtype(x.dtype, jnp.integer))
+            hier = (None if (rop == ReduceOp.ADASUM or int_avg)
+                    else _hierarchical_mesh_or_none(st, ps, p))
+            if hier is not None:
+                stacked = _stack_global(x, hier)
+                fn = _jitted("allreduce_hier", hier, (rop, compression))
+            else:
+                stacked = _stack_global(x, mesh)
+                fn = _jitted("allreduce", mesh, (rop, compression))
             out = _fetch(
                 fn(
                     stacked,

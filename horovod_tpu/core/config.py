@@ -66,6 +66,13 @@ class Config:
     # "none" | "fp16" | "bf16" | "int8"  (int8 = EQuARX-style quantized wire)
     compression: str = "none"
     adasum: bool = False
+    # two-stage eager allreduce over the (dcn, ici) process grid
+    # (parity: HOROVOD_HIERARCHICAL_ALLREDUCE / NCCLHierarchicalAllreduce)
+    hierarchical_allreduce: bool = False
+    # set by the launcher when every host has the SAME slot count (0 =
+    # non-uniform or unknown); hierarchical collectives require it so
+    # all ranks agree on the (dcn, ici) grid
+    uniform_local_size: int = 0
 
     # --- timeline / tracing ---
     timeline_filename: Optional[str] = None
@@ -127,6 +134,9 @@ class Config:
             batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),
             compression=_env_str("COMPRESSION", "none"),
             adasum=_env_bool("ADASUM", False),
+            hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE",
+                                             False),
+            uniform_local_size=_env_int("UNIFORM_LOCAL_SIZE", 0),
             timeline_filename=_env_str("TIMELINE"),
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
             stall_check_disable=_env_bool("STALL_CHECK_DISABLE", False),

@@ -115,6 +115,20 @@ class ProcessSet:
         )
 
 
+def participant_count(process_set) -> int:
+    """Number of ranks a collective spans: the process set's size, or
+    the world when none is given.  Shared by every frontend so the
+    resolution rule cannot drift between them."""
+    from . import state as core_state
+
+    if process_set is None:
+        return core_state.global_state().size
+    if isinstance(process_set, int):
+        st = core_state.require_init("process-set lookup")
+        return st.process_set_table.get(process_set).size
+    return process_set.size
+
+
 class ProcessSetTable:
     """Registry of process sets; id 0 is always the global set.
 

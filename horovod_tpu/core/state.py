@@ -82,6 +82,19 @@ def init(config: Optional[Config] = None) -> GlobalState:
             return _state
         cfg = config or Config.from_env()
 
+        # Apply the configured log level to the framework's logger tree
+        # (parity: HOROVOD_LOG_LEVEL gating logging.cc's LOG macros).
+        import logging as _logging
+
+        _LEVELS = {
+            "trace": _logging.DEBUG, "debug": _logging.DEBUG,
+            "info": _logging.INFO, "warning": _logging.WARNING,
+            "error": _logging.ERROR, "fatal": _logging.CRITICAL,
+        }
+        _logging.getLogger("horovod_tpu").setLevel(
+            _LEVELS.get(str(cfg.log_level).lower(), _logging.WARNING)
+        )
+
         # Elastic worker: install the driver-notification (SIGUSR1)
         # handler BEFORE the (potentially long) rendezvous below, so a
         # membership change during startup sets the flag instead of

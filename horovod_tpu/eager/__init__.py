@@ -48,6 +48,7 @@ def get_controller() -> EagerController:
                               else cfg.stall_check_time_seconds),
                 stall_abort_s=cfg.stall_shutdown_time_seconds,
                 timeline=st.timeline,
+                autotuner=st.autotuner,
                 process_sets=process_sets,
             )
             controller.start()

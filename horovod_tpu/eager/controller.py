@@ -219,6 +219,7 @@ class EagerController:
                  stall_abort_s: float = 0.0,
                  transport=None,
                  timeline=None,
+                 autotuner=None,
                  process_sets: Optional[Dict[int, List[int]]] = None,
                  manual: bool = False):
         self.rank, self.size = rank, size
@@ -243,6 +244,7 @@ class EagerController:
             LocalTransport() if size == 1 else KVTransport(rank, size)
         )
         self._timeline = timeline
+        self._autotuner = autotuner
         self._seq = itertools.count(1)
         self._noname: Dict[str, itertools.count] = {}
         self._group_ids = itertools.count(1)
@@ -472,6 +474,16 @@ class EagerController:
         rl = wire.parse_response_list(resp_blob)
         if rl.responses or rl.join_last_rank >= 0:
             self._execute(rl, finished)
+        if rl.responses and self._autotuner is not None:
+            # Parity: ParameterManager.Update — score each cycle by the
+            # bytes it moved, then LIVE-apply the tuner's current
+            # (fusion threshold, cycle time) to the running controller.
+            self._autotuner.record_step(
+                sum(rs.total_bytes for rs in rl.responses)
+            )
+            thr, cyc_ms = self._autotuner.current
+            self._ctrl.set_fusion_threshold(int(thr))
+            self.cycle_time_s = cyc_ms / 1000.0
         if cycle % 256 == 0:
             self._inspect_stalls()
 

@@ -32,6 +32,7 @@ from ..runner.launch import (
     build_ssh_command,
     build_worker_env,
     find_free_port,
+    uniform_local_size,
 )
 from .discovery import HostDiscoveryScript, HostManager
 from .worker import RESET_EXIT_CODE
@@ -116,9 +117,11 @@ class ElasticDriver:
         import threading
 
         lock = threading.Lock()
+        uniform = uniform_local_size(slots)
         for slot in slots:
             env = build_worker_env(
                 base_env, slot, coordinator_addr, port, self.args,
+                uniform_local=uniform,
             )
             if hosts_mod.is_local_host(slot.hostname):
                 cmd = list(self.command)

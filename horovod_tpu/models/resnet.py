@@ -21,6 +21,8 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from .tpu_norm import TpuBatchNorm
+
 ModuleDef = Any
 
 
@@ -96,7 +98,7 @@ class ResNet(nn.Module):
             nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
         )
         norm = partial(
-            nn.BatchNorm,
+            TpuBatchNorm,  # flax-BatchNorm semantics, TPU-fast stats
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,

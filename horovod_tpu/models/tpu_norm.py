@@ -1,0 +1,105 @@
+"""TPU-tuned BatchNorm.
+
+Drop-in replacement for ``flax.linen.BatchNorm`` with the same
+semantics (running stats, ``axis_name`` cross-replica sync — the
+SyncBatchNorm analog of horovod/torch/sync_batch_norm.py), but with the
+statistics computed over a FLATTENED (N*H*W, C) view: XLA:TPU lowers
+the 2-D column reduce to a fast single-pass kernel, while the
+multi-axis (0, 1, 2) spatial reduce flax emits runs an order of
+magnitude slower on this hardware (measured ~14x on v5e — it dominated
+the ResNet-50 step before this).
+
+Stats accumulate in float32 regardless of compute dtype (same as flax).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class TpuBatchNorm(nn.Module):
+    """BatchNorm over the last axis with TPU-fast statistics.
+
+    Matches flax.linen.BatchNorm's interface for the subset the models
+    here use: feature axis -1, running stats in a ``batch_stats``
+    collection, optional cross-replica ``axis_name``.
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.99
+    epsilon: float = 1e-5
+    dtype: Optional[Any] = None
+    axis_name: Optional[str] = None
+    use_bias: bool = True
+    use_scale: bool = True
+    bias_init: Callable = nn.initializers.zeros
+    scale_init: Callable = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average,
+            use_running_average,
+        )
+        feats = x.shape[-1]
+        ra_mean = self.variable(
+            "batch_stats", "mean",
+            lambda: jnp.zeros((feats,), jnp.float32),
+        )
+        ra_var = self.variable(
+            "batch_stats", "var",
+            lambda: jnp.ones((feats,), jnp.float32),
+        )
+
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            # TPU-fast statistics: flatten every non-feature axis so the
+            # reduce is a plain 2-D column reduction; convert-to-f32
+            # fuses into the reduce (one read of x).
+            x2 = x.reshape(-1, feats)
+            n = x2.shape[0]
+            mean = jnp.mean(x2, axis=0, dtype=jnp.float32)
+            mean_sq = jnp.mean(
+                jnp.square(x2.astype(jnp.float32)), axis=0
+            )
+            if self.axis_name is not None:
+                # cross-replica sync (SyncBatchNorm): average the
+                # moments, not the variances
+                mean = jax.lax.pmean(mean, self.axis_name)
+                mean_sq = jax.lax.pmean(mean_sq, self.axis_name)
+            var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+            if not self.is_initializing():
+                ra_mean.value = (
+                    self.momentum * ra_mean.value
+                    + (1.0 - self.momentum) * mean
+                )
+                # flax parity: running var uses the biased batch var
+                ra_var.value = (
+                    self.momentum * ra_var.value
+                    + (1.0 - self.momentum) * var
+                )
+
+        dtype = self.dtype or x.dtype
+        inv = jax.lax.rsqrt(var + self.epsilon)
+        if self.use_scale:
+            scale = self.param(
+                "scale", self.scale_init, (feats,), jnp.float32
+            )
+            inv = inv * scale
+        # Fold (mean, inv, bias) into per-channel (a, b) in fp32, then
+        # run the big elementwise pass in the compute dtype — keeps the
+        # activation traffic at bf16 width (fp32 here would double the
+        # step's dominant HBM cost).
+        shift = -mean * inv
+        if self.use_bias:
+            bias = self.param(
+                "bias", self.bias_init, (feats,), jnp.float32
+            )
+            shift = shift + bias
+        y = x * inv.astype(dtype) + shift.astype(dtype)
+        return y.astype(dtype)

@@ -159,6 +159,7 @@ class NativeController:
         )
         self.rank = rank
         self.size = size
+        self.fusion_threshold = fusion_threshold
 
     def close(self):
         if self._ptr:
@@ -233,6 +234,7 @@ class NativeController:
         return self._lib.hvt_controller_cache_size(self._ptr)
 
     def set_fusion_threshold(self, nbytes: int):
+        self.fusion_threshold = nbytes
         self._lib.hvt_controller_set_fusion_threshold(self._ptr, nbytes)
 
     def check_stalls(self) -> List[dict]:

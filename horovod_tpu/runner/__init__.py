@@ -54,6 +54,7 @@ def run(
     kwargs: Optional[Dict[str, Any]] = None,
     np: int = 2,
     cpu_devices: Optional[int] = None,
+    hosts: Optional[str] = None,
     env: Optional[Dict[str, str]] = None,
     timeout: Optional[float] = 600.0,
     start_timeout: Optional[float] = None,  # rendezvous window (env)
@@ -95,7 +96,9 @@ def run(
         ns = launch_mod.parse_args(argv)
         base_env = dict(os.environ)
         base_env.update(env or {})
-        host_spec = f"localhost:{np}"
+        # hosts: e.g. "localhost:2,127.0.0.1:2" to shape local/cross
+        # topology while still spawning locally (both names are local)
+        host_spec = hosts or f"localhost:{np}"
         slots = get_host_assignments(parse_host_spec(host_spec), np)
         port = launch_mod.find_free_port()
         code = launch_workers(

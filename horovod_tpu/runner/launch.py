@@ -126,12 +126,22 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     return args
 
 
+def uniform_local_size(slots: List[SlotInfo]) -> int:
+    """The common per-host slot count when the layout is uniform (every
+    host has the same local_size), else 0.  Hierarchical collectives
+    require a uniform grid; the launcher is the one place that can see
+    the whole layout, so it certifies uniformity to the workers."""
+    sizes = {s.local_size for s in slots}
+    return slots[0].local_size if len(sizes) == 1 else 0
+
+
 def build_worker_env(
     base_env: Dict[str, str],
     slot: SlotInfo,
     coordinator_addr: str,
     coordinator_port: int,
     args: Optional[argparse.Namespace] = None,
+    uniform_local: Optional[int] = None,
 ) -> Dict[str, str]:
     """Per-rank environment (parity: the env block launch_gloo exports —
     HOROVOD_RANK/SIZE/LOCAL_RANK/LOCAL_SIZE/CROSS_RANK/CROSS_SIZE plus
@@ -147,6 +157,8 @@ def build_worker_env(
         HVTPU_COORDINATOR_ADDR=coordinator_addr,
         HVTPU_COORDINATOR_PORT=str(coordinator_port),
     )
+    if uniform_local is not None:
+        env["HVTPU_UNIFORM_LOCAL_SIZE"] = str(uniform_local)
     if args is not None:
         flag_env = {
             "HVTPU_FUSION_THRESHOLD_MB": args.fusion_threshold_mb,
@@ -219,11 +231,13 @@ def launch_workers(
     """
     base_env = dict(base_env if base_env is not None else os.environ)
     stdout_lock = threading.Lock()
+    uniform = uniform_local_size(slots)
     workers: List[safe_shell_exec.WorkerProcess] = []
     try:
         for slot in slots:
             env = build_worker_env(
-                base_env, slot, coordinator_addr, coordinator_port, args
+                base_env, slot, coordinator_addr, coordinator_port, args,
+                uniform_local=uniform,
             )
             if hosts_mod.is_local_host(slot.hostname):
                 cmd = list(command)

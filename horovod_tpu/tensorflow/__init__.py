@@ -71,12 +71,58 @@ from .mpi_ops import (  # noqa: E402
 
 def broadcast_variables(variables, root_rank: int = 0, process_set=None):
     """Assign every variable its root-rank value (parity:
-    hvd.broadcast_variables)."""
-    for var in variables:
-        var.assign(
-            broadcast(tf.convert_to_tensor(var), root_rank=root_rank,
-                      process_set=process_set)
-        )
+    hvd.broadcast_variables).
+
+    All variables ride ONE fused byte buffer: the native thread pool
+    packs the host values in parallel, a single broadcast moves the
+    bytes, and each variable is assigned its slice (the same
+    FusionBufferManager-style fast path as the torch frontend's
+    broadcast_parameters).
+    """
+    import numpy as np
+
+    from ..native import core as native_core
+
+    variables = [v for v in variables if v is not None]
+    if not variables:
+        return
+    if len(variables) == 1 or not tf.executing_eagerly():
+        # graph mode (inside tf.function): the fused path needs host
+        # numpy values; take the per-variable broadcast, which routes
+        # through tf.py_function and stays trace-compatible
+        for v in variables:
+            v.assign(
+                broadcast(tf.convert_to_tensor(v), root_rank=root_rank,
+                          process_set=process_set)
+            )
+        return
+    raws = [v.numpy() for v in variables]
+    # NB: np.ascontiguousarray promotes 0-d to 1-d; keep true shapes
+    shapes = [r.shape for r in raws]
+    vals = [np.ascontiguousarray(r) for r in raws]
+    views = [val.reshape(-1).view(np.uint8) for val in vals]
+    total = sum(v.nbytes for v in views)
+    buf = np.empty(total, np.uint8)
+    native_core.parallel_gather(
+        memoryview(buf), [memoryview(v) for v in views]
+    )
+    from ..comm import eager as _eager_comm
+
+    out = np.asarray(_eager_comm.broadcast(
+        buf, root_rank=root_rank, process_set=process_set
+    ))
+    off = 0
+    for var, val, shape in zip(variables, vals, shapes):
+        n = val.nbytes
+        chunk = out[off:off + n]
+        try:
+            piece = chunk.view(val.dtype).reshape(shape)
+        except ValueError:  # unaligned offset for this dtype
+            piece = np.frombuffer(
+                chunk.tobytes(), dtype=val.dtype
+            ).reshape(shape)
+        var.assign(piece)
+        off += n
 
 
 def broadcast_object(obj, root_rank: int = 0, process_set=None):
@@ -144,13 +190,13 @@ class _DistributedGradientTape:
             process_set=self._process_set,
         )
 
-    def gradient(self, target, sources, output_gradients=None):
+    def gradient(self, target, sources, output_gradients=None, **kwargs):
         grads = self.__dict__["_tape"].gradient(
-            target, sources, output_gradients
+            target, sources, output_gradients, **kwargs
         )
-        if isinstance(grads, (list, tuple)):
-            return type(grads)(self._allreduce_one(g) for g in grads)
-        return self._allreduce_one(grads)
+        # sources may be an arbitrary nest (list/tuple/dict); allreduce
+        # every leaf (None leaves pass through)
+        return tf.nest.map_structure(self._allreduce_one, grads)
 
 
 def DistributedGradientTape(gradtape, device_dense="", device_sparse="",

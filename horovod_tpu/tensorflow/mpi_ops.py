@@ -42,14 +42,7 @@ def _engine_compression(compression):
     return EngineCompression.none
 
 
-def _participant_count(process_set) -> int:
-    """Number of ranks the collective spans (set size, or world)."""
-    if process_set is None:
-        return _hvt.size()
-    if isinstance(process_set, int):
-        st = _hvt.core.state.require_init("process-set lookup")
-        return st.process_set_table.get(process_set).size
-    return process_set.size
+from ..core.process_set import participant_count as _participant_count
 
 
 def predivide_scaling(op, gradient_predivide_factor: float, process_set):

@@ -78,6 +78,7 @@ from .mpi_ops import (  # noqa: E402
     poll,
     reducescatter,
     reducescatter_async,
+    sparse_allreduce_async,
     synchronize,
 )
 from .functions import (  # noqa: E402
@@ -105,7 +106,7 @@ __all__ = [
     "allgather", "allgather_async", "broadcast", "broadcast_",
     "broadcast_async", "broadcast_async_", "alltoall", "alltoall_async",
     "reducescatter", "reducescatter_async", "barrier", "join",
-    "synchronize", "poll",
+    "sparse_allreduce_async", "synchronize", "poll",
     "broadcast_parameters", "broadcast_optimizer_state",
     "broadcast_object", "allgather_object",
     "DistributedOptimizer", "SyncBatchNorm",

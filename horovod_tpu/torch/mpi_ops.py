@@ -1,13 +1,16 @@
 """Torch-tensor collectives over the TPU engine (parity:
 horovod/torch/mpi_ops.py + the C++ binding horovod/torch/mpi_ops_v2.cc).
 
-Where the reference wraps ``at::Tensor`` into ``TorchTensor`` adapters
-and enqueues into the C++ core, here the adapter boundary is
-torch(CPU) ↔ numpy ↔ jax: zero-copy for contiguous CPU tensors in both
-directions (``Tensor.numpy()`` / ``torch.from_numpy``).  Sync ops call
-the engine directly; async ops flow through the eager mini-controller
-(out-of-order enqueue tolerance, fusion, response cache) and return
-integer handles compatible with ``synchronize``/``poll``.
+Adapter boundary (parity: the zero-copy ``TorchTensor``/``TorchOpContext``
+adapters of adapter_v2.cc): contiguous torch tensors enter jax via
+**DLPack** with no host copy (bf16 included — DLPack carries it even
+though numpy can't), and results return to torch via
+``torch.from_dlpack`` sharing the engine's output buffer.  Only
+non-contiguous inputs and fp64 (jax x64-mode caveat) fall back to the
+numpy path.  Sync ops call the engine directly; async ops flow through
+the eager mini-controller (out-of-order enqueue tolerance, fusion,
+response cache) and return integer handles compatible with
+``synchronize``/``poll``.
 """
 
 from __future__ import annotations
@@ -15,6 +18,7 @@ from __future__ import annotations
 import warnings
 from typing import List, Optional
 
+import jax
 import numpy as np
 import torch
 
@@ -37,7 +41,21 @@ _TORCH_HANDLES = {}  # handle -> (payload for post-processing)
 _warned_fp64 = False
 
 
+def _warn_fp64():
+    global _warned_fp64
+    if not jax.config.jax_enable_x64 and not _warned_fp64:
+        _warned_fp64 = True
+        warnings.warn(
+            "float64 tensor reduced without jax_enable_x64: the "
+            "collective runs at float32 wire precision and the result "
+            "is cast back to float64.  Set jax.config.update("
+            "'jax_enable_x64', True) for true-fp64 collectives.",
+            UserWarning, stacklevel=4,
+        )
+
+
 def _to_np(tensor: torch.Tensor) -> np.ndarray:
+    """Numpy fallback path (non-contiguous/fp64/exotic layouts)."""
     t = tensor.detach()
     if not t.is_contiguous():
         t = t.contiguous()
@@ -45,18 +63,38 @@ def _to_np(tensor: torch.Tensor) -> np.ndarray:
         # numpy has no bf16; round-trip via fp32 (values preserved).
         return t.to(torch.float32).numpy()
     if t.dtype == torch.float64:
-        import jax
-        global _warned_fp64
-        if not jax.config.jax_enable_x64 and not _warned_fp64:
-            _warned_fp64 = True
-            warnings.warn(
-                "float64 tensor reduced without jax_enable_x64: the "
-                "collective runs at float32 wire precision and the result "
-                "is cast back to float64.  Set jax.config.update("
-                "'jax_enable_x64', True) for true-fp64 collectives.",
-                UserWarning, stacklevel=3,
-            )
+        _warn_fp64()
     return t.numpy()
+
+
+def _to_jax(tensor: torch.Tensor):
+    """torch → jax with zero host copy for contiguous CPU tensors via
+    DLPack (parity: adapter_v2.cc wrapping at::Tensor storage directly;
+    SURVEY.md §7.2 hard part 1).  The jax array aliases the torch
+    buffer — like the reference, the caller must not mutate the tensor
+    until the collective completes (synchronize for async ops)."""
+    t = tensor.detach()
+    if not t.is_contiguous():
+        t = t.contiguous()
+    if t.dtype == torch.float64:
+        _warn_fp64()
+        return t.numpy()  # x64 truncation semantics live in jnp.asarray
+    try:
+        return jax.dlpack.from_dlpack(t)
+    except Exception:
+        return _to_np(t)
+
+
+def _from_jax(arr, like: Optional[torch.Tensor] = None) -> torch.Tensor:
+    """jax → torch sharing the engine's output buffer via DLPack
+    (falls back to a numpy copy when the consumer can't import it)."""
+    try:
+        out = torch.from_dlpack(arr)
+    except Exception:
+        return _from_np(np.asarray(arr), like)
+    if like is not None and out.dtype != like.dtype:
+        out = out.to(like.dtype)
+    return out
 
 
 def _from_np(arr, like: Optional[torch.Tensor] = None) -> torch.Tensor:
@@ -95,12 +133,12 @@ def allreduce(tensor: torch.Tensor, average=None, name=None,
     """Averaged (by default) allreduce returning a NEW tensor (parity:
     hvd.allreduce in horovod/torch/mpi_ops.py)."""
     out = _hvt.allreduce(
-        _to_np(tensor), op=op, average=average,
+        _to_jax(tensor), op=op, average=average,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         compression=_engine_compression(compression),
         process_set=process_set, name=name,
     )
-    return _from_np(np.asarray(out), like=tensor).reshape(tensor.shape)
+    return _from_jax(out, like=tensor).reshape(tensor.shape)
 
 
 def allreduce_(tensor: torch.Tensor, average=None, name=None,
@@ -121,12 +159,12 @@ def grouped_allreduce(tensors: List[torch.Tensor], average=None, name=None,
                       compression=Compression.none, op=None,
                       process_set=None) -> List[torch.Tensor]:
     outs = _hvt.grouped_allreduce(
-        [_to_np(t) for t in tensors], op=op, average=average,
+        [_to_jax(t) for t in tensors], op=op, average=average,
         compression=_engine_compression(compression),
         process_set=process_set,
     )
     return [
-        _from_np(np.asarray(o), like=t).reshape(t.shape)
+        _from_jax(o, like=t).reshape(t.shape)
         for o, t in zip(outs, tensors)
     ]
 
@@ -142,15 +180,15 @@ def allgather(tensor: torch.Tensor, name=None, process_set=None
               ) -> torch.Tensor:
     """Concatenate along dim 0 across ranks (ragged dim-0 supported;
     parity: hvd.allgather / allgather size negotiation)."""
-    out = _hvt.allgather(_to_np(tensor), process_set=process_set, name=name)
-    return _from_np(np.asarray(out), like=tensor)
+    out = _hvt.allgather(_to_jax(tensor), process_set=process_set, name=name)
+    return _from_jax(out, like=tensor)
 
 
 def broadcast(tensor: torch.Tensor, root_rank: int = 0, name=None,
               process_set=None) -> torch.Tensor:
-    out = _hvt.broadcast(_to_np(tensor), root_rank=root_rank,
+    out = _hvt.broadcast(_to_jax(tensor), root_rank=root_rank,
                          process_set=process_set, name=name)
-    return _from_np(np.asarray(out), like=tensor).reshape(tensor.shape)
+    return _from_jax(out, like=tensor).reshape(tensor.shape)
 
 
 def broadcast_(tensor: torch.Tensor, root_rank: int = 0, name=None,
@@ -165,20 +203,20 @@ def alltoall(tensor: torch.Tensor, splits: Optional[torch.Tensor] = None,
     hvd.alltoall; returns (output, received_splits) like the reference
     when splits is given)."""
     splits_np = None if splits is None else _to_np(splits)
-    out = _hvt.alltoall(_to_np(tensor), splits_np, process_set=process_set,
+    out = _hvt.alltoall(_to_jax(tensor), splits_np, process_set=process_set,
                         name=name)
     if isinstance(out, tuple):
         data, rsplits = out
-        return (_from_np(np.asarray(data), like=tensor),
+        return (_from_jax(data, like=tensor),
                 torch.as_tensor(np.asarray(rsplits)))
-    return _from_np(np.asarray(out), like=tensor)
+    return _from_jax(out, like=tensor)
 
 
 def reducescatter(tensor: torch.Tensor, op=None, name=None,
                   process_set=None) -> torch.Tensor:
-    out = _hvt.reducescatter(_to_np(tensor), op=op, process_set=process_set,
+    out = _hvt.reducescatter(_to_jax(tensor), op=op, process_set=process_set,
                              name=name)
-    return _from_np(np.asarray(out), like=tensor)
+    return _from_jax(out, like=tensor)
 
 
 def barrier(process_set=None):
@@ -195,7 +233,7 @@ def allreduce_async(tensor: torch.Tensor, average=None, name=None,
                     postscale_factor: float = 1.0,
                     process_set=None) -> int:
     handle = _hvt.allreduce_async(
-        _to_np(tensor), op=op, average=average, name=name,
+        _to_jax(tensor), op=op, average=average, name=name,
         compression=_engine_compression(compression),
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set=process_set,
@@ -212,7 +250,7 @@ def allreduce_async_(tensor: torch.Tensor, average=None, name=None,
     """Async in-place allreduce: result lands in ``tensor`` at
     synchronize (parity: hvd.allreduce_async_)."""
     handle = _hvt.allreduce_async(
-        _to_np(tensor), op=op, average=average, name=name,
+        _to_jax(tensor), op=op, average=average, name=name,
         compression=_engine_compression(compression),
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set=process_set,
@@ -226,7 +264,7 @@ def grouped_allreduce_async(tensors: List[torch.Tensor], average=None,
                             compression=Compression.none,
                             process_set=None) -> List[int]:
     handles = _hvt.grouped_allreduce_async(
-        [_to_np(t) for t in tensors], op=op, average=average, names=names,
+        [_to_jax(t) for t in tensors], op=op, average=average, names=names,
         compression=_engine_compression(compression),
         process_set=process_set,
     )
@@ -236,7 +274,7 @@ def grouped_allreduce_async(tensors: List[torch.Tensor], average=None,
 
 
 def allgather_async(tensor: torch.Tensor, name=None, process_set=None) -> int:
-    handle = _hvt.allgather_async(_to_np(tensor), name=name,
+    handle = _hvt.allgather_async(_to_jax(tensor), name=name,
                                   process_set=process_set)
     _TORCH_HANDLES[handle] = ("gather", tensor)
     return handle
@@ -244,7 +282,7 @@ def allgather_async(tensor: torch.Tensor, name=None, process_set=None) -> int:
 
 def broadcast_async(tensor: torch.Tensor, root_rank: int = 0, name=None,
                     process_set=None) -> int:
-    handle = _hvt.broadcast_async(_to_np(tensor), root_rank=root_rank,
+    handle = _hvt.broadcast_async(_to_jax(tensor), root_rank=root_rank,
                                   name=name, process_set=process_set)
     _TORCH_HANDLES[handle] = ("new", tensor)
     return handle
@@ -252,7 +290,7 @@ def broadcast_async(tensor: torch.Tensor, root_rank: int = 0, name=None,
 
 def broadcast_async_(tensor: torch.Tensor, root_rank: int = 0, name=None,
                      process_set=None) -> int:
-    handle = _hvt.broadcast_async(_to_np(tensor), root_rank=root_rank,
+    handle = _hvt.broadcast_async(_to_jax(tensor), root_rank=root_rank,
                                   name=name, process_set=process_set)
     _TORCH_HANDLES[handle] = ("inplace", tensor)
     return handle
@@ -261,7 +299,7 @@ def broadcast_async_(tensor: torch.Tensor, root_rank: int = 0, name=None,
 def alltoall_async(tensor: torch.Tensor, splits=None, name=None,
                    process_set=None) -> int:
     splits_np = None if splits is None else _to_np(splits)
-    handle = _hvt.alltoall_async(_to_np(tensor), splits_np, name=name,
+    handle = _hvt.alltoall_async(_to_jax(tensor), splits_np, name=name,
                                  process_set=process_set)
     _TORCH_HANDLES[handle] = ("gather", tensor)
     return handle
@@ -269,24 +307,89 @@ def alltoall_async(tensor: torch.Tensor, splits=None, name=None,
 
 def reducescatter_async(tensor: torch.Tensor, op=None, name=None,
                         process_set=None) -> int:
-    handle = _hvt.reducescatter_async(_to_np(tensor), op=op, name=name,
+    handle = _hvt.reducescatter_async(_to_jax(tensor), op=op, name=name,
                                       process_set=process_set)
     _TORCH_HANDLES[handle] = ("gather", tensor)
     return handle
 
 
-def synchronize(handle: int):
+class SparseAllreduceHandle:
+    """Handle for a sparse allreduce: two allgathers in flight (parity:
+    horovod/torch/mpi_ops.py sparse_allreduce_async's handle tuple —
+    indices + values, reassembled at synchronize)."""
+
+    def __init__(self, h_indices: int, h_values: int, shape, op, like,
+                 divisor: int):
+        self.h_indices = h_indices
+        self.h_values = h_values
+        self.shape = tuple(shape)
+        self.op = op
+        self.like = like
+        self.divisor = divisor
+
+
+_sparse_noname = iter(range(1 << 62))
+
+
+from ..core.process_set import participant_count as _participant_count
+
+
+def sparse_allreduce_async(tensor: torch.Tensor, name=None, op=None,
+                           process_set=None) -> SparseAllreduceHandle:
+    """Allreduce a ``torch.sparse_coo`` tensor (embedding gradients):
+    every rank's (indices, values) are allgathered; synchronize
+    reassembles and coalesces (duplicate indices sum), dividing by the
+    participating rank count for Average (parity:
+    sparse_allreduce_async in horovod/torch/mpi_ops.py).
+    """
+    if not tensor.is_sparse:
+        raise ValueError("sparse_allreduce_async expects a sparse tensor")
+    rop = op if op is not None else Average
+    if rop not in (Sum, Average):
+        raise ValueError(
+            "sparse_allreduce_async supports op=Sum or Average"
+        )
+    t = tensor.detach().coalesce()
+    name = name or f"sparse_allreduce.noname.{next(_sparse_noname)}"
+    # indices: (sparse_dim, nnz) -> rows = nnz for the ragged allgather
+    idx_rows = t.indices().t().contiguous()
+    h_i = _hvt.allgather_async(_to_jax(idx_rows), name=f"{name}.indices",
+                               process_set=process_set)
+    h_v = _hvt.allgather_async(_to_jax(t.values().contiguous()),
+                               name=f"{name}.values",
+                               process_set=process_set)
+    return SparseAllreduceHandle(
+        h_i, h_v, t.shape, rop, t.values(),
+        divisor=_participant_count(process_set),
+    )
+
+
+def _synchronize_sparse(handle: SparseAllreduceHandle) -> torch.Tensor:
+    idx = _from_jax(_hvt.synchronize(handle.h_indices))
+    vals = _from_jax(_hvt.synchronize(handle.h_values),
+                     like=handle.like)
+    if handle.op == Average:
+        vals = vals / float(handle.divisor)
+    out = torch.sparse_coo_tensor(
+        idx.t().to(torch.int64), vals, size=handle.shape
+    )
+    return out.coalesce()
+
+
+def synchronize(handle):
     """Wait for an async op; returns the torch result (and applies the
     in-place semantics for *_async_ variants)."""
+    if isinstance(handle, SparseAllreduceHandle):
+        return _synchronize_sparse(handle)
     mode, ref = _TORCH_HANDLES.pop(handle, ("new", None))
     out = _hvt.synchronize(handle)
     if isinstance(out, tuple):  # alltoall with splits
         data, rsplits = out
-        return (_from_np(np.asarray(data), like=ref),
+        return (_from_jax(data, like=ref),
                 torch.as_tensor(np.asarray(rsplits)))
     if out is None:  # barrier-like
         return None
-    result = _from_np(np.asarray(out), like=ref)
+    result = _from_jax(out, like=ref)
     if mode == "inplace" and ref is not None:
         ref.data.copy_(result.reshape(ref.shape))
         return ref
@@ -295,7 +398,10 @@ def synchronize(handle: int):
     return result
 
 
-def poll(handle: int) -> bool:
+def poll(handle) -> bool:
+    if isinstance(handle, SparseAllreduceHandle):
+        return (_hvt.poll(handle.h_indices)
+                and _hvt.poll(handle.h_values))
     return _hvt.poll(handle)
 
 

@@ -32,13 +32,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                  compression=Compression.none,
                  backward_passes_per_step: int = 1,
                  op=None, gradient_predivide_factor: float = 1.0,
-                 process_set=None):
+                 process_set=None, sparse_as_dense: bool = False):
         super(self.__class__, self).__init__(params)
         op = mpi_ops.Average if op is None else op
         if gradient_predivide_factor != 1.0 and op != mpi_ops.Average:
             raise ValueError(
                 "gradient_predivide_factor requires op=Average"
             )
+        self._sparse_as_dense = sparse_as_dense
         self._compression = compression
         self._op = op
         self._process_set = process_set
@@ -105,9 +106,26 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if self._passes[p] == self.backward_passes_per_step:
             self._handles[p] = self._allreduce_grad_async(p)
 
-    def _allreduce_grad_async(self, p) -> int:
+    def _allreduce_grad_async(self, p):
         name = self._parameter_names[p]
         grad = p.grad
+        if grad.is_sparse:
+            if self._sparse_as_dense:
+                grad = grad.to_dense()
+                p.grad = grad  # in-place allreduce target must be dense
+            else:
+                # parity: sparse grads route through the values+indices
+                # allgather (sparse_allreduce_async); predivide is a
+                # dense-path feature in the reference too.
+                if self._predivide != 1.0:
+                    raise ValueError(
+                        "gradient_predivide_factor is not supported "
+                        "with sparse gradients (use sparse_as_dense)"
+                    )
+                return mpi_ops.sparse_allreduce_async(
+                    grad, name=f"allreduce.{name}", op=self._op,
+                    process_set=self._process_set,
+                )
         if self._predivide != 1.0:
             prescale = 1.0 / self._predivide
             # Average over the ranks that actually participate: the
@@ -148,7 +166,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     p.grad = torch.zeros_like(p)
                 handle = self._allreduce_grad_async(p)
                 self._handles[p] = handle
-            mpi_ops.synchronize(handle)
+            result = mpi_ops.synchronize(handle)
+            if isinstance(handle, mpi_ops.SparseAllreduceHandle):
+                # sparse results can't land in-place; replace the grad
+                # (parity: p.grad = synchronize(handle) for sparse)
+                p.grad = result
         self._handles.clear()
         for p in self._passes:
             self._passes[p] = 0
@@ -193,7 +215,9 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          backward_passes_per_step: int = 1,
                          op=None,
                          gradient_predivide_factor: float = 1.0,
-                         process_set=None) -> torch.optim.Optimizer:
+                         process_set=None,
+                         sparse_as_dense: bool = False
+                         ) -> torch.optim.Optimizer:
     """Wrap ``optimizer`` for data-parallel training (parity:
     hvd.DistributedOptimizer for torch).
 
@@ -205,4 +229,4 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step, op, gradient_predivide_factor,
-               process_set)
+               process_set, sparse_as_dense)

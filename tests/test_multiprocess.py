@@ -275,6 +275,69 @@ def test_join_uneven_batches_2proc():
             assert out["b2"] == 4.0   # (8 + 0) / 2: zeros count in avg
 
 
+def test_hierarchical_allreduce_4proc():
+    """HVTPU_HIERARCHICAL_ALLREDUCE over a 2-host x 2-slot layout
+    (both 'hosts' are loopback names, so everything spawns locally but
+    local/cross topology is real): the two-stage (ici then dcn) reduce
+    must produce the same numbers as the flat path."""
+
+    def body():
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvt
+
+        hvt.init()
+        r = hvt.rank()
+        assert hvt.local_size() == 2 and hvt.cross_size() == 2
+        assert hvt.size() == 4
+        s = np.asarray(hvt.allreduce(
+            jnp.full((5,), float(r + 1)), op=hvt.Sum
+        )).tolist()
+        a = np.asarray(hvt.allreduce(
+            jnp.full((3,), float(10 * (r + 1))), op=hvt.Average
+        )).tolist()
+        return (r, s, a)
+
+    results = run(
+        body, np=4, cpu_devices=1,
+        hosts="localhost:2,127.0.0.1:2",
+        env={**_ENV, "HVTPU_HIERARCHICAL_ALLREDUCE": "1"},
+        start_timeout=300.0,
+    )
+    for r, s, a in results:
+        assert s == [10.0] * 5          # 1+2+3+4
+        assert a == [25.0] * 3          # avg(10,20,30,40)
+
+
+def test_sparse_allreduce_2proc():
+    """sparse_allreduce_async across real processes: overlapping and
+    disjoint embedding rows from two ranks coalesce to the cross-rank
+    sum (reference: entries+values allgather path)."""
+
+    def body():
+        import torch
+
+        import horovod_tpu.torch as hvd
+
+        hvd.init()
+        r = hvd.rank()
+        # rank 0 touches rows {0, 1}; rank 1 touches rows {1, 2}
+        i = torch.tensor([[0 + r, 1 + r]])
+        v = torch.tensor([[1.0 * (r + 1)], [10.0 * (r + 1)]])
+        sp = torch.sparse_coo_tensor(i, v, size=(4, 1))
+        out = hvd.synchronize(
+            hvd.sparse_allreduce_async(sp, name="emb", op=hvd.Sum)
+        )
+        return (r, out.to_dense().squeeze(1).tolist())
+
+    results = _run(body, np=2)
+    for r, dense in results:
+        # row0: rank0's 1.0; row1: rank0's 10.0 + rank1's 2.0; row2:
+        # rank1's 20.0
+        assert dense == [1.0, 12.0, 20.0, 0.0]
+
+
 def test_worker_failure_propagates():
     """One rank raising must fail the job with that rank's traceback
     and terminate the peers (reference: launcher exit-code handling)."""

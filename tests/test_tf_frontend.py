@@ -164,6 +164,24 @@ class TestKerasOptimizer:
         opt.apply([tf.constant([0.0])], [v])   # sync: avg(6,0)=3
         np.testing.assert_allclose(v.numpy(), [4.0])
 
+    def test_backward_passes_skip_stateful_updates(self, hvt):
+        """Micro-steps must not touch stateful optimizer slots or
+        iterations — with momentum, a zero-gradient apply would still
+        move variables, so the base apply must be SKIPPED entirely."""
+        opt = hvd_keras.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=1.0, momentum=0.9),
+            backward_passes_per_step=2,
+        )
+        v = tf.Variable([10.0])
+        opt.apply([tf.constant([2.0])], [v])
+        opt.apply([tf.constant([2.0])], [v])   # sync: momentum kicks in
+        after_first_sync = float(v.numpy()[0])
+        assert int(opt.iterations.numpy()) == 1  # one aggregate step
+        opt.apply([tf.constant([0.0])], [v])   # micro-step
+        # momentum must NOT have been applied on the micro-step
+        assert float(v.numpy()[0]) == after_first_sync
+        assert int(opt.iterations.numpy()) == 1
+
     def test_backward_passes_per_step_in_fit(self, hvt):
         rng = np.random.RandomState(0)
         x = rng.rand(64, 4).astype(np.float32)

@@ -204,6 +204,9 @@ class TestDistributedOptimizer:
         torch.nn.functional.cross_entropy(model(x), y).backward()
         with pytest.raises(AssertionError, match="more than"):
             torch.nn.functional.cross_entropy(model(x), y).backward()
+        # drain the first backward's pending handles so their names
+        # don't race the next test's enqueues
+        opt.synchronize()
 
     def test_zero_grad_mid_cycle_raises(self):
         model, x, y = self._model_and_data()
@@ -315,3 +318,120 @@ class TestSyncBatchNorm:
         x = torch.randn(6, 4, requires_grad=True)
         sbn(x).sum().backward()
         assert x.grad is not None
+
+
+class TestZeroCopyAdapter:
+    """The DLPack adapter boundary (VERDICT round-1 task 5): contiguous
+    fp32 tensors must cross torch->jax and jax->torch with NO host
+    copy, asserted by buffer pointer identity."""
+
+    def test_torch_to_jax_pointer_identity(self, hvt):
+        from horovod_tpu.torch.mpi_ops import _to_jax
+
+        t = torch.arange(16, dtype=torch.float32)
+        j = _to_jax(t)
+        assert t.data_ptr() == j.unsafe_buffer_pointer()
+
+    def test_jax_to_torch_pointer_identity(self, hvt):
+        import jax.numpy as jnp
+
+        from horovod_tpu.torch.mpi_ops import _from_jax
+
+        j = jnp.arange(8.0)
+        t = _from_jax(j)
+        assert t.data_ptr() == j.unsafe_buffer_pointer()
+
+    def test_bf16_rides_dlpack(self, hvt):
+        from horovod_tpu.torch.mpi_ops import _to_jax
+
+        t = torch.ones(8, dtype=torch.bfloat16)
+        j = _to_jax(t)
+        assert str(j.dtype) == "bfloat16"
+        assert t.data_ptr() == j.unsafe_buffer_pointer()
+        out = hvd.allreduce(t, op=hvd.Sum, name="bf16zc")
+        assert out.dtype == torch.bfloat16
+
+    def test_noncontiguous_falls_back(self, hvt):
+        t = torch.arange(16, dtype=torch.float32).reshape(4, 4).t()
+        out = hvd.allreduce(t, op=hvd.Sum, name="nc")
+        assert torch.allclose(out, t)
+
+
+class TestSparseAllreduce:
+    def test_sparse_allreduce_roundtrip(self, hvt):
+        i = torch.tensor([[0, 2, 0]])
+        v = torch.tensor([[1.0, 2.0], [3.0, 4.0], [10.0, 20.0]])
+        sp = torch.sparse_coo_tensor(i, v, size=(4, 2))
+        h = hvd.sparse_allreduce_async(sp, name="sp1", op=hvd.Sum)
+        out = hvd.synchronize(h)
+        assert out.is_sparse
+        dense = out.to_dense()
+        # duplicate index 0 coalesced: [11, 22]
+        assert dense[0].tolist() == [11.0, 22.0]
+        assert dense[2].tolist() == [3.0, 4.0]
+        assert dense[1].tolist() == [0.0, 0.0]
+
+    def test_sparse_average(self, hvt):
+        i = torch.tensor([[1]])
+        v = torch.tensor([[8.0]])
+        sp = torch.sparse_coo_tensor(i, v, size=(3, 1))
+        out = hvd.synchronize(
+            hvd.sparse_allreduce_async(sp, name="sp2", op=hvd.Average)
+        )
+        assert out.to_dense()[1].item() == 8.0  # size-1 world
+
+    def test_dense_tensor_rejected(self, hvt):
+        with pytest.raises(ValueError, match="sparse"):
+            hvd.sparse_allreduce_async(torch.ones(3), name="d")
+
+    def test_embedding_sparse_grads_through_optimizer(self, hvt):
+        emb = torch.nn.Embedding(10, 4, sparse=True)
+        opt = torch.optim.SGD(emb.parameters(), lr=0.5)
+        opt = hvd.DistributedOptimizer(
+            opt, named_parameters=emb.named_parameters()
+        )
+        w0 = emb.weight.detach().clone()
+        idx = torch.tensor([1, 3, 1])
+        loss = emb(idx).sum()
+        opt.zero_grad()
+        loss.backward()
+        assert emb.weight.grad.is_sparse
+        opt.step()
+        moved = (emb.weight.detach() - w0).abs().sum(dim=1)
+        assert moved[1] > 0 and moved[3] > 0
+        assert moved[0] == 0 and moved[2] == 0
+
+    def test_embedding_sparse_as_dense(self, hvt):
+        emb = torch.nn.Embedding(6, 2, sparse=True)
+        opt = torch.optim.SGD(emb.parameters(), lr=0.5)
+        opt = hvd.DistributedOptimizer(
+            opt, named_parameters=emb.named_parameters(),
+            sparse_as_dense=True,
+        )
+        loss = emb(torch.tensor([0, 5])).sum()
+        opt.zero_grad()
+        loss.backward()
+        opt.step()
+        assert not emb.weight.grad.is_sparse
+
+
+class TestFusedBroadcastParameters:
+    def test_mixed_dtype_state_dict(self, hvt):
+        """The fused byte-buffer path must handle fp32 + bf16 + int64
+        buffers in one broadcast and leave values intact (size-1
+        world: identity)."""
+        model = torch.nn.Sequential(
+            torch.nn.Linear(4, 3), torch.nn.BatchNorm1d(3)
+        )
+        model = model.to(torch.float32)
+        sd = model.state_dict()
+        before = {k: v.clone() for k, v in sd.items()}
+        hvd.broadcast_parameters(sd, root_rank=0)
+        for k, v in sd.items():
+            assert torch.equal(v, before[k]), k
+
+    def test_single_tensor_falls_through(self, hvt):
+        p = torch.nn.Parameter(torch.randn(5))
+        before = p.detach().clone()
+        hvd.broadcast_parameters([("w", p)], root_rank=0)
+        assert torch.equal(p.detach(), before)
