@@ -386,6 +386,12 @@ broadcast_optimizer_state = _functions.broadcast_optimizer_state
 broadcast_object = _functions.broadcast_object
 allgather_object = _functions.allgather_object
 
+from .api.checkpoint import (  # noqa: E402
+    Checkpointer,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
 __all__ = [
     "__version__",
     "init", "shutdown", "is_initialized",
@@ -400,6 +406,7 @@ __all__ = [
     "DistributedOptimizer", "allreduce_gradients",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
+    "Checkpointer", "save_checkpoint", "restore_checkpoint",
     "Compression", "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max",
     "Product",
     "ProcessSet", "add_process_set", "remove_process_set",

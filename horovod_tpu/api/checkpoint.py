@@ -1,0 +1,184 @@
+"""Checkpoint / resume helpers.
+
+Parity surface (SURVEY.md §5.4): the reference has no general
+checkpoint subsystem — its idioms are (a) elastic State commit/restore,
+(b) ``broadcast_parameters``/``broadcast_object`` fanning out a rank-0
+restored checkpoint, (c) rank-0-writes-checkpoint as an example-level
+convention.  The TPU-native replacement the survey prescribes is
+orbax-style async checkpointing; this module provides it with the same
+rank-0 conventions, falling back to pickle when orbax is unavailable.
+
+API::
+
+    ckpt = hvt.Checkpointer(dir)         # rank-0 writes, async
+    ckpt.save(step, {"params": params, "opt_state": opt_state})
+    state = ckpt.restore()                # newest step (rank 0 reads)
+    state = hvt.broadcast_object(state)   # classic reference fanout
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import pickle
+import re
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..core import state as core_state
+
+
+def _is_coordinator() -> bool:
+    # require_init: before init() every process would default to rank 0
+    # and N ranks would race writes into the same checkpoint dir
+    return core_state.require_init("checkpointing").rank == 0
+
+
+class Checkpointer:
+    """Async, rank-0-writes checkpointing (orbax-backed when available).
+
+    ``save`` returns immediately — serialization happens on a worker
+    thread (the orbax async idiom); ``wait`` blocks until the last save
+    is durable.  ``restore`` loads the newest (or a given) step.
+    """
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None,
+                 use_orbax: Optional[bool] = None):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        if use_orbax is None:
+            try:
+                import orbax.checkpoint  # noqa: F401
+
+                use_orbax = True
+            except ImportError:  # pragma: no cover - orbax is baked in
+                use_orbax = False
+        self.use_orbax = use_orbax
+        self._pending: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        # a daemon writer thread would be killed at interpreter exit,
+        # silently losing the final checkpoint of a run that never
+        # called wait() — join it at exit instead
+        atexit.register(self._wait_at_exit)
+        if _is_coordinator():
+            os.makedirs(self.directory, exist_ok=True)
+        if self.use_orbax:
+            import orbax.checkpoint as ocp
+
+            self._ocp = ocp.StandardCheckpointer()
+
+    # -- write side ----------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:012d}")
+
+    def save(self, step: int, payload: Dict[str, Any]):
+        """Queue an async save of ``payload`` at ``step`` (rank 0 only;
+        other ranks no-op, like the reference's rank-0 convention)."""
+        if not _is_coordinator():
+            return
+        self.wait()  # one in flight at a time (orbax semantics)
+
+        def _write():
+            try:
+                target = self._step_dir(step)
+                if self.use_orbax:
+                    self._ocp.save(target, payload, force=True)
+                    self._ocp.wait_until_finished()
+                else:  # pragma: no cover - fallback
+                    tmp = target + ".tmp"
+                    os.makedirs(tmp, exist_ok=True)
+                    with open(os.path.join(tmp, "state.pkl"), "wb") as f:
+                        pickle.dump(payload, f)
+                    os.replace(tmp, target)
+                self._gc()
+            except BaseException as e:  # surfaced at wait()/next save
+                self._error = e
+
+        self._pending = threading.Thread(target=_write, daemon=True)
+        self._pending.start()
+
+    def wait(self):
+        """Block until the last queued save is durable; re-raises any
+        failure from the async writer (a checkpoint that silently
+        never landed would lose work on the next crash)."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint save failed") from err
+
+    def _wait_at_exit(self):
+        try:
+            self.wait()
+        except Exception as e:  # can't raise during interpreter exit
+            print(f"hvtpu.Checkpointer: {e}", file=sys.stderr)
+
+    def _gc(self):
+        if not self.max_to_keep:
+            return
+        steps = self.all_steps()
+        for s in steps[:-self.max_to_keep]:
+            import shutil
+
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    # -- read side -----------------------------------------------------
+    def all_steps(self) -> List[int]:
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in os.listdir(self.directory):
+            m = re.fullmatch(r"step_(\d+)", name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: Optional[int] = None,
+                template: Optional[Dict[str, Any]] = None
+                ) -> Optional[Dict[str, Any]]:
+        """Load ``step`` (default: newest); None when no checkpoint.
+        ``template`` (a pytree of like-shaped arrays) enables orbax's
+        typed restoration."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        target = self._step_dir(step)
+        if self.use_orbax:
+            if template is not None:
+                return self._ocp.restore(target, template)
+            return self._ocp.restore(target)
+        with open(os.path.join(target, "state.pkl"), "rb") as f:
+            return pickle.load(f)
+
+
+def save_checkpoint(directory: str, step: int, payload: Dict[str, Any],
+                    max_to_keep: Optional[int] = None) -> Checkpointer:
+    """One-shot convenience: async rank-0 save (returns the
+    Checkpointer so callers can ``wait()``)."""
+    ckpt = Checkpointer(directory, max_to_keep=max_to_keep)
+    ckpt.save(step, payload)
+    return ckpt
+
+
+def restore_checkpoint(directory: str, step: Optional[int] = None,
+                       template: Optional[Dict[str, Any]] = None,
+                       broadcast: bool = True):
+    """Restore on rank 0 and (by default) fan out to every rank via
+    ``broadcast_object`` — the reference's restore idiom
+    (horovod/torch/functions.py broadcast fanout)."""
+    from . import functions as api_functions
+
+    st = core_state.require_init("restore_checkpoint")
+    payload = None
+    if st.rank == 0:
+        payload = Checkpointer(directory).restore(step, template)
+    if broadcast and st.size > 1:
+        payload = api_functions.broadcast_object(payload, root_rank=0)
+    return payload

@@ -58,10 +58,16 @@ def allreduce_gradients(
     st = core_state.global_state()
     # The tuner only participates when it actually chose the threshold —
     # an explicit fusion_threshold_bytes must neither be overridden nor
-    # feed scores for candidates that were never in effect.
+    # feed scores for candidates that were never in effect.  Restricted
+    # to SINGLE-PROCESS worlds: at P>1 the eager controller owns tuning
+    # (rank 0 scores, result broadcast in the ResponseList); a per-rank
+    # tuner here would diverge ranks' bucket plans (different flattened
+    # shapes for the same named collective) and double-count bytes on
+    # rank 0.
     use_autotune = (
         fusion_threshold_bytes is None
-        and st.initialized and st.autotuner is not None and axis_name is None
+        and st.initialized and st.autotuner is not None
+        and axis_name is None and st.size == 1
     )
     if fusion_threshold_bytes is None:
         if use_autotune:

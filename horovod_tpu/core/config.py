@@ -88,6 +88,10 @@ class Config:
     autotune_log: Optional[str] = None
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
+    # samples the GP (Bayesian) tuner takes before pinning the best
+    autotune_gp_samples: int = 12
+    # "gp" (Bayesian, reference parity) | "grid" (deterministic sweep)
+    autotune_mode: str = "gp"
 
     # --- logging ---
     log_level: str = "warning"
@@ -148,6 +152,8 @@ class Config:
             autotune_log=_env_str("AUTOTUNE_LOG"),
             autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),
             autotune_steps_per_sample=_env_int("AUTOTUNE_STEPS_PER_SAMPLE", 10),
+            autotune_gp_samples=_env_int("AUTOTUNE_GP_SAMPLES", 12),
+            autotune_mode=_env_str("AUTOTUNE_MODE", "gp"),
             log_level=_env_str("LOG_LEVEL", "warning"),
             rank=_env_int("RANK", 0),
             size=_env_int("SIZE", 1),

@@ -259,6 +259,13 @@ class EagerController:
         self._cycle = 0
         self._stall_logged: set = set()
         self._stop = threading.Event()
+        # set when a ResponseList carries shutdown=True (every rank
+        # announced) — the coordinated-quiesce signal
+        self._shutdown_seen = threading.Event()
+        # how long stop() keeps serving peers while waiting for global
+        # shutdown agreement (matches the transport's blocking-get
+        # budget; a hard-crashed peer surfaces as an error there first)
+        self.shutdown_linger_s = 600.0
         self._thread: Optional[threading.Thread] = None
         self._thread_error: Optional[BaseException] = None
 
@@ -272,9 +279,35 @@ class EagerController:
             )
             self._thread.start()
 
+    def request_shutdown(self):
+        """Announce this rank's shutdown in subsequent cycles WITHOUT
+        stopping the cycle loop (non-blocking half of the coordinated
+        shutdown; tests stopping several same-process controllers call
+        this on all of them before stop() so none lingers)."""
+        self._ctrl.set_shutdown()
+
     def stop(self):
+        # Coordinated shutdown (parity: horovod_shutdown negotiating
+        # DONE via the controller): announce, then KEEP CYCLING —
+        # serving peers' coordination — until every rank announced
+        # (the coordinator leaving early would strand ranks mid-op,
+        # e.g. a process-set collective whose response is never
+        # emitted).
+        if (self.size > 1 and not self.manual
+                and self._thread is not None and self._thread.is_alive()
+                and self._thread_error is None):
+            self._ctrl.set_shutdown()
+            deadline = time.monotonic() + self.shutdown_linger_s
+            while time.monotonic() < deadline:
+                if self._shutdown_seen.wait(timeout=0.1):
+                    break
+                # the cycle thread dying (stall abort, transport
+                # timeout) means agreement can never arrive
+                if (self._thread is None or not self._thread.is_alive()
+                        or self._thread_error is not None):
+                    break
         self._stop.set()
-        # Close the transport FIRST so a cycle thread blocked in a
+        # Close the transport so a cycle thread blocked in a
         # coordination-service get unblocks promptly (TransportClosed).
         self._transport.close()
         thread_exited = True
@@ -445,7 +478,7 @@ class EagerController:
             except TransportClosed:
                 # Clean shutdown while blocked on the wire; stop() fails
                 # any still-pending futures.
-                return
+                break
             except BaseException as e:  # noqa: BLE001 — must fail futures
                 self._thread_error = e
                 logger.exception("eager controller cycle failed")
@@ -455,6 +488,9 @@ class EagerController:
                     self._by_name.clear()
                 for p in payloads:
                     p.future.set_error(HorovodInternalError(str(e)))
+                return
+            if self._shutdown_seen.is_set():
+                # every rank announced shutdown: global quiesce
                 return
             elapsed = time.monotonic() - t0
             sleep = self.cycle_time_s - elapsed
@@ -474,16 +510,24 @@ class EagerController:
         rl = wire.parse_response_list(resp_blob)
         if rl.responses or rl.join_last_rank >= 0:
             self._execute(rl, finished)
-        if rl.responses and self._autotuner is not None:
-            # Parity: ParameterManager.Update — score each cycle by the
-            # bytes it moved, then LIVE-apply the tuner's current
-            # (fusion threshold, cycle time) to the running controller.
+        if rl.responses and self._autotuner is not None and self.rank == 0:
+            # Parity: ParameterManager.Update — the COORDINATOR scores
+            # each cycle by the bytes it moved and publishes the
+            # tuner's current (fusion threshold, cycle time) in the
+            # next ResponseList, so every rank applies identical values
+            # (per-rank tuners would diverge: scores depend on local
+            # wall clock).
             self._autotuner.record_step(
                 sum(rs.total_bytes for rs in rl.responses)
             )
             thr, cyc_ms = self._autotuner.current
-            self._ctrl.set_fusion_threshold(int(thr))
-            self.cycle_time_s = cyc_ms / 1000.0
+            self._ctrl.set_tuned(int(thr), int(cyc_ms * 1000.0))
+        if rl.tuned_fusion_threshold >= 0:
+            self._ctrl.set_fusion_threshold(int(rl.tuned_fusion_threshold))
+        if rl.tuned_cycle_time_us >= 0:
+            self.cycle_time_s = rl.tuned_cycle_time_us / 1e6
+        if rl.shutdown:
+            self._shutdown_seen.set()
         if cycle % 256 == 0:
             self._inspect_stalls()
 

@@ -67,6 +67,10 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.c_void_p, c.c_int, c.POINTER(c.c_int32), c.c_int,
     ]
     lib.hvt_controller_set_joined.argtypes = [c.c_void_p]
+    lib.hvt_controller_set_tuned.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int32
+    ]
+    lib.hvt_controller_set_shutdown.argtypes = [c.c_void_p]
     lib.hvt_controller_drain_requests.restype = c.c_int64
     lib.hvt_controller_drain_requests.argtypes = [
         c.c_void_p, c.POINTER(c.c_uint8), c.c_int64,
@@ -236,6 +240,17 @@ class NativeController:
     def set_fusion_threshold(self, nbytes: int):
         self.fusion_threshold = nbytes
         self._lib.hvt_controller_set_fusion_threshold(self._ptr, nbytes)
+
+    def set_tuned(self, fusion_threshold: int, cycle_time_us: int):
+        """Publish autotuned params in subsequent ResponseLists
+        (coordinator only; parity: ParameterManager broadcast)."""
+        self._lib.hvt_controller_set_tuned(
+            self._ptr, fusion_threshold, cycle_time_us
+        )
+
+    def set_shutdown(self):
+        """Announce this rank wants to shut down (next DrainRequests)."""
+        self._lib.hvt_controller_set_shutdown(self._ptr)
 
     def check_stalls(self) -> List[dict]:
         n = int(self._lib.hvt_controller_check_stalls(self._ptr, None, 0))

@@ -81,10 +81,13 @@ class PyController:
         self._cache = _ResponseCache(cache_capacity)
         self._groups: Dict[int, int] = {}
         self._joined = False
+        self._shutdown = False
         # coordinator state
         self._message_table: Dict[str, dict] = {}
         self._joined_ranks: Set[int] = set()
         self._last_joined_rank = -1
+        self._tuned_threshold = -1
+        self._tuned_cycle_us = -1
         self._shutdown_ranks: Set[int] = set()
         self._process_sets: Dict[int, List[int]] = {0: list(range(size))}
 
@@ -116,9 +119,21 @@ class PyController:
     def set_joined(self):
         self._joined = True
 
+    def set_tuned(self, fusion_threshold: int, cycle_time_us: int):
+        """Publish autotuned params in subsequent ResponseLists
+        (coordinator only; parity: ParameterManager broadcast)."""
+        with self._lock:
+            self._tuned_threshold = int(fusion_threshold)
+            self._tuned_cycle_us = int(cycle_time_us)
+
+    def set_shutdown(self):
+        """Announce this rank wants to shut down (next drain_requests)."""
+        self._shutdown = True
+
     def drain_requests(self) -> bytes:
         with self._lock:
-            rl = wire.RequestList(rank=self.rank, joined=self._joined)
+            rl = wire.RequestList(rank=self.rank, joined=self._joined,
+                                  shutdown=self._shutdown)
             for e in self._pending:
                 self._in_flight[e.name] = e
                 self._pending_names.discard(e.name)
@@ -209,7 +224,10 @@ class PyController:
 
     def compute_responses(self) -> bytes:
         with self._lock:
-            out = wire.ResponseList()
+            out = wire.ResponseList(
+                tuned_fusion_threshold=self._tuned_threshold,
+                tuned_cycle_time_us=self._tuned_cycle_us,
+            )
             # deterministic (psid, name) order == std::map iteration
             ready = [
                 key for key in sorted(self._message_table)
@@ -262,11 +280,41 @@ class PyController:
                 responses.append(rs)
                 del self._message_table[key]
             out.responses = self._fuse(responses)
+            # pending tensors that can never complete because a REQUIRED
+            # rank announced shutdown fail promptly (must match
+            # Controller::BuildResponseList step 3b byte-for-byte)
+            if self._shutdown_ranks:
+                dead_keys = []
+                for key in sorted(self._message_table):
+                    pc = self._message_table[key]
+                    e = pc["entry"]
+                    dead_rank = -1
+                    for r in self._member_ranks(e.process_set_id):
+                        if (r not in pc["ranks"]
+                                and r not in self._joined_ranks
+                                and r in self._shutdown_ranks):
+                            dead_rank = r
+                            break
+                    if dead_rank < 0:
+                        continue
+                    out.responses.append(wire.Response(
+                        type=e.type, red_op=e.red_op, dtype=e.dtype,
+                        process_set_id=e.process_set_id,
+                        root_rank=e.root_rank,
+                        tensor_names=[e.name],
+                        tensor_shapes=[tuple(e.shape)],
+                        error=f"rank {dead_rank} has shut down",
+                    ))
+                    dead_keys.append(key)
+                for k in dead_keys:
+                    del self._message_table[k]
             if len(self._joined_ranks) >= self.size and self.size > 0:
                 out.join_last_rank = self._last_joined_rank
                 self._joined_ranks.clear()
                 self._last_joined_rank = -1
-            if self._shutdown_ranks:
+            # global quiesce only when EVERY rank announced shutdown
+            # (must match Controller::BuildResponseList)
+            if len(self._shutdown_ranks) >= self.size and self.size > 0:
                 out.shutdown = True
             return wire.serialize_response_list(out)
 

@@ -150,6 +150,13 @@ void hvt_controller_set_fusion_threshold(void* c, int64_t bytes) {
   Ctrl(c)->set_fusion_threshold(bytes);
 }
 
+void hvt_controller_set_tuned(void* c, int64_t fusion_threshold,
+                              int32_t cycle_time_us) {
+  Ctrl(c)->SetTuned(fusion_threshold, cycle_time_us);
+}
+
+void hvt_controller_set_shutdown(void* c) { Ctrl(c)->SetShutdown(); }
+
 // JSON stall report (parity: stall_inspector.cc warning text, but
 // machine-readable): [{"name":..,"waiting_s":..,"present":[..],
 // "missing":[..]}, ...]

@@ -174,6 +174,7 @@ std::vector<uint8_t> Controller::DrainRequests() {
   RequestList rl;
   rl.rank = rank_;
   rl.joined = joined_;
+  rl.shutdown = shutdown_;
   for (Entry& e : queue_.Drain()) {
     std::string sig = ResponseCache::Signature(e);
     int64_t bit = cache_.Lookup(sig);
@@ -257,6 +258,8 @@ int32_t Controller::PresentCount(const PendingCoordination& pc) const {
 ResponseList Controller::BuildResponseList() {
   // Caller holds mu_.
   ResponseList out;
+  out.tuned_fusion_threshold = tuned_threshold_;
+  out.tuned_cycle_time_us = tuned_cycle_us_;
 
   // 1. collect globally-ready keys (every member rank reported, or is
   //    joined).  message_table_ is a std::map → deterministic
@@ -335,6 +338,40 @@ ResponseList Controller::BuildResponseList() {
   }
   FuseResponses(&out.responses);
 
+  // 3b. pending tensors that can never complete because a REQUIRED
+  //     rank announced shutdown fail promptly with an error response
+  //     (parity: the reference's "Horovod has been shut down" error)
+  //     instead of stalling the remaining ranks to the transport
+  //     timeout.
+  if (!shutdown_ranks_.empty()) {
+    std::vector<std::string> dead_keys;
+    for (auto& kv : message_table_) {
+      const PendingCoordination& pc = kv.second;
+      int32_t dead_rank = -1;
+      for (int32_t r : ProcessSetRanks(pc.entry.process_set_id)) {
+        if (!pc.ranks.count(r) && !joined_ranks_.count(r) &&
+            shutdown_ranks_.count(r)) {
+          dead_rank = r;
+          break;
+        }
+      }
+      if (dead_rank < 0) continue;
+      const Entry& e = pc.entry;
+      Response rs;
+      rs.type = e.type;
+      rs.red_op = e.red_op;
+      rs.dtype = e.dtype;
+      rs.process_set_id = e.process_set_id;
+      rs.root_rank = e.root_rank;
+      rs.tensor_names.push_back(e.name);
+      rs.tensor_shapes.push_back(e.shape);
+      rs.error = "rank " + std::to_string(dead_rank) + " has shut down";
+      out.responses.push_back(std::move(rs));
+      dead_keys.push_back(kv.first);
+    }
+    for (const std::string& k : dead_keys) message_table_.erase(k);
+  }
+
   // 4. join: once every rank joined, emit the last joiner (parity:
   //    operations.cc join handling returns the last joined rank).
   if (static_cast<int32_t>(joined_ranks_.size()) >= size_ && size_ > 0) {
@@ -342,7 +379,12 @@ ResponseList Controller::BuildResponseList() {
     joined_ranks_.clear();
     last_joined_rank_ = -1;
   }
-  if (!shutdown_ranks_.empty()) out.shutdown = true;
+  // Global quiesce only when EVERY rank announced shutdown (parity:
+  // horovod_shutdown coordinating via DONE requests — a finishing
+  // rank's controller keeps serving peers until all agree to stop).
+  if (static_cast<int32_t>(shutdown_ranks_.size()) >= size_ && size_ > 0) {
+    out.shutdown = true;
+  }
   return out;
 }
 

@@ -134,6 +134,23 @@ class Controller {
   }
   void RegisterProcessSet(int32_t psid, std::vector<int32_t> ranks);
   void SetJoined() { joined_ = true; }
+  // Announce this rank wants to shut down (emitted in every
+  // subsequent DrainRequests).  The rank keeps cycling — serving
+  // coordination — until the coordinator sees EVERY rank's
+  // announcement and broadcasts ResponseList.shutdown (global
+  // quiesce); meanwhile pending collectives that NEED an announced
+  // rank fail promptly with an error response instead of stalling
+  // (parity: horovod_shutdown's negotiated DONE + the "Horovod has
+  // been shut down" error for stragglers).
+  void SetShutdown() { shutdown_ = true; }
+  // Coordinator-side: publish autotuned params in every ResponseList
+  // so all ranks apply identical values (parity: ParameterManager
+  // broadcasting tuned params from the coordinator).
+  void SetTuned(int64_t fusion_threshold, int32_t cycle_time_us) {
+    std::lock_guard<std::mutex> g(mu_);
+    tuned_threshold_ = fusion_threshold;
+    tuned_cycle_us_ = cycle_time_us;
+  }
   // Serialize this cycle's RequestList (drains the queue into in-flight).
   std::vector<uint8_t> DrainRequests();
   // Apply an agreed ResponseList: update cache + queue; out_finished gets
@@ -179,8 +196,11 @@ class Controller {
   ResponseCache cache_;
   GroupTable group_table_;
   bool joined_ = false;
+  bool shutdown_ = false;
 
   // coordinator state
+  int64_t tuned_threshold_ = -1;
+  int32_t tuned_cycle_us_ = -1;
   std::map<std::string, PendingCoordination> message_table_;  // by name (ordered for determinism)
   std::set<int32_t> joined_ranks_;
   int32_t last_joined_rank_ = -1;

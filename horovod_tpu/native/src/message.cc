@@ -79,6 +79,8 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
   w.u32(kWireVersion);
   w.i32(rl.join_last_rank);
   w.u8(rl.shutdown ? 1 : 0);
+  w.i64(rl.tuned_fusion_threshold);
+  w.i32(rl.tuned_cycle_time_us);
   w.u32(static_cast<uint32_t>(rl.responses.size()));
   for (const Response& rs : rl.responses) {
     w.u8(static_cast<uint8_t>(rs.type));
@@ -105,6 +107,8 @@ ResponseList ParseResponseList(const uint8_t* data, size_t len) {
   ResponseList rl;
   rl.join_last_rank = r.i32();
   rl.shutdown = r.u8() != 0;
+  rl.tuned_fusion_threshold = r.i64();
+  rl.tuned_cycle_time_us = r.i32();
   uint32_t n = r.u32();
   rl.responses.resize(n);
   for (uint32_t i = 0; i < n; ++i) {

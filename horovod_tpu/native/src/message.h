@@ -17,7 +17,9 @@ namespace hvt {
 
 constexpr uint32_t kRequestMagic = 0x52545648;   // "HVTR"
 constexpr uint32_t kResponseMagic = 0x50545648;  // "HVTP"
-constexpr uint32_t kWireVersion = 1;
+// v2: ResponseList carries coordinator-tuned (fusion threshold, cycle
+// time) so every rank applies identical autotuned parameters.
+constexpr uint32_t kWireVersion = 2;
 
 // A request as sent rank -> coordinator. Parity: message.h Request.
 struct Request {
@@ -62,6 +64,9 @@ struct ResponseList {
   std::vector<Response> responses;
   int32_t join_last_rank = -1;  // >=0 once every rank joined
   bool shutdown = false;
+  // coordinator-tuned parameters (-1 = unset)
+  int64_t tuned_fusion_threshold = -1;
+  int32_t tuned_cycle_time_us = -1;
 };
 
 // ---------------------------------------------------------------------------

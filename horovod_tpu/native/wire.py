@@ -15,7 +15,10 @@ from typing import List, Tuple
 
 REQUEST_MAGIC = 0x52545648  # "HVTR"
 RESPONSE_MAGIC = 0x50545648  # "HVTP"
-WIRE_VERSION = 1
+# v2: ResponseList carries coordinator-tuned (fusion threshold, cycle
+# time) so every rank applies identical autotuned parameters (parity:
+# ParameterManager broadcasting tuned params from the coordinator).
+WIRE_VERSION = 2
 
 # OpType (native/src/common.h)
 ALLREDUCE, ALLGATHER, BROADCAST, ALLTOALL, REDUCESCATTER, ADASUM, BARRIER, JOIN = range(8)
@@ -95,6 +98,9 @@ class ResponseList:
     responses: List[Response] = dataclasses.field(default_factory=list)
     join_last_rank: int = -1
     shutdown: bool = False
+    # coordinator-tuned parameters (-1 = unset)
+    tuned_fusion_threshold: int = -1
+    tuned_cycle_time_us: int = -1
 
 
 class _W:
@@ -215,6 +221,8 @@ def serialize_response_list(rl: ResponseList) -> bytes:
     w.u32(WIRE_VERSION)
     w.i32(rl.join_last_rank)
     w.u8(1 if rl.shutdown else 0)
+    w.i64(rl.tuned_fusion_threshold)
+    w.i32(rl.tuned_cycle_time_us)
     w.u32(len(rl.responses))
     for rs in rl.responses:
         w.u8(rs.type)
@@ -243,6 +251,8 @@ def parse_response_list(data: bytes) -> ResponseList:
     rl = ResponseList()
     rl.join_last_rank = r.i32()
     rl.shutdown = r.u8() != 0
+    rl.tuned_fusion_threshold = r.i64()
+    rl.tuned_cycle_time_us = r.i32()
     n = r.u32()
     for _ in range(n):
         rs = Response()

@@ -1,0 +1,69 @@
+"""Checkpoint/resume tests (SURVEY.md §5.4 — the orbax-style async
+rank-0 checkpoint idiom + broadcast fanout)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu
+
+
+class TestCheckpointer:
+    def test_save_restore_roundtrip(self, hvt, tmp_path):
+        import jax.numpy as jnp
+
+        ckpt = hvt.Checkpointer(str(tmp_path / "ck"))
+        payload = {
+            "params": {"w": jnp.arange(6.0).reshape(2, 3),
+                       "b": jnp.zeros(3)},
+            "step": np.asarray(7),
+        }
+        ckpt.save(7, payload)
+        ckpt.wait()
+        assert ckpt.all_steps() == [7]
+        out = ckpt.restore()
+        np.testing.assert_allclose(
+            np.asarray(out["params"]["w"]),
+            np.arange(6.0).reshape(2, 3),
+        )
+
+    def test_latest_and_specific_step(self, hvt, tmp_path):
+        import jax.numpy as jnp
+
+        ckpt = hvt.Checkpointer(str(tmp_path / "ck"))
+        for s in (1, 5, 3):
+            ckpt.save(s, {"v": jnp.asarray(float(s))})
+            ckpt.wait()
+        assert ckpt.latest_step() == 5
+        assert float(np.asarray(ckpt.restore()["v"])) == 5.0
+        assert float(np.asarray(ckpt.restore(step=3)["v"])) == 3.0
+
+    def test_max_to_keep_gc(self, hvt, tmp_path):
+        import jax.numpy as jnp
+
+        ckpt = hvt.Checkpointer(str(tmp_path / "ck"), max_to_keep=2)
+        for s in range(4):
+            ckpt.save(s, {"v": jnp.asarray(float(s))})
+            ckpt.wait()
+        assert ckpt.all_steps() == [2, 3]
+
+    def test_restore_empty_returns_none(self, hvt, tmp_path):
+        ckpt = hvt.Checkpointer(str(tmp_path / "nothing"))
+        assert ckpt.restore() is None
+
+    def test_one_shot_helpers(self, hvt, tmp_path):
+        import jax.numpy as jnp
+
+        d = str(tmp_path / "ck")
+        hvt.save_checkpoint(d, 11, {"x": jnp.ones(2)}).wait()
+        out = hvt.restore_checkpoint(d)
+        np.testing.assert_allclose(np.asarray(out["x"]), [1.0, 1.0])
+
+    def test_async_save_overlaps(self, hvt, tmp_path):
+        import jax.numpy as jnp
+
+        ckpt = hvt.Checkpointer(str(tmp_path / "ck"))
+        ckpt.save(1, {"big": jnp.ones((256, 256))})
+        # a second save waits for the first (one in flight), both land
+        ckpt.save(2, {"big": jnp.zeros((256, 256))})
+        ckpt.wait()
+        assert ckpt.all_steps() == [1, 2]

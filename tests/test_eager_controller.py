@@ -66,6 +66,10 @@ def make_world(size, **kw):
 
 
 def stop_world(ctrls):
+    # announce shutdown everywhere FIRST so no controller lingers
+    # waiting for the others' agreement (coordinated-shutdown parity)
+    for c in ctrls:
+        c.request_shutdown()
     for c in ctrls:
         c.stop()
 

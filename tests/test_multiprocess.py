@@ -338,6 +338,42 @@ def test_sparse_allreduce_2proc():
         assert dense == [1.0, 12.0, 20.0, 0.0]
 
 
+def test_early_exit_rank_does_not_hang_peers():
+    """A worker that finishes and exits must not hang the remaining
+    ranks' coordination: its shutdown farewell tells the coordinator to
+    stop waiting for its cycle blobs (the controller cycle gathers from
+    every rank otherwise)."""
+
+    def body():
+        import time
+
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvt
+
+        hvt.init()
+        r = hvt.rank()
+        solo = hvt.add_process_set([0])
+        other = hvt.add_process_set([1])
+        hvt.synchronize(
+            hvt.allreduce_async(jnp.ones(2), name="warm", op=hvt.Sum)
+        )
+        if r == 1:
+            return "bye"  # exits while rank 0 keeps coordinating
+        mine = solo
+        for i in range(20):
+            hvt.synchronize(hvt.allreduce_async(
+                jnp.ones(2), name=f"solo{i}", op=hvt.Sum,
+                process_set=mine,
+            ))
+            time.sleep(0.05)
+        return "done"
+
+    results = _run(body, np=2)
+    assert [x[1] if isinstance(x, tuple) else x for x in results] \
+        == ["done", "bye"] or results == ["done", "bye"]
+
+
 def test_worker_failure_propagates():
     """One rank raising must fail the job with that rank's traceback
     and terminate the peers (reference: launcher exit-code handling)."""

@@ -290,6 +290,44 @@ class TestNativePythonAgreement:
         assert len(rl.responses) == 2
         assert sorted(rs.process_set_id for rs in rl.responses) == [1, 2]
 
+    def test_shutdown_semantics_bytes_identical(self):
+        """Coordinated shutdown: global quiesce flag only when EVERY
+        rank announced; pending tensors requiring an announced rank
+        fail promptly with identical error bytes in both impls."""
+        nat = make_pair(ncore.NativeController, size=2, fusion=1 << 10)
+        py = make_pair(fallback.PyController, size=2, fusion=1 << 10)
+        for pair in (nat, py):
+            pair[0].enqueue(1, "stranded", wire.ALLREDUCE, wire.RED_SUM,
+                            6, (4,))
+            pair[1].set_shutdown()
+        nat_blobs = [c.drain_requests() for c in nat]
+        py_blobs = [c.drain_requests() for c in py]
+        assert nat_blobs == py_blobs
+        for b in nat_blobs:
+            nat[0].ingest(b)
+        for b in py_blobs:
+            py[0].ingest(b)
+        nat_resp = nat[0].compute_responses()
+        py_resp = py[0].compute_responses()
+        assert nat_resp == py_resp
+        rl = wire.parse_response_list(py_resp)
+        assert not rl.shutdown  # only rank 1 announced
+        assert len(rl.responses) == 1
+        assert rl.responses[0].error == "rank 1 has shut down"
+        # rank 0 announces too -> global quiesce
+        for pair in (nat, py):
+            pair[0].set_shutdown()
+        nat_blobs = [c.drain_requests() for c in nat]
+        py_blobs = [c.drain_requests() for c in py]
+        for b in nat_blobs:
+            nat[0].ingest(b)
+        for b in py_blobs:
+            py[0].ingest(b)
+        nat_resp = nat[0].compute_responses()
+        py_resp = py[0].compute_responses()
+        assert nat_resp == py_resp
+        assert wire.parse_response_list(py_resp).shutdown
+
     def test_cross_impl_fleet(self):
         """Rank 0 native + rank 1 Python coordinate successfully."""
         c0 = ncore.NativeController(0, 2, 1 << 20)

@@ -363,13 +363,17 @@ class TestAutotunerControllerWiring:
         try:
             import jax.numpy as jnp
 
-            # candidate 0 scores, moves to candidate 1, applies it live
+            # candidate 0 scores and candidate 1 is PUBLISHED in the
+            # next ResponseList (ParameterManager-broadcast parity);
+            # one more cycle applies it on every rank
             ctrl.enqueue("allreduce", jnp.ones(8), name="t0")
             ctrl.run_cycle_once()
+            ctrl.run_cycle_once()  # empty cycle carries tuned params
             assert ctrl.cycle_time_s == grid[1][1] / 1000.0
             assert ctrl._ctrl.fusion_threshold == grid[1][0]
             # second scored step pins the best and keeps applying it
             ctrl.enqueue("allreduce", jnp.ones(8), name="t1")
+            ctrl.run_cycle_once()
             ctrl.run_cycle_once()
             assert tuner.done
             assert (ctrl._ctrl.fusion_threshold, ctrl.cycle_time_s * 1000.0) \
@@ -396,3 +400,110 @@ class TestLogLevelWiring:
             assert (logging.getLogger("horovod_tpu").level
                     == logging.WARNING)
             hvt_mod.shutdown()
+
+
+class TestGaussianProcess:
+    def test_gp_fits_and_predicts(self):
+        import numpy as np
+
+        from horovod_tpu.obs.gaussian_process import GaussianProcess
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(20, 1)
+        y = np.sin(6 * x[:, 0])
+        gp = GaussianProcess(length_scale=0.2, noise=1e-6)
+        gp.fit(x, y)
+        mu, sigma = gp.predict(x)
+        np.testing.assert_allclose(mu, y, atol=1e-2)
+        # uncertainty grows away from data
+        _, s_far = gp.predict(np.asarray([[5.0]]))
+        assert s_far[0] > sigma.mean() * 3
+
+    def test_ei_prefers_promising_region(self):
+        import numpy as np
+
+        from horovod_tpu.obs.gaussian_process import (
+            GaussianProcess,
+            expected_improvement,
+        )
+
+        x = np.asarray([[0.0], [0.5], [1.0]])
+        y = np.asarray([0.0, 1.0, 0.1])
+        gp = GaussianProcess(length_scale=0.2, noise=1e-6)
+        gp.fit(x, y)
+        cand = np.linspace(0, 1, 101)[:, None]
+        ei = expected_improvement(gp, cand, best_y=1.0)
+        # best EI near the known max, not at the poor edges
+        assert 0.25 <= float(cand[np.argmax(ei)][0]) <= 0.75
+
+    def test_bayesian_optimizer_finds_peak(self):
+        import numpy as np
+
+        from horovod_tpu.obs.gaussian_process import BayesianOptimizer
+
+        def score(pt):  # peak at (0.3, 0.7) in unit coords
+            u = (np.asarray(pt) - np.asarray([0.0, 0.0])) / 10.0
+            return -((u[0] - 0.3) ** 2 + (u[1] - 0.7) ** 2)
+
+        bo = BayesianOptimizer([(0.0, 10.0), (0.0, 10.0)],
+                               seed_points=[(5.0, 5.0)])
+        for _ in range(20):
+            x = bo.suggest()
+            bo.observe(x, score(x))
+        best_x, _ = bo.best
+        assert abs(best_x[0] - 3.0) < 2.5
+        assert abs(best_x[1] - 7.0) < 2.5
+
+
+class TestGpAutotuner:
+    def test_gp_mode_pins_good_candidate(self):
+        from horovod_tpu.core.config import Config
+        from horovod_tpu.obs.autotune import Autotuner
+
+        cfg = Config(autotune=True, autotune_warmup_samples=0,
+                     autotune_steps_per_sample=1, autotune_gp_samples=10)
+        tuner = Autotuner(cfg, mode="gp")
+        # synthetic landscape: throughput peaks at large fusion
+        # thresholds with ~2.5 ms cycle time
+        import math
+
+        def throughput(thr, cyc):
+            t = math.log2(thr)
+            return -((t - 26.5) ** 2) - ((cyc - 2.5) ** 2) * 0.3
+
+        steps = 0
+        while not tuner.done and steps < 100:
+            thr, cyc = tuner.current
+            # feed bytes so score == throughput deterministically:
+            # monkeypatch via direct observe path instead
+            tuner._bytes = 0
+            tuner._steps = 0
+            tuner._t_start = __import__("time").monotonic() - 1.0
+            tuner._bytes = max(throughput(thr, cyc) + 100.0, 1e-3)
+            tuner._steps = tuner._steps_per_sample - 1
+            tuner.record_step(0)
+            steps += 1
+        assert tuner.done
+        thr, cyc = tuner.current
+        assert 2**24 <= thr <= 2**28.5
+        assert 0.5 <= cyc <= 10.0
+
+    def test_grid_mode_still_selects_best(self):
+        from horovod_tpu.core.config import Config
+        from horovod_tpu.obs.autotune import Autotuner
+
+        grid = [(1 << 20, 1.0), (8 << 20, 2.0), (64 << 20, 4.0)]
+        cfg = Config(autotune=True, autotune_warmup_samples=0,
+                     autotune_steps_per_sample=1)
+        tuner = Autotuner(cfg, grid=grid)
+        assert tuner.mode == "grid"
+        import time as _t
+
+        scores = {grid[0]: 10, grid[1]: 99, grid[2]: 20}
+        while not tuner.done:
+            cand = tuner.current
+            tuner._t_start = _t.monotonic() - 1.0
+            tuner._bytes = scores[cand]
+            tuner._steps = tuner._steps_per_sample - 1
+            tuner.record_step(0)
+        assert tuner.current == grid[1]
