@@ -24,6 +24,7 @@ import pickle
 import re
 import sys
 import threading
+import weakref
 from typing import Any, Dict, List, Optional
 
 from ..core import state as core_state
@@ -33,6 +34,21 @@ def _is_coordinator() -> bool:
     # require_init: before init() every process would default to rank 0
     # and N ranks would race writes into the same checkpoint dir
     return core_state.require_init("checkpointing").rank == 0
+
+
+# One module-level exit hook over a weak set: per-instance
+# atexit.register would pin every Checkpointer (a per-step
+# save_checkpoint loop creates many) for process lifetime.
+_live_checkpointers: "weakref.WeakSet[Checkpointer]" = weakref.WeakSet()
+
+
+@atexit.register
+def _flush_pending_saves_at_exit():
+    for ckpt in list(_live_checkpointers):
+        try:
+            ckpt.wait()
+        except Exception as e:  # can't raise during interpreter exit
+            print(f"hvtpu.Checkpointer: {e}", file=sys.stderr)
 
 
 class Checkpointer:
@@ -59,8 +75,8 @@ class Checkpointer:
         self._error: Optional[BaseException] = None
         # a daemon writer thread would be killed at interpreter exit,
         # silently losing the final checkpoint of a run that never
-        # called wait() — join it at exit instead
-        atexit.register(self._wait_at_exit)
+        # called wait() — the module exit hook joins pending saves
+        _live_checkpointers.add(self)
         if _is_coordinator():
             os.makedirs(self.directory, exist_ok=True)
         if self.use_orbax:
@@ -86,10 +102,14 @@ class Checkpointer:
                     self._ocp.save(target, payload, force=True)
                     self._ocp.wait_until_finished()
                 else:  # pragma: no cover - fallback
+                    import shutil
+
                     tmp = target + ".tmp"
                     os.makedirs(tmp, exist_ok=True)
                     with open(os.path.join(tmp, "state.pkl"), "wb") as f:
                         pickle.dump(payload, f)
+                    # match orbax force=True overwrite semantics
+                    shutil.rmtree(target, ignore_errors=True)
                     os.replace(tmp, target)
                 self._gc()
             except BaseException as e:  # surfaced at wait()/next save
@@ -108,12 +128,6 @@ class Checkpointer:
         if self._error is not None:
             err, self._error = self._error, None
             raise RuntimeError("async checkpoint save failed") from err
-
-    def _wait_at_exit(self):
-        try:
-            self.wait()
-        except Exception as e:  # can't raise during interpreter exit
-            print(f"hvtpu.Checkpointer: {e}", file=sys.stderr)
 
     def _gc(self):
         if not self.max_to_keep:

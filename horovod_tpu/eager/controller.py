@@ -642,6 +642,20 @@ class EagerController:
         ranks = self._ps_ranks.get(psid)
         return ranks is None or self.rank in ranks
 
+    def _fail_error_response(self, rs: wire.Response):
+        """Fail futures for an ERROR response.  Only payloads this rank
+        actually has are failed — error responses (e.g. 'rank N has
+        shut down') legitimately reach member ranks that never enqueued
+        the tensor, which must not be treated as protocol corruption."""
+        with self._lock:
+            for n in rs.tensor_names:
+                seq = self._by_name.get(n)
+                if (seq is not None
+                        and self._payloads[seq].psid == rs.process_set_id):
+                    del self._by_name[n]
+                    p = self._payloads.pop(seq)
+                    p.future.set_error(HorovodInternalError(rs.error))
+
     def _execute(self, rl: wire.ResponseList, finished: List[int]):
         for rs in rl.responses:
             # Responses are broadcast to every rank; only member ranks
@@ -649,11 +663,10 @@ class EagerController:
             # set's communicator spans exactly its members).
             if not self._member_of(rs.process_set_id):
                 continue
-            payloads = self._take_payloads(rs)
             if rs.error:
-                for p in payloads:
-                    p.future.set_error(HorovodInternalError(rs.error))
+                self._fail_error_response(rs)
                 continue
+            payloads = self._take_payloads(rs)
             try:
                 self._execute_one(rs, payloads)
             except Exception as e:

@@ -21,6 +21,7 @@
 // extra coordination traffic.
 #pragma once
 
+#include <atomic>
 #include <deque>
 #include <list>
 #include <map>
@@ -195,8 +196,10 @@ class Controller {
   TensorQueue queue_;
   ResponseCache cache_;
   GroupTable group_table_;
-  bool joined_ = false;
-  bool shutdown_ = false;
+  // set by the frontend thread, read lock-free by the cycle thread's
+  // DrainRequests — atomics, not a data race
+  std::atomic<bool> joined_{false};
+  std::atomic<bool> shutdown_{false};
 
   // coordinator state
   int64_t tuned_threshold_ = -1;

@@ -1,0 +1,24 @@
+"""Ray integration hook (out of scope for the TPU build; SURVEY.md
+§7.3).  The reference's ``RayExecutor`` places ranks via Ray placement
+groups; TPU jobs are launched by ``hvtpurun`` / GKE instead.  The API
+hook is kept so code probing for it degrades clearly.
+"""
+
+from __future__ import annotations
+
+_MSG = (
+    "horovod_tpu does not ship a Ray integration: TPU workers are "
+    "launched by hvtpurun (see horovod_tpu.runner) or your cluster "
+    "scheduler. The horovod.ray surface is documented out of scope in "
+    "SURVEY.md §7.3."
+)
+
+
+class RayExecutor:  # pragma: no cover - stub surface
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(_MSG)
+
+
+class ElasticRayExecutor:  # pragma: no cover - stub surface
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(_MSG)

@@ -1,0 +1,32 @@
+"""Spark integration hook (out of scope for the TPU build; SURVEY.md
+§7.3).  The reference's ``horovod.spark.run(fn)`` launches ranks on
+Spark executors; TPU jobs are launched by ``hvtpurun`` / GKE instead.
+The API hook is kept so code probing for it degrades clearly.
+"""
+
+from __future__ import annotations
+
+_MSG = (
+    "horovod_tpu does not ship a Spark integration: TPU workers are "
+    "launched by hvtpurun (see horovod_tpu.runner) or your cluster "
+    "scheduler. The horovod.spark surface is documented out of scope "
+    "in SURVEY.md §7.3."
+)
+
+
+def run(*args, **kwargs):
+    raise NotImplementedError(_MSG)
+
+
+def run_elastic(*args, **kwargs):
+    raise NotImplementedError(_MSG)
+
+
+class KerasEstimator:  # pragma: no cover - stub surface
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(_MSG)
+
+
+class TorchEstimator:  # pragma: no cover - stub surface
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(_MSG)

@@ -239,6 +239,26 @@ class TestMultiRankNegotiation:
         finally:
             stop_world(ctrls)
 
+    def test_shutdown_error_reaches_only_enqueuers(self, hvt):
+        """A 'rank N has shut down' error response is broadcast to all
+        ranks; members that never enqueued the tensor must IGNORE it
+        (not kill their cycle thread), and the enqueuer's future gets
+        the error."""
+        ctrls = make_world(3)
+        try:
+            f0 = ctrls[0].enqueue("allreduce", jnp.ones(2), name="dead")
+            ctrls[2].request_shutdown()
+            with pytest.raises(HorovodInternalError,
+                               match="rank 2 has shut down"):
+                f0.result(timeout=20)
+            # ranks 1 and 2 saw the same error response without having
+            # the payload; their cycle threads must still be healthy
+            time.sleep(0.1)
+            assert ctrls[1]._thread_error is None
+            assert ctrls[2]._thread_error is None
+        finally:
+            stop_world(ctrls)
+
     def test_same_name_in_disjoint_process_sets(self):
         """The coordination table is scoped per process set: the same
         tensor name pending in two disjoint sets must not collide

@@ -1,0 +1,20 @@
+"""Out-of-scope integration hooks (SURVEY.md §7.3): present, importable,
+and clearly refusing."""
+
+import pytest
+
+
+def test_spark_hook_refuses_clearly():
+    import horovod_tpu.spark as spark
+
+    with pytest.raises(NotImplementedError, match="hvtpurun"):
+        spark.run(lambda: None)
+    with pytest.raises(NotImplementedError):
+        spark.TorchEstimator()
+
+
+def test_ray_hook_refuses_clearly():
+    import horovod_tpu.ray as ray_mod
+
+    with pytest.raises(NotImplementedError, match="hvtpurun"):
+        ray_mod.RayExecutor()

@@ -64,3 +64,18 @@ def test_cli_failure_exit_code():
     ])
     assert res.returncode == 3
     assert "rank 1 exited with code 3" in res.stderr
+
+
+def test_hybrid_transformer_example():
+    """The post-parity parallel-layer example must run (single process,
+    8 virtual CPU devices, dp x pp x tp + sp + ep)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "examples", "transformer_hybrid.py"),
+         "--steps", "4", "--d-model", "32", "--layers", "2"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=_REPO,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "hybrid-parallel training OK" in res.stdout
