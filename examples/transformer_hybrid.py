@@ -43,9 +43,7 @@ def main():
     hvt.init()
     devices = jax.devices()
     n = len(devices)
-    if n % 8 == 0:
-        dp, pp, tp = n // 4, 2, 2
-    elif n % 4 == 0:
+    if n % 4 == 0:
         dp, pp, tp = n // 4, 2, 2
     elif n % 2 == 0:
         dp, pp, tp = n // 2, 1, 2

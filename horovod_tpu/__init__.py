@@ -291,6 +291,47 @@ def grouped_allreduce_async(tensors, *, op=None, average=None, names=None,
     return [_handle_manager().allocate(f) for f in futs]
 
 
+def grouped_allgather(tensors, *, process_set=None):
+    """Allgather a list of tensors (parity: hvd.grouped_allgather —
+    newer-upstream surface; sync form gathers each in order)."""
+    _state.require_init("grouped_allgather")
+    return [_eager.allgather(t, process_set=process_set) for t in tensors]
+
+
+def grouped_allgather_async(tensors, *, names=None, process_set=None):
+    """Async grouped allgather: executes only when every member is
+    ready on every rank (parity: hvd.grouped_allgather_async)."""
+    _state.require_init("grouped_allgather_async")
+    futs = _controller().grouped_enqueue(
+        "allgather", list(tensors), names=names, process_set=process_set,
+    )
+    return [_handle_manager().allocate(f) for f in futs]
+
+
+def grouped_reducescatter(tensors, *, op=None, process_set=None):
+    """Reducescatter a list of tensors (parity:
+    hvd.grouped_reducescatter)."""
+    _state.require_init("grouped_reducescatter")
+    return [
+        _eager.reducescatter(t, op=op, process_set=process_set)
+        for t in tensors
+    ]
+
+
+def grouped_reducescatter_async(tensors, *, op=None, names=None,
+                                process_set=None):
+    """Async grouped reducescatter (parity:
+    hvd.grouped_reducescatter_async)."""
+    _state.require_init("grouped_reducescatter_async")
+    from .comm.reduce_ops import normalize_op
+
+    futs = _controller().grouped_enqueue(
+        "reducescatter", list(tensors), names=names,
+        op=normalize_op(op, None), process_set=process_set,
+    )
+    return [_handle_manager().allocate(f) for f in futs]
+
+
 def allgather_async(tensor, *, name=None, process_set=None):
     _state.require_init("allgather_async")
     fut = _controller().enqueue(
@@ -346,6 +387,10 @@ def start_timeline(filename: str, mark_cycles: bool = False):
     if st.timeline is not None:
         st.timeline.close()
     st.timeline = Timeline(filename, st.rank, mark_cycles=mark_cycles)
+    if st.controller is not None:
+        # a live eager controller captured the previous timeline (or
+        # None) at construction; hand it the new one
+        st.controller._timeline = st.timeline
     return st.timeline
 
 
@@ -355,6 +400,8 @@ def stop_timeline():
     if st.timeline is not None:
         st.timeline.close()
         st.timeline = None
+    if st.controller is not None:
+        st.controller._timeline = None
 
 
 def join(device=None) -> int:
@@ -399,6 +446,8 @@ __all__ = [
     "num_devices", "local_devices", "world_mesh", "hierarchical_mesh", "mesh",
     "allreduce", "grouped_allreduce", "allgather", "broadcast", "alltoall",
     "reducescatter", "barrier", "join",
+    "grouped_allgather", "grouped_allgather_async",
+    "grouped_reducescatter", "grouped_reducescatter_async",
     "allreduce_async", "grouped_allreduce_async", "allgather_async",
     "broadcast_async", "alltoall_async",
     "reducescatter_async", "synchronize", "poll",

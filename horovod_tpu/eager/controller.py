@@ -400,6 +400,12 @@ class EagerController:
                 return fut
             self._payloads[seq] = payload
             self._by_name[name] = seq
+            if self._timeline is not None:
+                # Parity: timeline.cc NEGOTIATE_<OP> span from enqueue
+                # until the agreed response arrives (execution phases
+                # come from the data plane).  Inside the lock: the
+                # cycle thread could otherwise end() before begin().
+                self._timeline.begin(name, f"NEGOTIATE_{kind.upper()}")
         self.start()
         return fut
 
@@ -620,7 +626,17 @@ class EagerController:
             t_enqueue=time.monotonic(),
         )
 
-    def _take_payloads(self, rs: wire.Response) -> List[_Payload]:
+    def _take_payloads(self, rs: wire.Response,
+                       strict: bool = True) -> List[_Payload]:
+        """Pop this rank's payloads for a response (name + matching
+        process-set id, both dicts updated together under the lock).
+
+        ``strict=True`` (normal execution): a missing payload means a
+        joined rank zero-substitutes, anything else is protocol
+        corruption.  ``strict=False`` (error responses): missing
+        payloads are skipped — members that never enqueued the tensor
+        legitimately receive the broadcast error.
+        """
         out = []
         with self._lock:
             for i, n in enumerate(rs.tensor_names):
@@ -629,6 +645,8 @@ class EagerController:
                         and self._payloads[seq].psid == rs.process_set_id):
                     del self._by_name[n]
                     out.append(self._payloads.pop(seq))
+                elif not strict:
+                    continue
                 elif self._joined_local:
                     out.append(self._zero_payload(rs, i))
                 else:
@@ -647,14 +665,10 @@ class EagerController:
         actually has are failed — error responses (e.g. 'rank N has
         shut down') legitimately reach member ranks that never enqueued
         the tensor, which must not be treated as protocol corruption."""
-        with self._lock:
-            for n in rs.tensor_names:
-                seq = self._by_name.get(n)
-                if (seq is not None
-                        and self._payloads[seq].psid == rs.process_set_id):
-                    del self._by_name[n]
-                    p = self._payloads.pop(seq)
-                    p.future.set_error(HorovodInternalError(rs.error))
+        for p in self._take_payloads(rs, strict=False):
+            if self._timeline is not None:
+                self._timeline.end(p.name)
+            p.future.set_error(HorovodInternalError(rs.error))
 
     def _execute(self, rl: wire.ResponseList, finished: List[int]):
         for rs in rl.responses:
@@ -667,6 +681,10 @@ class EagerController:
                 self._fail_error_response(rs)
                 continue
             payloads = self._take_payloads(rs)
+            if self._timeline is not None:
+                for p in payloads:
+                    if p.seq != -1:  # not a synthetic zero payload
+                        self._timeline.end(p.name)
             try:
                 self._execute_one(rs, payloads)
             except Exception as e:

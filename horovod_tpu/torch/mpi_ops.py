@@ -176,6 +176,48 @@ def grouped_allreduce_(tensors: List[torch.Tensor], **kw) -> List[torch.Tensor]:
     return tensors
 
 
+def grouped_allgather(tensors: List[torch.Tensor], name=None,
+                      process_set=None) -> List[torch.Tensor]:
+    """Allgather a list of tensors (parity: hvd.grouped_allgather)."""
+    outs = _hvt.grouped_allgather(
+        [_to_jax(t) for t in tensors], process_set=process_set
+    )
+    return [_from_jax(o, like=t) for o, t in zip(outs, tensors)]
+
+
+def grouped_reducescatter(tensors: List[torch.Tensor], op=None,
+                          process_set=None) -> List[torch.Tensor]:
+    """Reducescatter a list of tensors (parity:
+    hvd.grouped_reducescatter)."""
+    outs = _hvt.grouped_reducescatter(
+        [_to_jax(t) for t in tensors], op=op, process_set=process_set
+    )
+    return [_from_jax(o, like=t) for o, t in zip(outs, tensors)]
+
+
+def grouped_allgather_async(tensors: List[torch.Tensor], names=None,
+                            process_set=None) -> List[int]:
+    handles = _hvt.grouped_allgather_async(
+        [_to_jax(t) for t in tensors], names=names,
+        process_set=process_set,
+    )
+    for h, t in zip(handles, tensors):
+        _TORCH_HANDLES[h] = ("gather", t)
+    return handles
+
+
+def grouped_reducescatter_async(tensors: List[torch.Tensor], op=None,
+                                names=None, process_set=None
+                                ) -> List[int]:
+    handles = _hvt.grouped_reducescatter_async(
+        [_to_jax(t) for t in tensors], op=op, names=names,
+        process_set=process_set,
+    )
+    for h, t in zip(handles, tensors):
+        _TORCH_HANDLES[h] = ("gather", t)
+    return handles
+
+
 def allgather(tensor: torch.Tensor, name=None, process_set=None
               ) -> torch.Tensor:
     """Concatenate along dim 0 across ranks (ragged dim-0 supported;

@@ -84,3 +84,79 @@ class TestStateDistribution:
 
     def test_allgather_object(self, hvt):
         assert hvt.allgather_object({"r": 0}) == [{"r": 0}]
+
+
+class TestGroupedVariants:
+    """Grouped allgather / reducescatter (newer-upstream surface)."""
+
+    def test_grouped_allgather_sync_and_async(self, hvt):
+        import jax.numpy as jnp
+        import numpy as np
+
+        outs = hvt.grouped_allgather([jnp.ones((2, 2)), jnp.zeros((3,))])
+        assert [tuple(o.shape) for o in outs] == [(2, 2), (3,)]
+        handles = hvt.grouped_allgather_async(
+            [jnp.ones((2,)), jnp.full((1,), 5.0)], names=["ga1", "ga2"]
+        )
+        res = [hvt.synchronize(h) for h in handles]
+        np.testing.assert_allclose(np.asarray(res[1]), [5.0])
+
+    def test_grouped_reducescatter_sync_and_async(self, hvt):
+        import jax.numpy as jnp
+        import numpy as np
+
+        outs = hvt.grouped_reducescatter(
+            [jnp.ones((4, 2)), jnp.full((2,), 3.0)], op=hvt.Sum
+        )
+        assert [tuple(o.shape) for o in outs] == [(4, 2), (2,)]
+        handles = hvt.grouped_reducescatter_async(
+            [jnp.ones((2,)), jnp.ones((4,))], names=["rs1", "rs2"],
+            op=hvt.Sum,
+        )
+        res = [hvt.synchronize(h) for h in handles]
+        np.testing.assert_allclose(np.asarray(res[0]), [1.0, 1.0])
+
+
+class TestNegotiationTimeline:
+    def test_negotiate_phase_recorded(self, hvt, tmp_path):
+        import json
+
+        import jax.numpy as jnp
+
+        path = str(tmp_path / "tl.json")
+        hvt.start_timeline(path)
+        h = hvt.allreduce_async(jnp.ones(4), name="tl_t", op=hvt.Sum)
+        hvt.synchronize(h)
+        hvt.stop_timeline()
+        with open(path) as f:
+            content = f.read()
+        # Chrome-trace array may lack the closing bracket mid-stream
+        if not content.rstrip().endswith("]"):
+            content = content.rstrip().rstrip(",") + "]"
+        events = json.loads(content)
+        negotiate = [e for e in events
+                     if e.get("name") == "NEGOTIATE_ALLREDUCE"]
+        assert any(e.get("ph") == "B" for e in negotiate)
+        assert any(e.get("ph") == "E" for e in negotiate)
+
+    def test_timeline_attach_to_live_controller(self, hvt, tmp_path):
+        """start_timeline AFTER the controller exists must still record
+        NEGOTIATE spans (the controller's timeline ref is updated)."""
+        import json
+
+        import jax.numpy as jnp
+
+        # create the controller BEFORE the timeline starts
+        hvt.synchronize(hvt.allreduce_async(jnp.ones(2), name="pre"))
+        path = str(tmp_path / "tl2.json")
+        hvt.start_timeline(path)
+        hvt.synchronize(hvt.allreduce_async(jnp.ones(2), name="post"))
+        hvt.stop_timeline()
+        with open(path) as f:
+            content = f.read()
+        if not content.rstrip().endswith("]"):
+            content = content.rstrip().rstrip(",") + "]"
+        events = json.loads(content)
+        assert any(e.get("name") == "NEGOTIATE_ALLREDUCE"
+                   and e.get("args", {}).get("tensor") == "post"
+                   for e in events)

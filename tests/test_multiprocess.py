@@ -231,6 +231,54 @@ def test_torch_optimizer_2proc():
     assert w0_final != w0_init  # training moved
 
 
+def test_grouped_variants_and_compression_2proc():
+    """Grouped allgather/reducescatter across real processes + fp16
+    wire compression on the async allreduce path."""
+
+    def body():
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvt
+        from horovod_tpu.comm.compression import Compression
+
+        hvt.init()
+        r = hvt.rank()
+        out = {}
+
+        hs = hvt.grouped_allgather_async(
+            [jnp.full((r + 1, 2), float(r)), jnp.asarray([float(r)])],
+            names=["g1", "g2"],
+        )
+        g1, g2 = [np.asarray(hvt.synchronize(h)) for h in hs]
+        out["g1"] = g1.tolist()
+        out["g2"] = g2.tolist()
+
+        hs = hvt.grouped_reducescatter_async(
+            [jnp.ones((4, 2)), jnp.full((2,), float(r + 1))],
+            names=["r1", "r2"], op=hvt.Sum,
+        )
+        r1, r2 = [np.asarray(hvt.synchronize(h)) for h in hs]
+        out["r1_shape"] = list(r1.shape)
+        out["r2"] = r2.tolist()
+
+        h = hvt.allreduce_async(
+            jnp.full((8,), 1.5 + r), name="fp16c", op=hvt.Sum,
+            compression=Compression.fp16,
+        )
+        out["fp16"] = float(np.asarray(hvt.synchronize(h))[0])
+        return (r, out)
+
+    results = _run(body, np=2)
+    for r, out in results:
+        assert out["g1"] == [[0.0, 0.0], [1.0, 1.0], [1.0, 1.0]]
+        assert out["g2"] == [0.0, 1.0]
+        assert out["r1_shape"] == [2, 2]
+        # reducescatter of (2,) over 2 ranks -> 1 element per rank
+        assert out["r2"] == [3.0]
+        assert out["fp16"] == 4.0  # 1.5 + 2.5, exact in fp16
+
+
 def test_join_uneven_batches_2proc():
     """JoinOp semantics across real processes: rank 1 exhausts its data
     after 1 batch and joins; rank 0 runs 2 more batches whose
