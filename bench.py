@@ -161,7 +161,12 @@ def main():
                 "notes": (
                     f"{STEPS_PER_CALL} steps/dispatch via lax.scan; "
                     "TPU-fast BatchNorm (flattened 2-D stats, bf16 "
-                    "normalize pass); HBM-bandwidth-bound step"
+                    "normalize pass). HBM-bandwidth-bound: profiled "
+                    "step is 34% BN stats/grad column-reduces, 25% "
+                    "BN/ReLU elementwise, 24% convs, i.e. ~96% of the "
+                    "77 GB/step roofline at 819 GB/s (2723 img/s "
+                    "ceiling); batch 512, remat, s2d stem, 64 "
+                    "steps/dispatch all measured <=0 gain"
                 ),
             }
         )

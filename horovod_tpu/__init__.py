@@ -384,14 +384,22 @@ def start_timeline(filename: str, mark_cycles: bool = False):
     st = _state.require_init("start_timeline")
     from .obs.timeline import Timeline
 
-    if st.timeline is not None:
-        st.timeline.close()
-    st.timeline = Timeline(filename, st.rank, mark_cycles=mark_cycles)
+    old = st.timeline
+    new_tl = Timeline(filename, st.rank, mark_cycles=mark_cycles)
+    if old is not None:
+        # carry in-flight spans over so their 'E' events land in the
+        # new file instead of silently vanishing; close() below writes
+        # matching 'E's into the old file
+        for name, phase in list(old._open_spans.items()):
+            new_tl.begin(name, phase)
+    st.timeline = new_tl
     if st.controller is not None:
         # a live eager controller captured the previous timeline (or
         # None) at construction; hand it the new one
-        st.controller._timeline = st.timeline
-    return st.timeline
+        st.controller._timeline = new_tl
+    if old is not None:
+        old.close()
+    return new_tl
 
 
 def stop_timeline():

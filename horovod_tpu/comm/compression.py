@@ -15,7 +15,25 @@ scales.
 
 from __future__ import annotations
 
+import itertools
+
 import jax.numpy as jnp
+
+# Per-process counter for stochastic-rounding seeds: combined with the
+# rank, every (rank, call) pair gets distinct PRNG noise — identical
+# seeds across ranks would correlate the rounding errors and defeat the
+# cancellation-over-ranks property stochastic rounding exists for.
+_STOCH_SEED_COUNTER = itertools.count()
+
+
+def _stochastic_seed() -> int:
+    try:
+        from ..core import state as _core_state
+
+        rank = _core_state.global_state().rank if _core_state.initialized() else 0
+    except Exception:  # pragma: no cover - state not importable
+        rank = 0
+    return (rank * 1_000_003 + next(_STOCH_SEED_COUNTER)) & 0x7FFFFFFF
 
 
 class Compressor:
@@ -99,22 +117,27 @@ class Int8Compressor(Compressor):
     """
 
     BLOCK = 1024
+    STOCHASTIC = False
 
-    @staticmethod
-    def compress(tensor):
+    @classmethod
+    def compress(cls, tensor):
         if not jnp.issubdtype(tensor.dtype, jnp.floating):
             return tensor, None
         orig_dtype = tensor.dtype
         orig_shape = tensor.shape
-        flat = tensor.reshape(-1)
-        n = flat.shape[0]
-        block = Int8Compressor.BLOCK
-        pad = (-n) % block
-        flat = jnp.pad(flat, (0, pad))
-        chunks = flat.reshape(-1, block).astype(jnp.float32)
-        scale = jnp.max(jnp.abs(chunks), axis=1, keepdims=True) / 127.0
-        safe = jnp.where(scale == 0, 1.0, scale)
-        q = jnp.clip(jnp.round(chunks / safe), -127, 127).astype(jnp.int8)
+        # One-pass Pallas quantize kernel on TPU (ops/pallas_ops.py —
+        # the analog of the reference's cuda_kernels.cu scale kernels);
+        # numerically-identical XLA lowering elsewhere.  Kernel layout
+        # (rows, 128) int8 + (rows/8, 1) scales is row-major-identical
+        # to this class's (nblocks, BLOCK=1024) wire format.
+        from ..ops import quantize_int8_blocks
+
+        q, scale, n = quantize_int8_blocks(
+            tensor.reshape(-1),
+            stochastic=cls.STOCHASTIC,
+            seed=_stochastic_seed() if cls.STOCHASTIC else 0,
+        )
+        q = q.reshape(-1, Int8Compressor.BLOCK)
         return q, (orig_dtype, orig_shape, n, scale)
 
     @staticmethod
@@ -122,12 +145,26 @@ class Int8Compressor(Compressor):
         if ctx is None:
             return tensor
         orig_dtype, orig_shape, n, scale = ctx
-        deq = tensor.astype(jnp.float32) * scale
-        return deq.reshape(-1)[:n].reshape(orig_shape).astype(orig_dtype)
+        from ..ops import dequantize_int8_blocks
+
+        deq = dequantize_int8_blocks(
+            tensor.reshape(-1, 128), scale, n, dtype=jnp.float32
+        )
+        return deq.reshape(orig_shape).astype(orig_dtype)
 
     @staticmethod
     def wire_dtype(dtype):
         return jnp.int8 if jnp.issubdtype(dtype, jnp.floating) else dtype
+
+
+class Int8StochasticCompressor(Int8Compressor):
+    """Int8 with stochastic rounding via the on-core TPU PRNG
+    (ops/pallas_ops.py): unbiased quantisation noise, so rounding error
+    does not accumulate over ranks when the wire feeds a summation —
+    the error model EQuARX (PAPERS.md, arXiv:2506.17615) assumes.
+    Falls back to deterministic rounding off-TPU."""
+
+    STOCHASTIC = True
 
 
 class Compression:
@@ -137,6 +174,7 @@ class Compression:
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     int8 = Int8Compressor
+    int8_stochastic = Int8StochasticCompressor
 
     @staticmethod
     def from_name(name: str):
@@ -146,6 +184,7 @@ class Compression:
                 "fp16": FP16Compressor,
                 "bf16": BF16Compressor,
                 "int8": Int8Compressor,
+                "int8_stochastic": Int8StochasticCompressor,
             }[name]
         except KeyError:
             raise ValueError(f"unknown compression {name!r}") from None

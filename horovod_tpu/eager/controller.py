@@ -740,14 +740,16 @@ class EagerController:
             raise HorovodInternalError(f"unknown response type {rs.type}")
 
     def _execute_allreduce(self, rs: wire.Response, payloads: List[_Payload]):
-        from ..comm.compression import Int8Compressor
+        from ..comm.spmd import _is_int8
 
         rop = _WIRE_TO_RED[rs.red_op]
         unfusable = (
             rs.red_op == wire.RED_ADASUM
             # int8's per-chunk scales don't sum across ranks outside the
-            # quantized-allreduce kernel; keep it on the per-tensor path.
-            or any(p.compressor is Int8Compressor for p in payloads)
+            # quantized-allreduce kernel; keep it on the per-tensor path
+            # (subclass-aware: Int8StochasticCompressor must not slip
+            # onto the fused path either).
+            or any(_is_int8(p.compressor) for p in payloads)
         )
         if unfusable or len(payloads) == 1:
             # Adasum stays per-tensor (scale-invariance is per-tensor);
@@ -767,11 +769,22 @@ class EagerController:
         # with elementwise reduction, so apply them per tensor around ONE
         # flat collective (parity: MemcpyInFusionBuffer -> single
         # ncclAllReduce -> MemcpyOutFusionBuffer).
+        from ..ops import fused_scale_cast
+
         wires, ctxs = [], []
         for p in payloads:
             t = p.tensor
             if p.prescale != 1.0:
-                t = t * jnp.asarray(p.prescale, t.dtype)
+                # one-pass Pallas scale kernel on the eager float path
+                # (parity: ScaleBuffer cuda_kernels around the fusion
+                # buffer); int dtypes keep the legacy truncating-scale
+                # semantics
+                if jnp.issubdtype(t.dtype, jnp.floating):
+                    t = fused_scale_cast(
+                        t.reshape(-1), p.prescale
+                    ).reshape(t.shape)
+                else:
+                    t = t * jnp.asarray(p.prescale, t.dtype)
             t, ctx = p.compressor.compress(t)
             wires.append(t)
             ctxs.append(ctx)
@@ -787,5 +800,10 @@ class EagerController:
         for p, ctx, piece in zip(payloads, ctxs, unpack_flat(red, specs)):
             out = p.compressor.decompress(piece, ctx)
             if p.postscale != 1.0:
-                out = out * jnp.asarray(p.postscale, out.dtype)
+                if jnp.issubdtype(out.dtype, jnp.floating):
+                    out = fused_scale_cast(
+                        out.reshape(-1), p.postscale
+                    ).reshape(out.shape)
+                else:
+                    out = out * jnp.asarray(p.postscale, out.dtype)
             p.future.set_result(out)

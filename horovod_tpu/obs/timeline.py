@@ -123,6 +123,11 @@ class Timeline:
             self.instant(CYCLE, index=cycle_index)
 
     def close(self):
+        # End dangling spans first so the trace has no unmatched 'B'
+        # events (e.g. ops still negotiating when the file is swapped
+        # by start_timeline).
+        for name in list(self._open_spans):
+            self.end(name)
         with self._lock:
             if self._closed:
                 return

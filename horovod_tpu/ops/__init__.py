@@ -1,0 +1,20 @@
+"""Pallas TPU kernels for data-plane hot ops.
+
+TPU analog of the reference's hand-written CUDA kernels
+(``horovod/common/ops/cuda/cuda_kernels.cu``) — see
+:mod:`horovod_tpu.ops.pallas_ops`.
+"""
+
+from .pallas_ops import (  # noqa: F401
+    QBLOCK,
+    dequantize_int8_blocks,
+    fused_scale_cast,
+    quantize_int8_blocks,
+)
+
+__all__ = [
+    "QBLOCK",
+    "fused_scale_cast",
+    "quantize_int8_blocks",
+    "dequantize_int8_blocks",
+]

@@ -159,6 +159,17 @@ def build_worker_env(
     )
     if uniform_local is not None:
         env["HVTPU_UNIFORM_LOCAL_SIZE"] = str(uniform_local)
+    # Source-checkout robustness: make the horovod_tpu package the
+    # launcher itself is running from importable in workers even when
+    # it is not pip-installed and the script lives elsewhere (the
+    # reference assumes an installed horovod; worker scripts here are
+    # run by absolute path, so cwd is not on sys.path).
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    if pkg_root not in parts:
+        env["PYTHONPATH"] = os.pathsep.join([pkg_root] + parts)
     if args is not None:
         flag_env = {
             "HVTPU_FUSION_THRESHOLD_MB": args.fusion_threshold_mb,

@@ -267,6 +267,26 @@ def test_grouped_variants_and_compression_2proc():
             compression=Compression.fp16,
         )
         out["fp16"] = float(np.asarray(hvt.synchronize(h))[0])
+
+        # int8 (incl. the stochastic subclass) must stay OFF the fused
+        # flat-buffer path: per-rank block scales don't survive a raw
+        # summed wire (regression: the controller's unfusable check
+        # matched Int8Compressor by identity, so the subclass fused and
+        # produced garbage).  Two concurrent ops makes the controller
+        # emit one fused response covering both.
+        hs = [
+            hvt.allreduce_async(
+                jnp.full((16,), 2.0 + r), name="q8a", op=hvt.Sum,
+                compression=Compression.int8_stochastic,
+            ),
+            hvt.allreduce_async(
+                jnp.full((16,), 10.0 * (r + 1)), name="q8b", op=hvt.Sum,
+                compression=Compression.int8_stochastic,
+            ),
+        ]
+        q8a, q8b = [np.asarray(hvt.synchronize(h)) for h in hs]
+        out["q8a"] = float(q8a[0])
+        out["q8b"] = float(q8b[0])
         return (r, out)
 
     results = _run(body, np=2)
@@ -277,6 +297,9 @@ def test_grouped_variants_and_compression_2proc():
         # reducescatter of (2,) over 2 ranks -> 1 element per rank
         assert out["r2"] == [3.0]
         assert out["fp16"] == 4.0  # 1.5 + 2.5, exact in fp16
+        # 2+3=5 and 10+20=30, within one int8 quantization step
+        assert abs(out["q8a"] - 5.0) <= 5.0 / 127 + 1e-6
+        assert abs(out["q8b"] - 30.0) <= 30.0 / 127 + 1e-6
 
 
 def test_join_uneven_batches_2proc():

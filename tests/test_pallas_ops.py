@@ -1,0 +1,123 @@
+"""Pallas TPU kernel tests (ops/pallas_ops.py).
+
+The kernels are the TPU analog of the reference's hand-written device
+kernels (horovod/common/ops/cuda/cuda_kernels.cu scale-buffer kernels;
+MemcpyInFusionBuffer pack path).  On the CPU test platform the kernel
+bodies execute under the Pallas interpreter (HVTPU_PALLAS_INTERPRET=1)
+and must agree exactly with the pure-XLA twin lowering the production
+fallback uses — the same executable-spec pattern as test_native.py's
+C++/Python cross-check.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from horovod_tpu.ops import (
+    QBLOCK,
+    dequantize_int8_blocks,
+    fused_scale_cast,
+    quantize_int8_blocks,
+)
+from horovod_tpu.ops import pallas_ops
+
+
+@pytest.fixture
+def interpret_mode(monkeypatch):
+    monkeypatch.setenv("HVTPU_PALLAS_INTERPRET", "1")
+
+
+def _rand(n, seed=0):
+    return jnp.asarray(
+        np.random.RandomState(seed).randn(n).astype(np.float32)
+    )
+
+
+class TestFusedScaleCast:
+    @pytest.mark.parametrize("n", [17, 1024, 32768, 40000])
+    @pytest.mark.parametrize("out_dtype", [jnp.float32, jnp.bfloat16])
+    def test_matches_xla(self, interpret_mode, n, out_dtype):
+        x = _rand(n)
+        got = fused_scale_cast(x, 0.125, out_dtype)
+        want = (x * 0.125).astype(out_dtype)
+        assert got.shape == (n,)
+        assert got.dtype == want.dtype
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_xla_fallback_identical(self, interpret_mode, monkeypatch):
+        x = _rand(5000, seed=3)
+        kernel = fused_scale_cast(x, 2.0, jnp.bfloat16)
+        monkeypatch.setenv("HVTPU_PALLAS", "0")
+        xla = fused_scale_cast(x, 2.0, jnp.bfloat16)
+        np.testing.assert_array_equal(np.asarray(kernel), np.asarray(xla))
+
+
+class TestQuantizeInt8:
+    @pytest.mark.parametrize("n", [100, QBLOCK, 3 * QBLOCK + 5, 70000])
+    def test_roundtrip_error_bound(self, interpret_mode, n):
+        x = _rand(n, seed=1)
+        q, scale, n_out = quantize_int8_blocks(x)
+        assert n_out == n
+        assert q.dtype == jnp.int8
+        out = dequantize_int8_blocks(q, scale, n)
+        # absmax block quantisation: error <= scale/2 per block
+        per_block_tol = (
+            np.asarray(scale).reshape(-1, 1) * 0.51
+        )
+        err = np.abs(
+            np.asarray(out) - np.asarray(x)
+        )
+        padded = np.zeros(q.shape[0] * 128 // QBLOCK * QBLOCK)
+        padded[:n] = err
+        blocks = padded.reshape(-1, QBLOCK)
+        assert (blocks <= per_block_tol + 1e-7).all()
+
+    def test_kernel_matches_xla_twin(self, interpret_mode, monkeypatch):
+        x = _rand(9000, seed=2)
+        qk, sk, _ = quantize_int8_blocks(x)
+        monkeypatch.setenv("HVTPU_PALLAS", "0")
+        qx, sx, _ = quantize_int8_blocks(x)
+        # kernel pads rows further than the twin; the shared prefix must
+        # be byte-identical (codes AND scales)
+        rows = qx.shape[0]
+        np.testing.assert_array_equal(np.asarray(qk)[:rows], np.asarray(qx))
+        np.testing.assert_array_equal(
+            np.asarray(sk)[: sx.shape[0]], np.asarray(sx)
+        )
+        # padding region quantises zeros -> zero codes
+        assert not np.asarray(qk)[rows:].any()
+
+    def test_zero_block_scale(self, interpret_mode):
+        x = jnp.zeros((2048,), jnp.float32)
+        q, scale, n = quantize_int8_blocks(x)
+        assert not np.asarray(q).any()
+        out = dequantize_int8_blocks(q, scale, n)
+        assert not np.asarray(out).any()
+
+
+class TestInt8CompressorIntegration:
+    def test_compressor_uses_block_layout(self):
+        from horovod_tpu.comm.compression import Compression
+
+        x = _rand(5000, seed=4).reshape(50, 100)
+        wire, ctx = Compression.int8.compress(x)
+        assert wire.dtype == jnp.int8
+        assert wire.shape[1] == Compression.int8.BLOCK
+        back = Compression.int8.decompress(wire, ctx)
+        assert back.shape == x.shape
+        amax = float(jnp.max(jnp.abs(x)))
+        assert float(jnp.max(jnp.abs(back - x))) <= amax / 127 * 0.51 + 1e-7
+
+    def test_stochastic_falls_back_deterministic_off_tpu(self):
+        from horovod_tpu.comm.compression import Compression
+
+        x = _rand(3000, seed=5)
+        w1, c1 = Compression.int8_stochastic.compress(x)
+        w2, c2 = Compression.int8_stochastic.compress(x)
+        np.testing.assert_array_equal(np.asarray(w1), np.asarray(w2))
+        back = Compression.int8_stochastic.decompress(w1, c1)
+        amax = float(jnp.max(jnp.abs(x)))
+        assert float(jnp.max(jnp.abs(back - x))) <= amax / 127 * 0.51 + 1e-7
