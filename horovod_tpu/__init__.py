@@ -435,6 +435,7 @@ def join(device=None) -> int:
 # ---------------------------------------------------------------------------
 
 DistributedOptimizer = _optimizer.DistributedOptimizer
+ShardedDistributedOptimizer = _optimizer.ShardedDistributedOptimizer
 allreduce_gradients = _optimizer.allreduce_gradients
 broadcast_parameters = _functions.broadcast_parameters
 broadcast_optimizer_state = _functions.broadcast_optimizer_state
@@ -460,7 +461,8 @@ __all__ = [
     "broadcast_async", "alltoall_async",
     "reducescatter_async", "synchronize", "poll",
     "start_timeline", "stop_timeline",
-    "DistributedOptimizer", "allreduce_gradients",
+    "DistributedOptimizer", "ShardedDistributedOptimizer",
+    "allreduce_gradients",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
     "Checkpointer", "save_checkpoint", "restore_checkpoint",

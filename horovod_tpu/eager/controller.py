@@ -56,6 +56,18 @@ _RED_TO_WIRE = {
 _WIRE_TO_RED = {v: k for k, v in _RED_TO_WIRE.items()}
 
 
+def _apply_scale(t, factor: float):
+    """Pre/postscale around the fused wire: one-pass Pallas scale
+    kernel for floats (parity: ScaleBuffer cuda_kernels around the
+    fusion buffer); int dtypes keep the legacy truncating-scale
+    semantics."""
+    if jnp.issubdtype(t.dtype, jnp.floating):
+        from ..ops import fused_scale_cast
+
+        return fused_scale_cast(t.reshape(-1), factor).reshape(t.shape)
+    return t * jnp.asarray(factor, t.dtype)
+
+
 class OpFuture:
     """Completion future for one enqueued op (parity: the handle slots of
     horovod/torch/handle_manager.cc — done flag + result/exception)."""
@@ -769,22 +781,11 @@ class EagerController:
         # with elementwise reduction, so apply them per tensor around ONE
         # flat collective (parity: MemcpyInFusionBuffer -> single
         # ncclAllReduce -> MemcpyOutFusionBuffer).
-        from ..ops import fused_scale_cast
-
         wires, ctxs = [], []
         for p in payloads:
             t = p.tensor
             if p.prescale != 1.0:
-                # one-pass Pallas scale kernel on the eager float path
-                # (parity: ScaleBuffer cuda_kernels around the fusion
-                # buffer); int dtypes keep the legacy truncating-scale
-                # semantics
-                if jnp.issubdtype(t.dtype, jnp.floating):
-                    t = fused_scale_cast(
-                        t.reshape(-1), p.prescale
-                    ).reshape(t.shape)
-                else:
-                    t = t * jnp.asarray(p.prescale, t.dtype)
+                t = _apply_scale(t, p.prescale)
             t, ctx = p.compressor.compress(t)
             wires.append(t)
             ctxs.append(ctx)
@@ -800,10 +801,5 @@ class EagerController:
         for p, ctx, piece in zip(payloads, ctxs, unpack_flat(red, specs)):
             out = p.compressor.decompress(piece, ctx)
             if p.postscale != 1.0:
-                if jnp.issubdtype(out.dtype, jnp.floating):
-                    out = fused_scale_cast(
-                        out.reshape(-1), p.postscale
-                    ).reshape(out.shape)
-                else:
-                    out = out * jnp.asarray(p.postscale, out.dtype)
+                out = _apply_scale(out, p.postscale)
             p.future.set_result(out)

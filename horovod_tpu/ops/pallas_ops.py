@@ -242,7 +242,13 @@ def quantize_int8_blocks(flat, *, stochastic: bool = False,
         q, scale, n = _quantize_xla(flat)
         return q, scale, n
 
-    x2, rows, n = _pad_to_grid(flat.astype(jnp.float32), _QROWS)
+    # keep the native width into the kernel (the in-register cast in
+    # the body handles f32 accumulation) — a host-side astype would
+    # materialize a full f32 copy of the buffer in HBM first; only
+    # exotic dtypes (f64 etc.) pre-cast
+    if flat.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):
+        flat = flat.astype(jnp.float32)
+    x2, rows, n = _pad_to_grid(flat, _QROWS)
 
     def call(x_part, part_rows, tile, seed_val):
         g_per_tile = tile // _QROWS

@@ -4,9 +4,12 @@ Parity surface: ``horovod/tensorflow/mpi_ops.py`` + the C++ custom-op
 binding ``horovod/tensorflow/mpi_ops.cc`` (``HorovodAllreduceOp`` …).
 
 Adapter design: the reference registers TF custom kernels; here the
-boundary is tf ↔ numpy ↔ jax.  Eager tensors convert directly; inside
-a ``tf.function`` graph the ops route through ``tf.py_function`` (the
-engine executes eagerly mid-graph), keeping user code with
+boundary is tf ↔ jax via DLPack — zero host copy for eager CPU tensors
+in both directions (parity: the TFTensor adapter in mpi_ops.cc wrapping
+the TF buffer directly; same contract as the torch adapter), with a
+numpy fallback for float64 (jax x64 semantics) and exotic layouts.
+Inside a ``tf.function`` graph the ops route through ``tf.py_function``
+(the engine executes eagerly mid-graph), keeping user code with
 ``@tf.function`` training steps working unchanged — the role
 ``xla_mpi_ops.cc``'s CustomCall plays in the reference.
 ``tf.IndexedSlices`` gradients take the values+indices allgather path
@@ -17,6 +20,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import jax
 import numpy as np
 import tensorflow as tf
 
@@ -66,13 +70,57 @@ def _np(t) -> np.ndarray:
     return np.asarray(t)
 
 
+def _to_engine(t):
+    """tf → jax with zero host copy via DLPack for eager CPU tensors
+    (fallback: numpy).  float64 stays on the numpy path so jax's x64
+    truncation semantics match the torch adapter."""
+    if isinstance(t, tf.Variable):
+        t = t.value()
+    if isinstance(t, tf.Tensor):
+        if t.dtype == tf.float64:
+            return t.numpy()
+        try:
+            return jax.dlpack.from_dlpack(
+                tf.experimental.dlpack.to_dlpack(t)
+            )
+        except Exception:
+            return _np(t)
+    return np.asarray(t)
+
+
+def _from_engine(arr, dtype=None):
+    """jax → tf sharing the engine's output buffer via DLPack (numpy
+    copy fallback); restores the caller's dtype like the reference's
+    decompress-to-input-dtype convention."""
+    try:
+        out = tf.experimental.dlpack.from_dlpack(arr.__dlpack__())
+    except Exception:
+        out = tf.convert_to_tensor(np.asarray(arr))
+    if dtype is not None and out.dtype != dtype:
+        out = tf.cast(out, dtype)
+    return out
+
+
 def _graph_op(fn, inputs, out_dtype, out_shape=None):
-    """Run ``fn`` (numpy-level engine call) inside a TF graph via
-    tf.py_function; in eager mode call it directly."""
+    """Run ``fn`` (an engine call accepting jax/numpy arrays) inside a
+    TF graph via tf.py_function; in eager mode call it directly on the
+    DLPack-shared buffers."""
     if tf.executing_eagerly():
-        return tf.convert_to_tensor(fn(*[_np(i) for i in inputs]))
+        return _from_engine(fn(*[_to_engine(i) for i in inputs]),
+                            dtype=out_dtype)
+
+    def _np_out(o):
+        a = np.asarray(o)
+        # py_function's Tout contract is strict: restore the declared
+        # dtype when the engine computed narrower (float64 runs at f32
+        # wire precision unless jax x64 is enabled)
+        want = getattr(out_dtype, "as_numpy_dtype", None)
+        if want is not None and a.dtype != np.dtype(want):
+            a = a.astype(want)
+        return tf.convert_to_tensor(a)
+
     out = tf.py_function(
-        lambda *ts: tf.convert_to_tensor(fn(*[t.numpy() for t in ts])),
+        lambda *ts: _np_out(fn(*[t.numpy() for t in ts])),
         inputs, Tout=out_dtype,
     )
     if out_shape is not None:
@@ -117,13 +165,13 @@ def allreduce(tensor, average=None, op=None, name=None,
                                 dense_shape=tensor.dense_shape)
 
     def impl(x):
-        return np.asarray(_hvt.allreduce(
+        return _hvt.allreduce(
             x, op=op, average=average, name=name,
             compression=_engine_compression(compression),
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
             process_set=process_set,
-        ))
+        )
 
     return _graph_op(impl, [tensor], tensor.dtype, tensor.shape)
 
@@ -132,11 +180,12 @@ def grouped_allreduce(tensors: List, average=None, op=None,
                       compression=Compression.none, process_set=None):
     if tf.executing_eagerly():
         outs = _hvt.grouped_allreduce(
-            [_np(t) for t in tensors], op=op, average=average,
+            [_to_engine(t) for t in tensors], op=op, average=average,
             compression=_engine_compression(compression),
             process_set=process_set,
         )
-        return [tf.convert_to_tensor(np.asarray(o)) for o in outs]
+        return [_from_engine(o, dtype=t.dtype)
+                for t, o in zip(tensors, outs)]
     return [
         allreduce(t, average=average, op=op, compression=compression,
                   process_set=process_set)
@@ -148,9 +197,7 @@ def allgather(tensor, name=None, process_set=None):
     """Concatenate along dim 0 across ranks (ragged dim 0 supported)."""
 
     def impl(x):
-        return np.asarray(
-            _hvt.allgather(x, process_set=process_set, name=name)
-        )
+        return _hvt.allgather(x, process_set=process_set, name=name)
 
     shape = tf.TensorShape([None]).concatenate(tensor.shape[1:]) \
         if tensor.shape.rank is not None and tensor.shape.rank > 0 else None
@@ -159,9 +206,9 @@ def allgather(tensor, name=None, process_set=None):
 
 def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):
     def impl(x):
-        return np.asarray(_hvt.broadcast(
+        return _hvt.broadcast(
             x, root_rank=root_rank, process_set=process_set, name=name
-        ))
+        )
 
     return _graph_op(impl, [tensor], tensor.dtype, tensor.shape)
 
@@ -171,18 +218,19 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
     splits is given, else just the output."""
     if splits is None:
         def impl(x):
-            return np.asarray(_hvt.alltoall(
+            return _hvt.alltoall(
                 x, None, process_set=process_set, name=name
-            ))
+            )
 
         shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
         return _graph_op(impl, [tensor], tensor.dtype, shape)
 
     if tf.executing_eagerly():
         out, rsplits = _hvt.alltoall(
-            _np(tensor), _np(splits), process_set=process_set, name=name
+            _to_engine(tensor), _np(splits), process_set=process_set,
+            name=name,
         )
-        return (tf.convert_to_tensor(np.asarray(out)),
+        return (_from_engine(out, dtype=tensor.dtype),
                 tf.convert_to_tensor(np.asarray(rsplits)))
 
     out, rsplits = tf.py_function(
@@ -199,9 +247,9 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
 
 def reducescatter(tensor, op=None, name=None, process_set=None):
     def impl(x):
-        return np.asarray(_hvt.reducescatter(
+        return _hvt.reducescatter(
             x, op=op, process_set=process_set, name=name
-        ))
+        )
 
     shape = tf.TensorShape([None]).concatenate(tensor.shape[1:]) \
         if tensor.shape.rank is not None and tensor.shape.rank > 0 else None

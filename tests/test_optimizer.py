@@ -180,3 +180,84 @@ class TestDistributedOptimizer:
         updates, opt_state = tx.update(g, opt_state, params)
         p2 = optax.apply_updates(params, updates)
         assert float(mlp_loss(p2, x, y)) < float(mlp_loss(params, x, y))
+
+
+class TestShardedDistributedOptimizer:
+    """ZeRO-1 sharded optimizer (reduce_scatter grads -> shard update
+    -> all_gather): must train identically to the unsharded
+    DistributedOptimizer while holding only 1/N of the state."""
+
+    def _train(self, tx, steps=15):
+        params = make_mlp_params(jax.random.PRNGKey(0))
+        x, y = make_data()
+
+        def body(p, xb, yb):
+            s = tx.init(p)
+
+            def one(i, carry):
+                p, s = carry
+                loss, g = jax.value_and_grad(mlp_loss)(p, xb, yb)
+                u, s = tx.update(g, s, p)
+                return (optax.apply_updates(p, u), s)
+
+            p, s = jax.lax.fori_loop(0, steps, one, (p, s))
+            return p
+
+        return jax.jit(
+            jax.shard_map(
+                body, mesh=mesh8(),
+                in_specs=(P(), P(AXIS), P(AXIS)),
+                out_specs=P(), check_vma=False,
+            )
+        )(params, x, y)
+
+    def test_matches_unsharded(self, hvt):
+        p_sharded = self._train(
+            hvt.ShardedDistributedOptimizer(optax.adam(1e-2), axis_name=AXIS)
+        )
+        p_dense = self._train(
+            hvt.DistributedOptimizer(optax.adam(1e-2), axis_name=AXIS)
+        )
+        for k in p_dense:
+            np.testing.assert_allclose(
+                np.asarray(p_sharded[k]), np.asarray(p_dense[k]),
+                rtol=2e-5, atol=2e-6,
+            )
+
+    def test_state_is_sharded(self, hvt):
+        tx = hvt.ShardedDistributedOptimizer(optax.adam(1e-2), axis_name=AXIS)
+        params = make_mlp_params(jax.random.PRNGKey(0))
+        n_params = sum(int(np.prod(v.shape)) for v in params.values())
+
+        def body(p):
+            s = tx.init(p)
+            biggest = max(
+                (l.size for l in jax.tree_util.tree_leaves(s) if l.ndim),
+                default=0,
+            )
+            return jnp.asarray(biggest)
+
+        biggest = int(jax.jit(jax.shard_map(
+            body, mesh=mesh8(), in_specs=(P(),), out_specs=P(),
+            check_vma=False,
+        ))(params))
+        assert biggest == -(-n_params // 8)  # ceil(P/N), not P
+
+    def test_sum_op_and_compression(self, hvt):
+        from horovod_tpu.comm.compression import Compression
+
+        tx = hvt.ShardedDistributedOptimizer(
+            optax.sgd(1e-3), axis_name=AXIS, average=False,
+            compression=Compression.bf16,
+        )
+        p = self._train(tx)
+        assert all(np.isfinite(np.asarray(v)).all() for v in p.values())
+
+    def test_int8_compression_rejected(self, hvt):
+        from horovod_tpu.comm.compression import Compression
+
+        with pytest.raises(ValueError, match="int8"):
+            hvt.ShardedDistributedOptimizer(
+                optax.sgd(1e-3), axis_name=AXIS,
+                compression=Compression.int8,
+            )

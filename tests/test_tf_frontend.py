@@ -28,6 +28,31 @@ class TestTfOps:
         np.testing.assert_allclose(out.numpy(), [[2.0, 4.0]])
         assert out.shape == (1, 2)
 
+    def test_allreduce_graph_mode_float64(self, hvt):
+        # py_function's Tout contract: the engine computes at f32 wire
+        # precision (jax x64 off) but the declared float64 dtype must be
+        # restored, not error (regression: dtype-mismatch crash)
+        @tf.function
+        def step(t):
+            return hvd_tf.allreduce(t, op=hvd_tf.Sum)
+
+        out = step(tf.constant([1.5, 2.5], dtype=tf.float64))
+        assert out.dtype == tf.float64
+        np.testing.assert_allclose(out.numpy(), [1.5, 2.5])
+
+    def test_allreduce_eager_float64_and_bfloat16(self, hvt):
+        out = hvd_tf.allreduce(
+            tf.constant([1.0, 2.0], dtype=tf.float64), op=hvd_tf.Sum
+        )
+        assert out.dtype == tf.float64
+        out16 = hvd_tf.allreduce(
+            tf.constant([1.0, 2.0], dtype=tf.bfloat16), op=hvd_tf.Sum
+        )
+        assert out16.dtype == tf.bfloat16
+        np.testing.assert_allclose(
+            tf.cast(out16, tf.float32).numpy(), [1.0, 2.0]
+        )
+
     def test_allgather_and_broadcast(self, hvt):
         g = hvd_tf.allgather(tf.ones((3, 2)))
         assert g.shape == (3, 2)
