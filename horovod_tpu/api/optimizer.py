@@ -198,8 +198,7 @@ def ShardedDistributedOptimizer(
     def _flatten(tree):
         leaves_with_paths = jax.tree_util.tree_leaves_with_path(tree)
         leaves = [l for _, l in leaves_with_paths]
-        flat, _ = pack_flat(leaves)
-        specs = [(tuple(l.shape), l.dtype, int(l.size)) for l in leaves]
+        flat, specs = pack_flat(leaves)
         return flat, specs, jax.tree_util.tree_structure(tree)
 
     def _shard_bounds(n_total, n_ranks):

@@ -11,10 +11,13 @@ from .pallas_ops import (  # noqa: F401
     fused_scale_cast,
     quantize_int8_blocks,
 )
+from .ring import ring_allgather_2d, ring_allreduce  # noqa: F401
 
 __all__ = [
     "QBLOCK",
     "fused_scale_cast",
     "quantize_int8_blocks",
     "dequantize_int8_blocks",
+    "ring_allreduce",
+    "ring_allgather_2d",
 ]

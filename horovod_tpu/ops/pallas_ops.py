@@ -168,6 +168,20 @@ _QROWS = 8
 QBLOCK = _QROWS * _LANES
 
 
+def block_scale_inv(xg):
+    """Shared absmax-block quantisation formula: (scale, inv) for
+    blocks ``xg (g, B) f32``.  THE single definition — the Pallas
+    kernel, the XLA twin, and the ring kernel's per-hop requantization
+    (ops/ring.py) must stay bit-identical, so they all call this."""
+    absmax = jnp.max(jnp.abs(xg), axis=1, keepdims=True)
+    # single multiply (not /127): a division invites per-fusion
+    # strength-reduction ulp drift between lowerings
+    scale = absmax * jnp.float32(1.0 / 127.0)
+    inv = jnp.where(scale > 0.0,
+                    1.0 / jnp.where(scale > 0.0, scale, 1.0), 0.0)
+    return scale, inv
+
+
 def _quantize_kernel(seed_ref, x_ref, q_ref, scale_ref, *, stochastic,
                      tile):
     i = pl.program_id(0)
@@ -177,12 +191,7 @@ def _quantize_kernel(seed_ref, x_ref, q_ref, scale_ref, *, stochastic,
     # per-(8,128)-tile absmax: reduce within each group of _QROWS rows
     g = tile // _QROWS
     xg = x.reshape(g, _QROWS * _LANES)
-    absmax = jnp.max(jnp.abs(xg), axis=1, keepdims=True)  # (g, 1)
-    # single multiply (not /127): bit-identical with the XLA twin — a
-    # division invites per-fusion strength-reduction ulp drift
-    scale = absmax * jnp.float32(1.0 / 127.0)
-    inv = jnp.where(scale > 0.0, 1.0 / jnp.where(scale > 0.0, scale, 1.0),
-                    0.0)
+    scale, inv = block_scale_inv(xg)
     scaled = xg * inv
     if stochastic:
         # pltpu.stochastic_round only targets bf16/fp8; integer
@@ -213,10 +222,7 @@ def _quantize_xla(flat):
     x2, rows, n = _pad_to_grid(flat.astype(jnp.float32), _QROWS)
     g = rows // _QROWS
     xg = x2.reshape(g, QBLOCK)
-    absmax = jnp.max(jnp.abs(xg), axis=1, keepdims=True)
-    scale = absmax * jnp.float32(1.0 / 127.0)
-    inv = jnp.where(scale > 0.0, 1.0 / jnp.where(scale > 0.0, scale, 1.0),
-                    0.0)
+    scale, inv = block_scale_inv(xg)
     q = jnp.clip(jnp.round(xg * inv), -127, 127).astype(jnp.int8)
     return q.reshape(rows, _LANES), scale, n
 

@@ -132,6 +132,14 @@ def _graph_op(fn, inputs, out_dtype, out_shape=None):
 # collectives
 # ---------------------------------------------------------------------------
 
+# AutoGraph must NOT convert these ops when a user's @tf.function body
+# calls them: conversion rewrites internal helper calls (observed:
+# tf___to_engine substituted for allreduce under cache-order-dependent
+# tracing) and the bodies are host-side engine dispatches anyway.
+_no_autograph = tf.autograph.experimental.do_not_convert
+
+
+@_no_autograph
 def allreduce(tensor, average=None, op=None, name=None,
               compression=Compression.none,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
@@ -176,6 +184,7 @@ def allreduce(tensor, average=None, op=None, name=None,
     return _graph_op(impl, [tensor], tensor.dtype, tensor.shape)
 
 
+@_no_autograph
 def grouped_allreduce(tensors: List, average=None, op=None,
                       compression=Compression.none, process_set=None):
     if tf.executing_eagerly():
@@ -193,6 +202,7 @@ def grouped_allreduce(tensors: List, average=None, op=None,
     ]
 
 
+@_no_autograph
 def allgather(tensor, name=None, process_set=None):
     """Concatenate along dim 0 across ranks (ragged dim 0 supported)."""
 
@@ -204,6 +214,7 @@ def allgather(tensor, name=None, process_set=None):
     return _graph_op(impl, [tensor], tensor.dtype, shape)
 
 
+@_no_autograph
 def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):
     def impl(x):
         return _hvt.broadcast(
@@ -213,6 +224,7 @@ def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):
     return _graph_op(impl, [tensor], tensor.dtype, tensor.shape)
 
 
+@_no_autograph
 def alltoall(tensor, splits=None, name=None, process_set=None):
     """Parity: hvd.alltoall — returns (output, received_splits) when
     splits is given, else just the output."""
@@ -233,18 +245,27 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
         return (_from_engine(out, dtype=tensor.dtype),
                 tf.convert_to_tensor(np.asarray(rsplits)))
 
+    want_np = tensor.dtype.as_numpy_dtype
+
+    def _pyfn(t, s):
+        o, rs = _hvt.alltoall(t.numpy(), s.numpy(),
+                              process_set=process_set, name=name)
+        o = np.asarray(o)
+        # same Tout contract as _graph_op._np_out: restore the declared
+        # dtype (float64 computes at f32 wire precision with x64 off)
+        if o.dtype != np.dtype(want_np):
+            o = o.astype(want_np)
+        return (tf.convert_to_tensor(o),
+                tf.convert_to_tensor(np.asarray(rs).astype(np.int32)))
+
     out, rsplits = tf.py_function(
-        lambda t, s: tuple(
-            tf.convert_to_tensor(np.asarray(r))
-            for r in _hvt.alltoall(t.numpy(), s.numpy(),
-                                   process_set=process_set, name=name)
-        ),
-        [tensor, splits], Tout=[tensor.dtype, tf.int32],
+        _pyfn, [tensor, splits], Tout=[tensor.dtype, tf.int32],
     )
     out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
     return out, rsplits
 
 
+@_no_autograph
 def reducescatter(tensor, op=None, name=None, process_set=None):
     def impl(x):
         return _hvt.reducescatter(
@@ -256,9 +277,11 @@ def reducescatter(tensor, op=None, name=None, process_set=None):
     return _graph_op(impl, [tensor], tensor.dtype, shape)
 
 
+@_no_autograph
 def barrier(process_set=None):
     _hvt.barrier(process_set=process_set)
 
 
+@_no_autograph
 def join(device=None) -> int:
     return _hvt.join(device)

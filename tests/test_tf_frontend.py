@@ -40,6 +40,16 @@ class TestTfOps:
         assert out.dtype == tf.float64
         np.testing.assert_allclose(out.numpy(), [1.5, 2.5])
 
+    def test_alltoall_graph_mode_float64(self, hvt):
+        @tf.function
+        def step(t):
+            return hvd_tf.alltoall(t, splits=tf.constant([2]))
+
+        out, rsplits = step(tf.constant([1.5, 2.5], dtype=tf.float64))
+        assert out.dtype == tf.float64
+        np.testing.assert_allclose(out.numpy(), [1.5, 2.5])
+        np.testing.assert_array_equal(rsplits.numpy(), [2])
+
     def test_allreduce_eager_float64_and_bfloat16(self, hvt):
         out = hvd_tf.allreduce(
             tf.constant([1.0, 2.0], dtype=tf.float64), op=hvd_tf.Sum
