@@ -115,6 +115,18 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.hvt_timeline_mark_cycle.argtypes = [c.c_void_p, c.c_double]
     lib.hvt_timeline_flush.argtypes = [c.c_void_p]
+    lib.hvt_gp_predict.restype = c.c_int
+    lib.hvt_gp_predict.argtypes = [
+        c.POINTER(c.c_double), c.POINTER(c.c_double), c.c_int64, c.c_int64,
+        c.POINTER(c.c_double), c.c_int64, c.c_double, c.c_double,
+        c.c_double, c.POINTER(c.c_double), c.POINTER(c.c_double),
+    ]
+    lib.hvt_gp_expected_improvement.restype = c.c_int
+    lib.hvt_gp_expected_improvement.argtypes = [
+        c.POINTER(c.c_double), c.POINTER(c.c_double), c.c_int64, c.c_int64,
+        c.POINTER(c.c_double), c.c_int64, c.c_double, c.c_double,
+        c.c_double, c.c_double, c.c_double, c.POINTER(c.c_double),
+    ]
     return lib
 
 
@@ -130,10 +142,13 @@ def load() -> Optional[ctypes.CDLL]:
     if path is None:
         return None
     try:
+        # AttributeError covers a stale .so missing newer symbols (the
+        # ABI check below would reject it too, but only if _configure
+        # survives) — fall back to the Python twin either way.
         _lib = _configure(ctypes.CDLL(path))
-    except OSError:
+    except (OSError, AttributeError):
         return None
-    if _lib.hvt_abi_version() != 1:
+    if _lib.hvt_abi_version() != 2:
         _lib = None
     return _lib
 
@@ -343,3 +358,68 @@ def parallel_scatter(src: memoryview, dsts: List[memoryview]) -> None:
         keep.append(a)
         dst_ptrs[i] = ctypes.cast(a, ctypes.POINTER(ctypes.c_uint8))
     lib.hvt_parallel_scatter(src_arr, dst_ptrs, sizes, n)
+
+
+def _as_c_doubles(arr):
+    import numpy as np
+
+    a = np.ascontiguousarray(arr, dtype=np.float64)
+    return a, a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def gp_predict(xs, ys, cand, *, length_scale: float, noise: float,
+               signal_variance: float):
+    """Native GP posterior (mu, sigma) at ``cand`` (parity:
+    gaussian_process.cc GaussianProcessRegressor).  Returns None when
+    the native lib is unavailable or the Gram matrix is singular — the
+    caller falls back to the numpy twin."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    xs_np, xs_p = _as_c_doubles(np.atleast_2d(xs))
+    ys_np, ys_p = _as_c_doubles(np.asarray(ys).reshape(-1))
+    cand_np, cand_p = _as_c_doubles(np.atleast_2d(cand))
+    n, d = xs_np.shape
+    m = cand_np.shape[0]
+    mu = np.empty(m, np.float64)
+    sigma = np.empty(m, np.float64)
+    rc = lib.hvt_gp_predict(
+        xs_p, ys_p, n, d, cand_p, m,
+        ctypes.c_double(length_scale), ctypes.c_double(noise),
+        ctypes.c_double(signal_variance),
+        mu.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        sigma.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    if rc != 0:
+        return None
+    return mu, sigma
+
+
+def gp_expected_improvement(xs, ys, cand, *, length_scale: float,
+                            noise: float, signal_variance: float,
+                            best_y: float, xi: float):
+    """Native fit+predict+EI in one call (parity: the EI loop of
+    bayesian_optimization.cc NextSample).  None -> caller falls back."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    xs_np, xs_p = _as_c_doubles(np.atleast_2d(xs))
+    ys_np, ys_p = _as_c_doubles(np.asarray(ys).reshape(-1))
+    cand_np, cand_p = _as_c_doubles(np.atleast_2d(cand))
+    n, d = xs_np.shape
+    m = cand_np.shape[0]
+    ei = np.empty(m, np.float64)
+    rc = lib.hvt_gp_expected_improvement(
+        xs_p, ys_p, n, d, cand_p, m,
+        ctypes.c_double(length_scale), ctypes.c_double(noise),
+        ctypes.c_double(signal_variance), ctypes.c_double(best_y),
+        ctypes.c_double(xi),
+        ei.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    if rc != 0:
+        return None
+    return ei

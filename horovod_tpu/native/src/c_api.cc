@@ -56,7 +56,7 @@ int64_t Staged(std::vector<uint8_t>* staged, uint8_t* buf, int64_t cap,
 extern "C" {
 
 // ---- versioning ----------------------------------------------------------
-int hvt_abi_version() { return 1; }
+int hvt_abi_version() { return 2; }  // v2: + hvt_gp_* (gaussian_process.cc)
 
 // ---- controller ----------------------------------------------------------
 void* hvt_controller_new(int rank, int size, int64_t fusion_threshold,

@@ -2,8 +2,13 @@
 
 Parity surface: ``horovod/common/optim/gaussian_process.cc``
 (``GaussianProcessRegressor`` — RBF kernel, Cholesky solve, EI) and
-``bayesian_optimization.cc`` (``BayesianOptimization::NextSample``),
-re-expressed in numpy for the Python-side autotuner.
+``bayesian_optimization.cc`` (``BayesianOptimization::NextSample``).
+
+Like the reference, the math lives in native code
+(``native/src/gaussian_process.cc``, used when the library is
+available); this numpy implementation is the executable-spec twin and
+the fallback — ``tests/test_native.py`` cross-checks the two to
+~1e-10, the same pattern as the controller/fallback pair.
 """
 
 from __future__ import annotations
@@ -25,6 +30,7 @@ class GaussianProcess:
         self.noise = noise
         self.signal_variance = signal_variance
         self._x: Optional[np.ndarray] = None
+        self._raw_y: Optional[np.ndarray] = None
         self._y_mean = 0.0
         self._y_std = 1.0
         self._alpha: Optional[np.ndarray] = None
@@ -37,24 +43,41 @@ class GaussianProcess:
         )
 
     def fit(self, x: np.ndarray, y: np.ndarray):
+        """Record the data and standardisation; the O(n^3) Cholesky is
+        deferred — the native path refactors from raw (x, y) itself, so
+        factoring here would do the cubic work twice per suggest."""
         x = np.atleast_2d(np.asarray(x, np.float64))
         y = np.asarray(y, np.float64).reshape(-1)
+        self._raw_y = y
         self._y_mean = float(y.mean())
         self._y_std = float(y.std()) or 1.0
-        yn = (y - self._y_mean) / self._y_std
-        k = self._kernel(x, x) + self.noise * np.eye(len(x))
+        self._chol = None
+        self._alpha = None
+        self._x = x
+
+    def _ensure_factor(self):
+        if self._chol is not None:
+            return
+        yn = (self._raw_y - self._y_mean) / self._y_std
+        k = self._kernel(self._x, self._x) + self.noise * np.eye(
+            len(self._x)
+        )
         self._chol = np.linalg.cholesky(k)
         self._alpha = np.linalg.solve(
             self._chol.T, np.linalg.solve(self._chol, yn)
         )
-        self._x = x
 
     def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        """Posterior (mean, std) at ``x`` in the ORIGINAL y units."""
+        """Posterior (mean, std) at ``x`` in the ORIGINAL y units.
+        Routes through the native implementation when available."""
         x = np.atleast_2d(np.asarray(x, np.float64))
         if self._x is None:
             return (np.full(len(x), self._y_mean),
                     np.full(len(x), self._y_std))
+        native = _native_predict(self, x)
+        if native is not None:
+            return native
+        self._ensure_factor()
         ks = self._kernel(x, self._x)
         mu = ks @ self._alpha
         v = np.linalg.solve(self._chol, ks.T)
@@ -65,6 +88,30 @@ class GaussianProcess:
         )
         return (mu * self._y_std + self._y_mean,
                 np.sqrt(var) * self._y_std)
+
+
+def _native_enabled(gp: "GaussianProcess") -> bool:
+    import os
+
+    return (getattr(gp, "_raw_y", None) is not None
+            and os.environ.get("HVTPU_FORCE_PY_GP", "0") != "1")
+
+
+def _native_predict(gp: "GaussianProcess", cand):
+    """Posterior via native/src/gaussian_process.cc; None -> fall back
+    to the numpy twin (no toolchain, or a singular Gram matrix)."""
+    if not _native_enabled(gp):
+        return None
+    try:
+        from ..native import core as native_core
+
+        return native_core.gp_predict(
+            gp._x, gp._raw_y, cand,
+            length_scale=gp.length_scale, noise=gp.noise,
+            signal_variance=gp.signal_variance,
+        )
+    except Exception:
+        return None
 
 
 _erf = np.vectorize(math.erf)
@@ -81,7 +128,23 @@ def _norm_cdf(z):
 def expected_improvement(gp: GaussianProcess, candidates: np.ndarray,
                          best_y: float, xi: float = 0.01) -> np.ndarray:
     """EI acquisition (maximization; parity: the EI computation in
-    bayesian_optimization.cc)."""
+    bayesian_optimization.cc).  One native fit+predict+EI call when the
+    library is available, numpy twin otherwise."""
+    candidates = np.atleast_2d(np.asarray(candidates, np.float64))
+    if gp._x is not None and _native_enabled(gp):
+        try:
+            from ..native import core as native_core
+
+            ei = native_core.gp_expected_improvement(
+                gp._x, gp._raw_y, candidates,
+                length_scale=gp.length_scale, noise=gp.noise,
+                signal_variance=gp.signal_variance,
+                best_y=best_y, xi=xi,
+            )
+            if ei is not None:
+                return ei
+        except Exception:
+            pass
     mu, sigma = gp.predict(candidates)
     imp = mu - best_y - xi
     z = imp / sigma

@@ -9,6 +9,7 @@ pure-Python implementations agree byte-for-byte on the wire).
 import json
 import os
 
+import numpy as np
 import pytest
 
 from horovod_tpu import native
@@ -378,3 +379,87 @@ def test_make_controller_fallback_env(monkeypatch):
     monkeypatch.setenv("HVTPU_FORCE_PY_CONTROLLER", "1")
     c = native.make_controller(0, 1, 1 << 20)
     assert isinstance(c, fallback.PyController)
+
+
+class TestNativeGaussianProcess:
+    """native/src/gaussian_process.cc vs the numpy executable-spec twin
+    (obs/gaussian_process.py) — parity: gaussian_process.cc +
+    bayesian_optimization.cc keeping the GP math native."""
+
+    def _data(self, n=15, d=2, seed=3):
+        rng = np.random.RandomState(seed)
+        xs = rng.rand(n, d)
+        ys = np.sin(3 * xs[:, 0]) * np.cos(2 * xs[:, 1]) + 0.05 * rng.randn(n)
+        cand = rng.rand(64, d)
+        return xs, ys, cand
+
+    def test_predict_matches_numpy_twin(self):
+        if not ncore.available():
+            pytest.skip("no native toolchain")
+        from horovod_tpu.obs import gaussian_process as gpmod
+
+        xs, ys, cand = self._data()
+        out = ncore.gp_predict(xs, ys, cand, length_scale=0.3,
+                               noise=1e-4, signal_variance=1.0)
+        assert out is not None
+        mu_n, sig_n = out
+        gp = gpmod.GaussianProcess(length_scale=0.3, noise=1e-4)
+        gp.fit(xs, ys)
+        import os
+        os.environ["HVTPU_FORCE_PY_GP"] = "1"  # force the numpy twin
+        try:
+            mu_p, sig_p = gp.predict(cand)
+        finally:
+            del os.environ["HVTPU_FORCE_PY_GP"]
+        np.testing.assert_allclose(mu_n, mu_p, atol=1e-10)
+        np.testing.assert_allclose(sig_n, sig_p, atol=1e-10)
+
+    def test_ei_matches_numpy_twin(self):
+        if not ncore.available():
+            pytest.skip("no native toolchain")
+        from horovod_tpu.obs import gaussian_process as gpmod
+
+        xs, ys, cand = self._data(seed=7)
+        ei_n = ncore.gp_expected_improvement(
+            xs, ys, cand, length_scale=0.3, noise=1e-4,
+            signal_variance=1.0, best_y=float(ys.max()), xi=0.01,
+        )
+        assert ei_n is not None
+        gp = gpmod.GaussianProcess(length_scale=0.3, noise=1e-4)
+        gp.fit(xs, ys)
+        import os
+        os.environ["HVTPU_FORCE_PY_GP"] = "1"
+        try:
+            ei_p = gpmod.expected_improvement(gp, cand, float(ys.max()))
+        finally:
+            del os.environ["HVTPU_FORCE_PY_GP"]
+        np.testing.assert_allclose(ei_n, ei_p, atol=1e-10)
+
+    def test_gp_predict_routes_native_by_default(self):
+        if not ncore.available():
+            pytest.skip("no native toolchain")
+        from horovod_tpu.obs import gaussian_process as gpmod
+
+        xs, ys, cand = self._data(seed=9)
+        gp = gpmod.GaussianProcess(length_scale=0.3, noise=1e-4)
+        gp.fit(xs, ys)
+        mu_native, _ = gp.predict(cand)        # native route
+        import os
+        os.environ["HVTPU_FORCE_PY_GP"] = "1"
+        try:
+            mu_numpy, _ = gp.predict(cand)     # twin route
+        finally:
+            del os.environ["HVTPU_FORCE_PY_GP"]
+        np.testing.assert_allclose(mu_native, mu_numpy, atol=1e-10)
+
+    def test_singular_gram_falls_back(self):
+        if not ncore.available():
+            pytest.skip("no native toolchain")
+        # duplicate points with zero noise -> non-PD Gram; native
+        # returns None and the numpy twin (with jitter) still answers
+        xs = np.zeros((4, 2))
+        ys = np.ones(4)
+        out = ncore.gp_predict(xs, ys, np.zeros((1, 2)),
+                               length_scale=0.3, noise=0.0,
+                               signal_variance=1.0)
+        assert out is None
