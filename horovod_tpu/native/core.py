@@ -383,6 +383,14 @@ def gp_predict(xs, ys, cand, *, length_scale: float, noise: float,
     cand_np, cand_p = _as_c_doubles(np.atleast_2d(cand))
     n, d = xs_np.shape
     m = cand_np.shape[0]
+    # shape discipline before raw pointers cross the C boundary: a
+    # mismatch would stride wrongly (silent garbage) or read OOB; the
+    # numpy twin raises, so raise here too
+    if cand_np.shape[1] != d or ys_np.shape[0] != n:
+        raise ValueError(
+            f"gp_predict shape mismatch: xs {xs_np.shape}, "
+            f"ys {ys_np.shape}, cand {cand_np.shape}"
+        )
     mu = np.empty(m, np.float64)
     sigma = np.empty(m, np.float64)
     rc = lib.hvt_gp_predict(
@@ -412,6 +420,11 @@ def gp_expected_improvement(xs, ys, cand, *, length_scale: float,
     cand_np, cand_p = _as_c_doubles(np.atleast_2d(cand))
     n, d = xs_np.shape
     m = cand_np.shape[0]
+    if cand_np.shape[1] != d or ys_np.shape[0] != n:
+        raise ValueError(
+            f"gp_expected_improvement shape mismatch: xs {xs_np.shape}, "
+            f"ys {ys_np.shape}, cand {cand_np.shape}"
+        )
     ei = np.empty(m, np.float64)
     rc = lib.hvt_gp_expected_improvement(
         xs_p, ys_p, n, d, cand_p, m,

@@ -69,6 +69,11 @@ class Autotuner:
             self._active = self._grid[0]
         self._candidate = 0
         self._scores: List[float] = []
+        # raw params per GP observation: pinning must return the EXACT
+        # candidate that was run, not a log2/2** float round-trip of it
+        # (the round-trip can shift the integer threshold by 1 ulp,
+        # yielding a "best" config that was never actually sampled)
+        self._gp_observed: List[Tuple[int, float]] = []
         self._steps = 0
         self._bytes = 0
         self._t_start = time.monotonic()
@@ -132,9 +137,9 @@ class Autotuner:
         self._bytes = 0
         if self.mode == "gp":
             self._bo.observe(self._params_to_point(self._active), score)
+            self._gp_observed.append(self._active)
             if self._bo.num_observations >= self._max_gp_samples:
-                best_pt, _ = self._bo.best
-                self._pinned = self._point_to_params(best_pt)
+                self._pinned = self._gp_observed[self._bo.best_index]
             else:
                 self._active = self._point_to_params(self._bo.suggest())
         else:

@@ -90,28 +90,33 @@ class GaussianProcess:
                 np.sqrt(var) * self._y_std)
 
 
-def _native_enabled(gp: "GaussianProcess") -> bool:
+def _native_call(fn_name: str, gp: "GaussianProcess", cand, **extra):
+    """Shared native-dispatch policy for the GP entry points: disabled
+    by HVTPU_FORCE_PY_GP=1 or before fit; None (-> numpy twin fallback)
+    when the toolchain is missing, the Gram matrix is singular, or the
+    native call fails for any reason."""
     import os
 
-    return (getattr(gp, "_raw_y", None) is not None
-            and os.environ.get("HVTPU_FORCE_PY_GP", "0") != "1")
-
-
-def _native_predict(gp: "GaussianProcess", cand):
-    """Posterior via native/src/gaussian_process.cc; None -> fall back
-    to the numpy twin (no toolchain, or a singular Gram matrix)."""
-    if not _native_enabled(gp):
+    if (getattr(gp, "_raw_y", None) is None
+            or os.environ.get("HVTPU_FORCE_PY_GP", "0") == "1"):
         return None
     try:
         from ..native import core as native_core
 
-        return native_core.gp_predict(
+        fn = getattr(native_core, fn_name)
+        return fn(
             gp._x, gp._raw_y, cand,
             length_scale=gp.length_scale, noise=gp.noise,
-            signal_variance=gp.signal_variance,
+            signal_variance=gp.signal_variance, **extra,
         )
     except Exception:
         return None
+
+
+def _native_predict(gp: "GaussianProcess", cand):
+    """Posterior via native/src/gaussian_process.cc; None -> fall back
+    to the numpy twin."""
+    return _native_call("gp_predict", gp, cand)
 
 
 _erf = np.vectorize(math.erf)
@@ -131,20 +136,11 @@ def expected_improvement(gp: GaussianProcess, candidates: np.ndarray,
     bayesian_optimization.cc).  One native fit+predict+EI call when the
     library is available, numpy twin otherwise."""
     candidates = np.atleast_2d(np.asarray(candidates, np.float64))
-    if gp._x is not None and _native_enabled(gp):
-        try:
-            from ..native import core as native_core
-
-            ei = native_core.gp_expected_improvement(
-                gp._x, gp._raw_y, candidates,
-                length_scale=gp.length_scale, noise=gp.noise,
-                signal_variance=gp.signal_variance,
-                best_y=best_y, xi=xi,
-            )
-            if ei is not None:
-                return ei
-        except Exception:
-            pass
+    if gp._x is not None:
+        ei = _native_call("gp_expected_improvement", gp, candidates,
+                          best_y=best_y, xi=xi)
+        if ei is not None:
+            return ei
     mu, sigma = gp.predict(candidates)
     imp = mu - best_y - xi
     z = imp / sigma
@@ -199,8 +195,12 @@ class BayesianOptimizer:
         self._ys.append(float(y))
 
     @property
+    def best_index(self) -> int:
+        return int(np.argmax(self._ys))
+
+    @property
     def best(self) -> Tuple[np.ndarray, float]:
-        i = int(np.argmax(self._ys))
+        i = self.best_index
         return self._xs[i], self._ys[i]
 
     @property

@@ -1,0 +1,221 @@
+"""Device-trace op profiling: capture + summarize XLA op time.
+
+Parity surface: the reference's per-op visibility comes from the
+Timeline (``horovod/common/timeline.cc`` — tensor lifecycle phases) and
+NVTX ranges for Nsight (``nvtx_op_range.cc``).  On TPU the equivalent
+ground truth is the XLA device trace ``jax.profiler`` writes; this
+module adds the missing half: turning the captured ``*.xplane.pb``
+into a per-op-kind time table WITHOUT TensorBoard (the usual
+``tensorboard_plugin_profile`` stack is protobuf-version-fragile and
+absent from many images).
+
+The xplane file is parsed with a minimal wire-format reader (~60
+lines): XSpace -> planes -> lines -> events plus the event-metadata
+table.  Only length-delimited fields and varints are needed.
+
+Typical use (exactly the loop used to find that the ResNet-50 step is
+34% BatchNorm column-reduces)::
+
+    from horovod_tpu.obs import profile
+    with profile.trace("/tmp/prof"):
+        step(...); jax.block_until_ready(...)
+    for row in profile.op_summary("/tmp/prof")[:10]:
+        print(row)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# --- minimal protobuf wire reader ------------------------------------
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, bytes]]:
+    """Yield (field_number, wire_type, payload) over a message buffer.
+    Varints yield their value encoded back as int in payload position;
+    64/32-bit fields yield raw bytes."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:          # varint
+            val, pos = _read_varint(buf, pos)
+            yield field, wt, val
+        elif wt == 2:        # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            yield field, wt, buf[pos:pos + ln]
+            pos += ln
+        elif wt == 1:        # 64-bit
+            yield field, wt, buf[pos:pos + 8]
+            pos += 8
+        elif wt == 5:        # 32-bit
+            yield field, wt, buf[pos:pos + 4]
+            pos += 4
+        else:  # pragma: no cover - groups unused by xplane
+            raise ValueError(f"unsupported wire type {wt}")
+
+
+# xplane.proto field numbers (tsl/profiler/protobuf/xplane.proto):
+# XSpace.planes=1; XPlane.name=2, .lines=3, .event_metadata=4 (map;
+# field 5 is the STAT metadata map — do not confuse the two);
+# XLine.events=4, .name=2; XEvent.metadata_id=1, .duration_ps=3;
+# XEventMetadata.id=1, .name=2, .display_name=4.
+
+
+def _parse_event_metadata(buf: bytes) -> Tuple[int, str]:
+    mid, name, display = 0, "", ""
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 0:
+            mid = v
+        elif f == 2 and wt == 2:
+            name = v.decode("utf-8", "replace")
+        elif f == 4 and wt == 2:
+            display = v.decode("utf-8", "replace")
+    return mid, (display or name)
+
+
+def _parse_plane(buf: bytes):
+    name = ""
+    lines: List[bytes] = []
+    emeta: Dict[int, str] = {}
+    for f, wt, v in _fields(buf):
+        if f == 2 and wt == 2:
+            name = v.decode("utf-8", "replace")
+        elif f == 3 and wt == 2:
+            lines.append(v)
+        elif f == 4 and wt == 2:
+            # map<int64, XEventMetadata> entry: key=1, value=2
+            meta_buf = None
+            for mf, mwt, mv in _fields(v):
+                if mf == 2 and mwt == 2:
+                    meta_buf = mv
+            if meta_buf is not None:
+                mid, mname = _parse_event_metadata(meta_buf)
+                emeta[mid] = mname
+    return name, lines, emeta
+
+
+def _parse_line(buf: bytes):
+    name = ""
+    events: List[bytes] = []
+    for f, wt, v in _fields(buf):
+        if f == 2 and wt == 2:
+            name = v.decode("utf-8", "replace")
+        elif f == 4 and wt == 2:
+            events.append(v)
+    return name, events
+
+
+def _parse_event(buf: bytes) -> Tuple[int, int]:
+    mid, dur_ps = 0, 0
+    for f, wt, v in _fields(buf):
+        if f == 1 and wt == 0:
+            mid = v
+        elif f == 3 and wt == 0:
+            dur_ps = v
+    return mid, dur_ps
+
+
+# --- public API --------------------------------------------------------
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a device trace (thin wrapper over jax.profiler.trace so
+    callers need only this module)."""
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield
+
+
+def _find_xplanes(logdir: str) -> List[str]:
+    return sorted(
+        glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                  recursive=True)
+    )
+
+
+def plane_names(logdir: str) -> List[str]:
+    """Names of all planes in the newest trace (debugging aid)."""
+    paths = _find_xplanes(logdir)
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {logdir}")
+    with open(paths[-1], "rb") as f:
+        space = f.read()
+    return [
+        _parse_plane(v)[0]
+        for f_no, wt, v in _fields(space)
+        if f_no == 1 and wt == 2
+    ]
+
+
+def op_summary(logdir: str, *, plane_substr: str = "/device:",
+               line_name: str = "XLA Ops",
+               group: bool = True) -> List[dict]:
+    """Aggregate per-op durations from the newest trace under logdir.
+
+    Returns rows ``{"op": kind, "total_ms": t, "count": c}`` sorted by
+    time, descending.  ``group=True`` buckets ops by fusion kind
+    (multiply_reduce_fusion.123 -> multiply_reduce_fusion), which is
+    the actionable granularity (e.g. BN stats reduces vs convs).
+    ``plane_substr`` selects planes — the default matches the TPU/GPU
+    device planes; CPU-only traces have host planes only (pass
+    ``plane_substr="/host:CPU"`` with an appropriate ``line_name``).
+    """
+    paths = _find_xplanes(logdir)
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {logdir}")
+    with open(paths[-1], "rb") as f:
+        space = f.read()
+
+    agg: Dict[str, List[float]] = {}
+    for f_no, wt, plane_buf in _fields(space):
+        if f_no != 1 or wt != 2:
+            continue
+        pname, lines, emeta = _parse_plane(plane_buf)
+        if plane_substr not in pname:
+            continue
+        for line_buf in lines:
+            lname, events = _parse_line(line_buf)
+            if lname != line_name:
+                continue
+            for ev in events:
+                mid, dur_ps = _parse_event(ev)
+                name = emeta.get(mid, f"op{mid}")
+                if name.startswith("while"):
+                    continue  # container; children are separate events
+                if group:
+                    name = re.sub(r"[.\d]+$", "", name.split(" = ")[0]
+                                  .lstrip("%"))
+                cell = agg.setdefault(name, [0.0, 0])
+                cell[0] += dur_ps / 1e9  # ps -> ms
+                cell[1] += 1
+    rows = [
+        {"op": k, "total_ms": round(v[0], 3), "count": v[1]}
+        for k, v in agg.items()
+    ]
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def device_time_ms(logdir: str, **kw) -> float:
+    """Total device busy time in the trace (sum over op rows)."""
+    return round(sum(r["total_ms"] for r in op_summary(logdir, **kw)), 3)

@@ -393,7 +393,7 @@ class TestNativeGaussianProcess:
         cand = rng.rand(64, d)
         return xs, ys, cand
 
-    def test_predict_matches_numpy_twin(self):
+    def test_predict_matches_numpy_twin(self, monkeypatch):
         if not ncore.available():
             pytest.skip("no native toolchain")
         from horovod_tpu.obs import gaussian_process as gpmod
@@ -405,16 +405,12 @@ class TestNativeGaussianProcess:
         mu_n, sig_n = out
         gp = gpmod.GaussianProcess(length_scale=0.3, noise=1e-4)
         gp.fit(xs, ys)
-        import os
-        os.environ["HVTPU_FORCE_PY_GP"] = "1"  # force the numpy twin
-        try:
-            mu_p, sig_p = gp.predict(cand)
-        finally:
-            del os.environ["HVTPU_FORCE_PY_GP"]
+        monkeypatch.setenv("HVTPU_FORCE_PY_GP", "1")  # numpy twin
+        mu_p, sig_p = gp.predict(cand)
         np.testing.assert_allclose(mu_n, mu_p, atol=1e-10)
         np.testing.assert_allclose(sig_n, sig_p, atol=1e-10)
 
-    def test_ei_matches_numpy_twin(self):
+    def test_ei_matches_numpy_twin(self, monkeypatch):
         if not ncore.available():
             pytest.skip("no native toolchain")
         from horovod_tpu.obs import gaussian_process as gpmod
@@ -427,29 +423,22 @@ class TestNativeGaussianProcess:
         assert ei_n is not None
         gp = gpmod.GaussianProcess(length_scale=0.3, noise=1e-4)
         gp.fit(xs, ys)
-        import os
-        os.environ["HVTPU_FORCE_PY_GP"] = "1"
-        try:
-            ei_p = gpmod.expected_improvement(gp, cand, float(ys.max()))
-        finally:
-            del os.environ["HVTPU_FORCE_PY_GP"]
+        monkeypatch.setenv("HVTPU_FORCE_PY_GP", "1")
+        ei_p = gpmod.expected_improvement(gp, cand, float(ys.max()))
         np.testing.assert_allclose(ei_n, ei_p, atol=1e-10)
 
-    def test_gp_predict_routes_native_by_default(self):
+    def test_gp_predict_routes_native_by_default(self, monkeypatch):
         if not ncore.available():
             pytest.skip("no native toolchain")
+        monkeypatch.delenv("HVTPU_FORCE_PY_GP", raising=False)
         from horovod_tpu.obs import gaussian_process as gpmod
 
         xs, ys, cand = self._data(seed=9)
         gp = gpmod.GaussianProcess(length_scale=0.3, noise=1e-4)
         gp.fit(xs, ys)
         mu_native, _ = gp.predict(cand)        # native route
-        import os
-        os.environ["HVTPU_FORCE_PY_GP"] = "1"
-        try:
-            mu_numpy, _ = gp.predict(cand)     # twin route
-        finally:
-            del os.environ["HVTPU_FORCE_PY_GP"]
+        monkeypatch.setenv("HVTPU_FORCE_PY_GP", "1")
+        mu_numpy, _ = gp.predict(cand)         # twin route
         np.testing.assert_allclose(mu_native, mu_numpy, atol=1e-10)
 
     def test_singular_gram_falls_back(self):
@@ -463,3 +452,18 @@ class TestNativeGaussianProcess:
                                length_scale=0.3, noise=0.0,
                                signal_variance=1.0)
         assert out is None
+
+    def test_shape_mismatch_raises(self):
+        if not ncore.available():
+            pytest.skip("no native toolchain")
+        xs, ys, _ = self._data()
+        with pytest.raises(ValueError, match="shape mismatch"):
+            ncore.gp_predict(xs, ys, np.zeros((4, xs.shape[1] + 1)),
+                             length_scale=0.3, noise=1e-4,
+                             signal_variance=1.0)
+        with pytest.raises(ValueError, match="shape mismatch"):
+            ncore.gp_expected_improvement(
+                xs, ys[:-1], np.zeros((4, xs.shape[1])),
+                length_scale=0.3, noise=1e-4, signal_variance=1.0,
+                best_y=0.0, xi=0.01,
+            )

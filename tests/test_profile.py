@@ -1,0 +1,105 @@
+"""obs/profile.py — xplane device-trace op summarizer.
+
+The wire-format parser is validated against a hand-encoded xplane
+buffer (exact bytes, no TF/protobuf dependency) and against a live
+jax.profiler capture (host plane on this CPU test platform; the
+device-plane path is the same code, validated on real TPU hardware in
+the perf work this module productizes).
+"""
+
+import os
+
+import pytest
+
+from horovod_tpu.obs import profile
+
+
+def _varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _vi(field: int, value: int) -> bytes:
+    return _varint(field << 3) + _varint(value)
+
+
+def _make_xplane(tmpdir) -> str:
+    # XEventMetadata {id:7, name:"multiply_reduce_fusion.3"}
+    meta = _vi(1, 7) + _ld(2, b"multiply_reduce_fusion.3")
+    meta_entry = _vi(1, 7) + _ld(2, meta)          # map key=1, value=2
+    meta2 = _vi(1, 8) + _ld(2, b"convolution.1")
+    meta2_entry = _vi(1, 8) + _ld(2, meta2)
+    # events: two of metadata 7 (1ms + 2ms), one of metadata 8 (5ms)
+    ev1 = _vi(1, 7) + _vi(3, int(1e9))
+    ev2 = _vi(1, 7) + _vi(3, int(2e9))
+    ev3 = _vi(1, 8) + _vi(3, int(5e9))
+    line = _ld(2, b"XLA Ops") + _ld(4, ev1) + _ld(4, ev2) + _ld(4, ev3)
+    plane = (_ld(2, b"/device:TPU:0") + _ld(3, line)
+             + _ld(4, meta_entry) + _ld(4, meta2_entry))
+    space = _ld(1, plane)
+    d = os.path.join(str(tmpdir), "plugins", "profile", "run1")
+    os.makedirs(d)
+    path = os.path.join(d, "host.xplane.pb")
+    with open(path, "wb") as f:
+        f.write(space)
+    return str(tmpdir)
+
+
+class TestParser:
+    def test_synthetic_xplane_summary(self, tmp_path):
+        logdir = _make_xplane(tmp_path)
+        rows = profile.op_summary(logdir)
+        assert rows == [
+            {"op": "convolution", "total_ms": 5.0, "count": 1},
+            {"op": "multiply_reduce_fusion", "total_ms": 3.0,
+             "count": 2},
+        ]
+        assert profile.device_time_ms(logdir) == 8.0
+
+    def test_ungrouped_keeps_instance_names(self, tmp_path):
+        logdir = _make_xplane(tmp_path)
+        rows = profile.op_summary(logdir, group=False)
+        names = {r["op"] for r in rows}
+        assert names == {"multiply_reduce_fusion.3", "convolution.1"}
+
+    def test_plane_names(self, tmp_path):
+        logdir = _make_xplane(tmp_path)
+        assert profile.plane_names(logdir) == ["/device:TPU:0"]
+
+    def test_missing_trace_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            profile.op_summary(str(tmp_path))
+
+
+class TestLiveCapture:
+    def test_capture_and_parse_host_plane(self, tmp_path, hvt):
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.ones((256, 256))
+
+        @jax.jit
+        def f(a):
+            return (a @ a).sum()
+
+        float(f(x))
+        with profile.trace(str(tmp_path)):
+            float(f(x))
+        # CPU traces carry host planes; the parser must read them
+        names = profile.plane_names(str(tmp_path))
+        assert any("/host:CPU" in n for n in names)
+        rows = profile.op_summary(
+            str(tmp_path), plane_substr="/host:CPU", line_name="python",
+            group=False,
+        )
+        assert isinstance(rows, list)
