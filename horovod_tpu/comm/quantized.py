@@ -46,8 +46,29 @@ def quantized_allreduce(tensor, *, axis_name: str, average: bool = False):
 
     The tensor is flattened and padded so each participant owns an
     equal chunk.  Returns fp32 (caller casts back).
+
+    ``HVTPU_QUANTIZED_RING=1`` routes through the Pallas per-hop
+    requantizing ring kernel instead (ops/ring.py — the EQuARX
+    algorithm proper, requantizing on every hop rather than once per
+    phase); only takes effect where the kernel can run (TPU, or the
+    interpreter in tests).
     """
+    import os
+
     n_ranks = lax.axis_size(axis_name)
+    if (os.environ.get("HVTPU_QUANTIZED_RING", "0") == "1"
+            and n_ranks > 1):
+        try:
+            # soft import: ring.py needs pallas importable; fall
+            # through to the XLA path anywhere it isn't
+            from ..ops.ring import _interpret_arg, ring_allreduce
+        except Exception:
+            ring_allreduce = None
+        if ring_allreduce is not None and _interpret_arg() is not None:
+            return ring_allreduce(
+                tensor, axis_name=axis_name, average=average,
+                quantized=True,
+            )
     orig_shape = tensor.shape
     orig_dtype = tensor.dtype
     flat = tensor.reshape(-1).astype(jnp.float32)

@@ -163,3 +163,40 @@ class TestFallbacks:
         want = np.asarray(x).sum(0)
         amax = np.abs(np.asarray(x)).max()
         assert np.abs(np.asarray(out) - want).max() <= 8 * 3 * amax / 127
+
+
+class TestEngineIntegration:
+    def test_int8_engine_path_routes_through_ring(self, monkeypatch):
+        """HVTPU_QUANTIZED_RING=1: spmd.allreduce with int8 compression
+        executes the per-hop requantizing ring kernel."""
+        monkeypatch.setenv("HVTPU_QUANTIZED_RING", "1")
+        from horovod_tpu.comm import spmd
+        from horovod_tpu.comm.compression import Compression
+        from horovod_tpu.comm.reduce_ops import ReduceOp
+        from horovod_tpu.ops import ring as ring_mod
+
+        # the XLA two-phase path would also satisfy the numeric bound,
+        # so additionally prove the ring kernel actually ran
+        calls = []
+        real = ring_mod.ring_allreduce
+        monkeypatch.setattr(
+            ring_mod, "ring_allreduce",
+            lambda *a, **kw: (calls.append(kw), real(*a, **kw))[1],
+        )
+
+        x = jnp.asarray(
+            np.random.RandomState(8).randn(8, 2048).astype(np.float32)
+        )
+        out = _run(
+            lambda xs: spmd.allreduce(
+                xs[0], axis_name=AXIS, op=ReduceOp.SUM,
+                compression=Compression.int8,
+            ),
+            x, out_specs=P(),
+        )
+        assert calls and calls[0].get("quantized") is True
+        want = np.asarray(x).sum(0)
+        err = np.abs(np.asarray(out) - want)
+        bound = 14 * np.abs(np.asarray(x)).sum(0).max() / 127
+        assert err.max() <= bound
+        assert err.mean() < 0.1
