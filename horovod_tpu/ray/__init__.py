@@ -1,24 +1,92 @@
-"""Ray integration hook (out of scope for the TPU build; SURVEY.md
-§7.3).  The reference's ``RayExecutor`` places ranks via Ray placement
-groups; TPU jobs are launched by ``hvtpurun`` / GKE instead.  The API
-hook is kept so code probing for it degrades clearly.
+"""Ray integration surface, local-mode functional.
+
+Parity surface: ``horovod.ray.RayExecutor`` (horovod/ray/runner.py) —
+``start()`` / ``run(fn)`` / ``run_remote``+``execute`` / ``shutdown``
+driving one Horovod rank per Ray worker.  Ray placement-group
+scheduling is out of scope for the TPU build (SURVEY.md §7.3: pods are
+launched by hvtpurun / the cluster scheduler); the same API is provided
+in **local mode**, launching ranks as local worker processes through
+the hvtpurun machinery — the reference's own CI exercises RayExecutor
+on a local Ray cluster the same way.
 """
 
 from __future__ import annotations
 
-_MSG = (
-    "horovod_tpu does not ship a Ray integration: TPU workers are "
-    "launched by hvtpurun (see horovod_tpu.runner) or your cluster "
-    "scheduler. The horovod.ray surface is documented out of scope in "
-    "SURVEY.md §7.3."
-)
+from typing import Any, Callable, Dict, List, Optional
 
 
-class RayExecutor:  # pragma: no cover - stub surface
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(_MSG)
+class RayExecutor:
+    """Local-mode executor with the reference's lifecycle shape.
+
+    >>> ex = RayExecutor(num_workers=2)
+    >>> ex.start()
+    >>> results = ex.run(train_fn, args=(cfg,))
+    >>> ex.shutdown()
+    """
+
+    def __init__(self, settings=None, *, num_workers: Optional[int] = None,
+                 num_hosts: Optional[int] = None,
+                 num_workers_per_host: Optional[int] = None,
+                 cpu_devices: Optional[int] = 1,
+                 env_vars: Optional[Dict[str, str]] = None,
+                 use_gpu: bool = False, cpus_per_worker: int = 1,
+                 gpus_per_worker: Optional[int] = None):
+        # reference world-size arithmetic: either num_workers directly
+        # or num_hosts x num_workers_per_host — silently running a
+        # different world size than asked would corrupt training
+        if num_workers is None and num_hosts is not None:
+            num_workers = num_hosts * (num_workers_per_host or 1)
+        elif (num_workers is not None and num_hosts is not None
+              and num_workers != num_hosts * (num_workers_per_host or 1)):
+            raise ValueError(
+                "specify num_workers OR num_hosts*num_workers_per_host, "
+                "not conflicting values of both"
+            )
+        self.num_workers = num_workers or 2
+        self.cpu_devices = cpu_devices
+        self.env_vars = env_vars
+        self._started = False
+
+    def start(self):
+        """No cluster to warm up in local mode; validates state."""
+        self._started = True
+
+    def run(self, fn: Callable, args: tuple = (),
+            kwargs: Optional[Dict[str, Any]] = None) -> List[Any]:
+        """Run ``fn`` on every rank, return per-rank results ordered by
+        rank (parity: RayExecutor.run)."""
+        if not self._started:
+            raise RuntimeError("RayExecutor.start() must be called first")
+        from .. import runner
+
+        return runner.run(
+            fn, args=args, kwargs=kwargs, np=self.num_workers,
+            cpu_devices=self.cpu_devices, env=self.env_vars,
+        )
+
+    # reference API aliases
+    def run_remote(self, fn: Callable, args: tuple = (),
+                   kwargs: Optional[Dict[str, Any]] = None):
+        """Local mode executes eagerly; returns the results list (the
+        reference returns Ray ObjectRefs to pass to ``execute``)."""
+        return self.run(fn, args=args, kwargs=kwargs)
+
+    def execute(self, fn_or_results):
+        """Reference shape: ``execute(fn)`` runs fn on every worker.
+        Also accepts the output of :meth:`run_remote` (already a
+        results list in local mode) and returns it unchanged."""
+        if callable(fn_or_results):
+            return self.run(fn_or_results)
+        return fn_or_results
+
+    def shutdown(self):
+        self._started = False
 
 
 class ElasticRayExecutor:  # pragma: no cover - stub surface
     def __init__(self, *args, **kwargs):
-        raise NotImplementedError(_MSG)
+        raise NotImplementedError(
+            "ElasticRayExecutor: elastic jobs are driven by hvtpurun "
+            "--host-discovery-script (see horovod_tpu.elastic); Ray "
+            "placement-group elasticity is out of scope (SURVEY.md §7.3)."
+        )

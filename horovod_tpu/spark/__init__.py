@@ -1,32 +1,72 @@
-"""Spark integration hook (out of scope for the TPU build; SURVEY.md
-§7.3).  The reference's ``horovod.spark.run(fn)`` launches ranks on
-Spark executors; TPU jobs are launched by ``hvtpurun`` / GKE instead.
-The API hook is kept so code probing for it degrades clearly.
+"""Spark integration surface, local-mode functional.
+
+Parity surface: ``horovod.spark.run(fn)`` (horovod/spark/__init__.py /
+runner.py) — run ``fn`` as one Horovod rank per Spark executor and
+return the per-rank results.  TPU pods are launched by ``hvtpurun`` /
+the cluster scheduler, so a Spark-executor placement backend is out of
+scope (SURVEY.md §7.3); what IS provided is the same API executed in
+**local mode**: ranks are launched as local worker processes through
+the hvtpurun machinery (the reference itself falls back to local-mode
+Spark in its tests — SURVEY §4's localhost-as-cluster pattern).
+
+The Estimator surface (KerasEstimator/TorchEstimator, Petastorm data
+paths) remains out of scope and raises with a pointer.
 """
 
 from __future__ import annotations
 
-_MSG = (
-    "horovod_tpu does not ship a Spark integration: TPU workers are "
-    "launched by hvtpurun (see horovod_tpu.runner) or your cluster "
-    "scheduler. The horovod.spark surface is documented out of scope "
-    "in SURVEY.md §7.3."
+from typing import Any, Callable, Dict, List, Optional
+
+_ESTIMATOR_MSG = (
+    "horovod_tpu does not ship Spark Estimators (Petastorm/Store data "
+    "paths are out of scope, SURVEY.md §7.3); use horovod_tpu.spark.run "
+    "for function-style jobs or hvtpurun for scripts."
 )
 
 
-def run(*args, **kwargs):
-    raise NotImplementedError(_MSG)
+def run(
+    fn: Callable,
+    args: tuple = (),
+    kwargs: Optional[Dict[str, Any]] = None,
+    num_proc: Optional[int] = None,
+    extra_mpi_args=None,        # accepted for source compat; unused
+    env: Optional[Dict[str, str]] = None,
+    start_timeout: Optional[float] = None,
+    verbose: int = 0,
+    cpu_devices: Optional[int] = 1,
+) -> List[Any]:
+    """Run ``fn`` on ``num_proc`` ranks and return per-rank results.
+
+    Local-mode execution via the hvtpurun launcher: same signature
+    shape and return convention as the reference's
+    ``horovod.spark.run`` (fn rides pickle to each rank; results come
+    back ordered by rank).  ``cpu_devices`` defaults to 1 XLA CPU
+    device per rank — pass None to let workers see the real
+    accelerator (single-host only).
+    """
+    from .. import runner
+
+    return runner.run(
+        fn, args=args, kwargs=kwargs, np=num_proc or 2,
+        cpu_devices=cpu_devices, env=env, verbose=bool(verbose),
+        start_timeout=start_timeout,
+    )
 
 
 def run_elastic(*args, **kwargs):
-    raise NotImplementedError(_MSG)
+    raise NotImplementedError(
+        "horovod_tpu.spark.run_elastic: elastic jobs are driven by "
+        "hvtpurun --host-discovery-script (see horovod_tpu.elastic); "
+        "a Spark-executor elastic backend is out of scope "
+        "(SURVEY.md §7.3)."
+    )
 
 
 class KerasEstimator:  # pragma: no cover - stub surface
     def __init__(self, *args, **kwargs):
-        raise NotImplementedError(_MSG)
+        raise NotImplementedError(_ESTIMATOR_MSG)
 
 
 class TorchEstimator:  # pragma: no cover - stub surface
     def __init__(self, *args, **kwargs):
-        raise NotImplementedError(_MSG)
+        raise NotImplementedError(_ESTIMATOR_MSG)

@@ -1,20 +1,68 @@
-"""Out-of-scope integration hooks (SURVEY.md §7.3): present, importable,
-and clearly refusing."""
+"""Cluster-integration surfaces (SURVEY.md §2.6): function-style APIs
+run in LOCAL MODE through the hvtpurun machinery (real worker
+processes, per-rank results — the reference's own localhost-as-cluster
+CI pattern); the Spark Estimator / Ray-placement pieces stay
+out-of-scope stubs that refuse clearly (§7.3)."""
 
 import pytest
 
 
-def test_spark_hook_refuses_clearly():
-    import horovod_tpu.spark as spark
+def _make_rank_size():
+    # nested closure: cloudpickle ships it by value, so workers don't
+    # need this test module importable (the test_multiprocess pattern)
+    def _rank_size():
+        import horovod_tpu as hvt
 
-    with pytest.raises(NotImplementedError, match="hvtpurun"):
-        spark.run(lambda: None)
-    with pytest.raises(NotImplementedError):
-        spark.TorchEstimator()
+        hvt.init()
+        return (hvt.rank(), hvt.size())
+
+    return _rank_size
 
 
-def test_ray_hook_refuses_clearly():
-    import horovod_tpu.ray as ray_mod
+class TestSparkLocalMode:
+    def test_run_executes_fn_per_rank(self):
+        import horovod_tpu.spark as spark
 
-    with pytest.raises(NotImplementedError, match="hvtpurun"):
-        ray_mod.RayExecutor()
+        results = spark.run(_make_rank_size(), num_proc=2)
+        assert results == [(0, 2), (1, 2)]
+
+    def test_estimators_refuse_clearly(self):
+        import horovod_tpu.spark as spark
+
+        with pytest.raises(NotImplementedError, match="out of scope"):
+            spark.TorchEstimator()
+        with pytest.raises(NotImplementedError, match="out of scope"):
+            spark.KerasEstimator()
+        with pytest.raises(NotImplementedError, match="hvtpurun"):
+            spark.run_elastic(lambda: None)
+
+
+class TestRayLocalMode:
+    def test_executor_lifecycle(self):
+        import horovod_tpu.ray as ray_mod
+
+        fn = _make_rank_size()
+        # reference world-size arithmetic honored
+        assert ray_mod.RayExecutor(num_hosts=2,
+                                   num_workers_per_host=4).num_workers == 8
+        with pytest.raises(ValueError, match="conflicting"):
+            ray_mod.RayExecutor(num_workers=3, num_hosts=2,
+                                num_workers_per_host=4)
+        ex = ray_mod.RayExecutor(num_workers=2)
+        with pytest.raises(RuntimeError, match="start"):
+            ex.run(fn)
+        ex.start()
+        results = ex.run(fn)
+        assert results == [(0, 2), (1, 2)]
+        assert ex.execute(ex.run_remote(fn)) == [(0, 2), (1, 2)]
+        # reference shape: execute(fn) runs it on every worker
+        assert ex.execute(fn) == [(0, 2), (1, 2)]
+        ex.shutdown()
+        with pytest.raises(RuntimeError):
+            ex.run(fn)
+
+    def test_elastic_refuses_clearly(self):
+        import horovod_tpu.ray as ray_mod
+
+        with pytest.raises(NotImplementedError, match="hvtpurun"):
+            ray_mod.ElasticRayExecutor()
