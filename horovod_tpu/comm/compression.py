@@ -18,22 +18,48 @@ from __future__ import annotations
 import itertools
 
 import jax.numpy as jnp
+from jax import lax
 
-# Per-process counter for stochastic-rounding seeds: combined with the
-# rank, every (rank, call) pair gets distinct PRNG noise — identical
-# seeds across ranks would correlate the rounding errors and defeat the
-# cancellation-over-ranks property stochastic rounding exists for.
-_STOCH_SEED_COUNTER = itertools.count()
+_STOCH_CALL_COUNTER = itertools.count()
 
 
-def _stochastic_seed() -> int:
+def _rank_salt() -> int:
     try:
         from ..core import state as _core_state
 
         rank = _core_state.global_state().rank if _core_state.initialized() else 0
     except Exception:  # pragma: no cover - state not importable
         rank = 0
-    return (rank * 1_000_003 + next(_STOCH_SEED_COUNTER)) & 0x7FFFFFFF
+    return (rank * 1_000_003) & 0x7FFFFFFF
+
+
+def _stochastic_seed(flat):
+    """Stochastic-rounding seed: a TRACED fold of the payload bits,
+    salted by the process rank and a per-call counter.
+
+    The payload fold must be traced — a Python-side value alone is
+    evaluated once at trace time and bakes into the compiled program,
+    giving identical dither every step.  The fold reads the payload in
+    its NATIVE width (bitcast, no f32 astype) so seed derivation never
+    materializes a widened copy of a bf16/f16 buffer in HBM.  The
+    per-call counter varies eager-path calls even for byte-identical
+    payloads; under jit it is a baked constant, so a payload that
+    repeats exactly across steps repeats its dither — callers needing
+    per-step variation for constant payloads must vary the payload or
+    use the allreduce-wire path (comm/quantized.py), which folds the
+    collective's rank index.  The rank salt decorrelates
+    multi-controller processes; in single-controller shard_map it is
+    the same on every shard, so identical payloads on two shards dither
+    identically (the wire path again decorrelates by axis_index)."""
+    if flat.dtype.itemsize == 2:
+        bits = lax.bitcast_convert_type(flat, jnp.int16).astype(jnp.int32)
+    elif flat.dtype == jnp.float32:
+        bits = lax.bitcast_convert_type(flat, jnp.int32)
+    else:
+        bits = lax.bitcast_convert_type(
+            flat.astype(jnp.float32), jnp.int32)
+    salt = (_rank_salt() ^ (next(_STOCH_CALL_COUNTER) * 0x9E3779B1)) & 0x7FFFFFFF
+    return jnp.sum(bits, dtype=jnp.int32) ^ jnp.int32(salt)
 
 
 class Compressor:
@@ -132,10 +158,11 @@ class Int8Compressor(Compressor):
         # to this class's (nblocks, BLOCK=1024) wire format.
         from ..ops import quantize_int8_blocks
 
+        flat = tensor.reshape(-1)
         q, scale, n = quantize_int8_blocks(
-            tensor.reshape(-1),
+            flat,
             stochastic=cls.STOCHASTIC,
-            seed=_stochastic_seed() if cls.STOCHASTIC else 0,
+            seed=_stochastic_seed(flat) if cls.STOCHASTIC else 0,
         )
         q = q.reshape(-1, Int8Compressor.BLOCK)
         return q, (orig_dtype, orig_shape, n, scale)

@@ -22,14 +22,20 @@ matching the paper's error model.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 BLOCK = 512
 
 
-def _quantize(x):
-    """x: (..., k) fp32 → int8 codes + fp32 per-block scales."""
+def _quantize(x, *, key=None):
+    """x: (..., k) fp32 → int8 codes + fp32 per-block scales.
+
+    ``key`` (a jax PRNG key) switches to stochastic rounding:
+    ``floor(x/s + u)``, u ~ U[0,1), which is unbiased (E[q·s] = x) so
+    quantisation noise cancels instead of accumulating when the codes
+    feed a summation across ranks."""
     n = x.shape[-1]
     pad = (-n) % BLOCK
     if pad:
@@ -37,27 +43,51 @@ def _quantize(x):
     blocks = x.reshape(x.shape[:-1] + (-1, BLOCK))
     scale = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True) / 127.0
     safe = jnp.where(scale == 0, 1.0, scale)
-    q = jnp.clip(jnp.round(blocks / safe), -127, 127).astype(jnp.int8)
+    scaled = blocks / safe
+    if key is not None:
+        u = jax.random.uniform(key, scaled.shape, jnp.float32)
+        q = jnp.clip(jnp.floor(scaled + u), -127, 127).astype(jnp.int8)
+    else:
+        q = jnp.clip(jnp.round(scaled), -127, 127).astype(jnp.int8)
     return q, scale.astype(jnp.float32)
 
 
-def quantized_allreduce(tensor, *, axis_name: str, average: bool = False):
+def _dither_key(flat, axis_name):
+    """Traced PRNG key for stochastic rounding: folds the rank index
+    (decorrelates dither across ranks — the property cross-rank error
+    cancellation needs) and a fold of the payload bits (varies the
+    dither per step under jit, where a Python-level seed would bake
+    into the compiled program as a constant)."""
+    bits = lax.bitcast_convert_type(flat.astype(jnp.float32), jnp.int32)
+    key = jax.random.fold_in(jax.random.key(0x51DE), lax.axis_index(axis_name))
+    return jax.random.fold_in(key, jnp.sum(bits).astype(jnp.uint32))
+
+
+def quantized_allreduce(tensor, *, axis_name: str, average: bool = False,
+                        stochastic: bool = False):
     """int8-wire allreduce of a float tensor inside shard_map/jit.
 
     The tensor is flattened and padded so each participant owns an
     equal chunk.  Returns fp32 (caller casts back).
 
+    ``stochastic=True`` rounds with a traced per-(rank, payload) PRNG
+    key (see :func:`_dither_key`) so the per-rank quantisation errors
+    are independent and cancel ~√N-style in the phase-1 summation
+    instead of adding coherently — the error model EQuARX assumes.
+
     ``HVTPU_QUANTIZED_RING=1`` routes through the Pallas per-hop
     requantizing ring kernel instead (ops/ring.py — the EQuARX
     algorithm proper, requantizing on every hop rather than once per
     phase); only takes effect where the kernel can run (TPU, or the
-    interpreter in tests).
+    interpreter in tests).  The ring kernel rounds deterministically,
+    so ``stochastic=True`` keeps the XLA path — the documented
+    unbiased-dither semantics win over the ring opt-in.
     """
     import os
 
     n_ranks = lax.axis_size(axis_name)
     if (os.environ.get("HVTPU_QUANTIZED_RING", "0") == "1"
-            and n_ranks > 1):
+            and n_ranks > 1 and not stochastic):
         try:
             # soft import: ring.py needs pallas importable; fall
             # through to the XLA path anywhere it isn't
@@ -79,8 +109,13 @@ def quantized_allreduce(tensor, *, axis_name: str, average: bool = False):
         flat = jnp.pad(flat, (0, pad))
     chunks = flat.reshape(n_ranks, chunk)
 
-    # Phase 1: reduce-scatter with int8 wire.
-    q, scale = _quantize(chunks)               # (N, chunk/B, B) int8 + scales
+    key = _dither_key(flat, axis_name) if stochastic else None
+
+    # Phase 1: reduce-scatter with int8 wire.  Stochastic rounding
+    # matters HERE: the N dequantized contributions are summed, so
+    # independent per-rank dither cancels while deterministic rounding
+    # bias adds coherently.
+    q, scale = _quantize(chunks, key=key)      # (N, chunk/B, B) int8 + scales
     q_recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
                             tiled=True)
     s_recv = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
@@ -89,10 +124,19 @@ def quantized_allreduce(tensor, *, axis_name: str, average: bool = False):
     deq = q_recv.astype(jnp.float32) * s_recv
     reduced = jnp.sum(deq, axis=0)             # (chunk/B, B) fp32
 
-    # Phase 2: allgather with int8 wire.
+    # Phase 2: allgather with int8 wire.  (Stochastic rounding here
+    # keeps the result unbiased over steps; the error is common to all
+    # ranks either way since each chunk is quantized once by its owner.)
     scale2 = jnp.max(jnp.abs(reduced), axis=-1, keepdims=True) / 127.0
     safe2 = jnp.where(scale2 == 0, 1.0, scale2)
-    q2 = jnp.clip(jnp.round(reduced / safe2), -127, 127).astype(jnp.int8)
+    scaled2 = reduced / safe2
+    if key is not None:
+        u2 = jax.random.uniform(
+            jax.random.fold_in(key, 1), scaled2.shape, jnp.float32
+        )
+        q2 = jnp.clip(jnp.floor(scaled2 + u2), -127, 127).astype(jnp.int8)
+    else:
+        q2 = jnp.clip(jnp.round(scaled2), -127, 127).astype(jnp.int8)
     q_all = lax.all_gather(q2, axis_name)      # (N, chunk/B, B)
     s_all = lax.all_gather(scale2.astype(jnp.float32), axis_name)
     deq_all = (q_all.astype(jnp.float32) * s_all).reshape(n_ranks, -1)

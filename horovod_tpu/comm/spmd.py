@@ -55,6 +55,12 @@ def _is_int8(compression) -> bool:
     )
 
 
+def _is_stochastic_int8(compression) -> bool:
+    return _is_int8(compression) and bool(
+        getattr(compression, "STOCHASTIC", False)
+    )
+
+
 def _require_equal_groups(groups, op_name: str):
     """XLA requires equal-size replica groups for gather/scatter-shaped
     collectives; ProcessSet.device_groups() can produce unequal groups
@@ -123,6 +129,7 @@ def allreduce(
             out = quantized_allreduce(
                 tensor, axis_name=axis_name,
                 average=(rop == ReduceOp.AVERAGE),
+                stochastic=_is_stochastic_int8(compression),
             ).astype(tensor.dtype)
         else:
             wire, ctx = compression.compress(tensor)

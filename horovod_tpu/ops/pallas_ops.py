@@ -228,7 +228,7 @@ def _quantize_xla(flat):
 
 
 def quantize_int8_blocks(flat, *, stochastic: bool = False,
-                         seed: int = 0):
+                         seed=0):
     """Block-absmax int8 quantisation of a flat f32/bf16 buffer.
 
     Returns ``(codes, scales, n)``: codes ``(rows, 128) int8`` (rows a
@@ -258,7 +258,8 @@ def quantize_int8_blocks(flat, *, stochastic: bool = False,
 
     def call(x_part, part_rows, tile, seed_val):
         g_per_tile = tile // _QROWS
-        seed_arr = jnp.asarray([seed_val], jnp.int32)
+        # seed_val may be a traced scalar (see compression._stochastic_seed)
+        seed_arr = jnp.asarray(seed_val, jnp.int32).reshape(1)
         return pl.pallas_call(
             functools.partial(_quantize_kernel, stochastic=stochastic,
                               tile=tile),

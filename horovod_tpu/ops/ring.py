@@ -9,11 +9,15 @@ express:
 
 * ``ring_allreduce(..., quantized=True)`` — the true EQuARX design
   (PAPERS.md, arXiv:2506.17615): int8 codes + per-block scales cross
-  the wire on EVERY hop, with dequantize → f32 accumulate → requantize
-  at each stage.  The XLA-level approximation in comm/quantized.py
-  must round-trip through ``all_to_all``/``all_gather``; here the
-  quantize lives inside the transfer loop, which is the actual paper
-  algorithm (1 B/elt wire on all 2(N-1) hops).
+  the wire on EVERY hop.  Reduce-scatter hops dequantize → f32
+  accumulate → requantize (values change per hop); all-gather hops
+  relay each owner's codes VERBATIM (store-and-forward), so every
+  rank dequantizes identical bytes and the output is bit-equal across
+  ranks — the allreduce contract.  The XLA-level approximation in
+  comm/quantized.py must round-trip through ``all_to_all``/
+  ``all_gather``; here the quantize lives inside the transfer loop,
+  which is the actual paper algorithm (1 B/elt wire on all 2(N-1)
+  hops).
 * A reference implementation of the ring protocol itself (double
   buffering, per-slot DMA semaphore accounting) that the multi-chip
   dry-run exercises in the Pallas TPU interpreter — the same role the
@@ -139,6 +143,9 @@ def ring_allgather_2d(local, *, axis_name: str):
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
+        # distinct collective_id per kernel entry point: concurrent
+        # collective kernels sharing a barrier semaphore is documented
+        # as a correctness hazard (allgather=0, allreduce=1, quant=2)
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=0
         ),
@@ -278,11 +285,15 @@ def _quantized_allreduce_kernel(x_ref, out_ref, qcomm_ref, scomm_ref,
     left = lax.rem(my_id - 1 + n, n)
     ch = x_ref.shape[0] // n
 
-    def send_hop(i, value, base):
-        """Quantize ``value``, RDMA codes+scales to the right neighbor,
-        return the dequantized incoming block.  ``base`` selects the
-        phase's disjoint slot pair (see _allreduce_kernel: phases must
-        not share in-flight buffers/semaphores)."""
+    def transfer_hop(i, base):
+        """One double-buffered ring hop of the codes+scales pair that
+        currently sit in slot ``base + i%2``: ACK-backpressured dual
+        RDMA to the right neighbor's slot ``base + (i+1)%2``, then the
+        freed-slot ACK to the left.  The semaphore protocol lives ONLY
+        here — both phases (and any future one) share it.  ``base``
+        selects the phase's disjoint slot pair (see _allreduce_kernel:
+        phases must not share in-flight buffers/semaphores).  Returns
+        the recv slot index."""
         send_slot = base + lax.rem(i, 2)
         recv_slot = base + lax.rem(i + 1, 2)
         dst = lax.rem(my_id + 1, n)
@@ -292,9 +303,6 @@ def _quantized_allreduce_kernel(x_ref, out_ref, qcomm_ref, scomm_ref,
         def _():
             pltpu.semaphore_wait(ack_sem.at[recv_slot], 1)
 
-        q, s = _quantize_block(value)
-        qcomm_ref[send_slot] = q
-        scomm_ref[send_slot] = s
         rdma_q = pltpu.make_async_remote_copy(
             src_ref=qcomm_ref.at[send_slot],
             dst_ref=qcomm_ref.at[recv_slot],
@@ -323,6 +331,16 @@ def _quantized_allreduce_kernel(x_ref, out_ref, qcomm_ref, scomm_ref,
                 device_id_type=pltpu.DeviceIdType.LOGICAL,
             )
 
+        return recv_slot
+
+    def send_hop(i, value, base):
+        """Quantize ``value`` into the send slot, run a transfer hop,
+        return the dequantized incoming block."""
+        send_slot = base + lax.rem(i, 2)
+        q, s = _quantize_block(value)
+        qcomm_ref[send_slot] = q
+        scomm_ref[send_slot] = s
+        recv_slot = transfer_hop(i, base)
         return _dequantize_block(qcomm_ref[recv_slot], scomm_ref[recv_slot])
 
     # ---- phase 1: reduce-scatter with per-hop requantization -------
@@ -336,16 +354,30 @@ def _quantized_allreduce_kernel(x_ref, out_ref, qcomm_ref, scomm_ref,
 
     lax.fori_loop(0, n - 1, rs_step, 0)
 
+    # ---- phase 2: all-gather, store-and-forward --------------------
+    # The reduced chunk values do NOT change in this phase, so each
+    # chunk is quantized exactly ONCE (by its owner) and the int8
+    # codes + scales are relayed VERBATIM around the ring.  Every rank
+    # therefore dequantizes identical bytes — the output is bit-equal
+    # on all ranks (the allreduce contract) and the quantization error
+    # does not grow with ring distance.  The owner likewise keeps the
+    # dequantized form of the codes it put on the wire, not its raw
+    # f32 accumulator.
     owned = lax.rem(my_id + 1, n)
-    out_ref[pl.ds(owned * ch, ch), :] = acc_ref[:]
+    q0, s0 = _quantize_block(acc_ref[:])
+    qcomm_ref[2] = q0
+    scomm_ref[2] = s0
+    out_ref[pl.ds(owned * ch, ch), :] = _dequantize_block(q0, s0)
 
-    # ---- phase 2: all-gather, still int8 on the wire ---------------
     def ag_step(i, _):
         src_dev = lax.rem(my_id - i - 1 + 2 * n, n)
         src_chunk = lax.rem(src_dev + 1, n)
-        incoming = send_hop(i, acc_ref[:], 2)
-        acc_ref[:] = incoming
-        out_ref[pl.ds(src_chunk * ch, ch), :] = incoming
+        # relay only — no quantize: received codes land in recv_slot
+        # == next step's send_slot, so they are forwarded verbatim
+        recv_slot = transfer_hop(i, 2)
+        out_ref[pl.ds(src_chunk * ch, ch), :] = _dequantize_block(
+            qcomm_ref[recv_slot], scomm_ref[recv_slot]
+        )
         return 0
 
     lax.fori_loop(0, n - 1, ag_step, 0)
@@ -387,7 +419,7 @@ def _ring_allreduce_2d(x2, *, axis_name: str, quantized: bool):
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=0
+            has_side_effects=True, collective_id=2 if quantized else 1
         ),
         interpret=interp,
     )(x2)

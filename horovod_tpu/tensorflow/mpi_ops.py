@@ -75,7 +75,10 @@ def _to_engine(t):
     (fallback: numpy).  float64 stays on the numpy path so jax's x64
     truncation semantics match the torch adapter."""
     if isinstance(t, tf.Variable):
-        t = t.value()
+        # snapshot: variable.assign would mutate the underlying buffer
+        # in place while JAX treats the DLPack-imported array as
+        # immutable — zero-copy stays reserved for plain eager tensors
+        t = tf.identity(t.value())
     if isinstance(t, tf.Tensor):
         if t.dtype == tf.float64:
             return t.numpy()

@@ -133,3 +133,98 @@ class TestCompressionRoundtrip:
         assert Compression.from_name("fp16") is Compression.fp16
         with pytest.raises(ValueError):
             Compression.from_name("zstd")
+
+
+class TestStochasticInt8Wire:
+    """The int8_stochastic compressor must actually dither the
+    allreduce wire (regression: spmd routed every int8 compressor to
+    the deterministic quantized path, leaving stochastic inert) with a
+    TRACED per-(rank, payload) key (regression: a Python-side seed
+    baked into the jit program at trace time — same dither every step
+    and on every rank)."""
+
+    def _allreduce(self, x, compression):
+        from horovod_tpu.comm import spmd
+
+        def body(xs):
+            return spmd.allreduce(
+                xs[0], axis_name=AXIS, op=ReduceOp.SUM,
+                compression=compression,
+            )
+
+        return jax.jit(
+            jax.shard_map(
+                body, mesh=mesh8(), in_specs=(P(AXIS),), out_specs=P(),
+                check_vma=False,
+            )
+        )(x)
+
+    def test_dither_decorrelates_identical_ranks(self):
+        # The phase-1 mechanics in isolation (the end-to-end error is
+        # dominated by the phase-2 requantization of the 8x-larger
+        # reduced values, which is common to both rounding modes):
+        # 8 ranks quantizing IDENTICAL data deterministically produce
+        # bit-equal errors, so the summed error is 8x the per-rank
+        # error (mean ~ 2*scale); independent per-rank dither is
+        # unbiased and cancels ~sqrt(8)-style (mean ~ 0.9*scale).
+        from horovod_tpu.comm.quantized import _quantize
+
+        rng = np.random.RandomState(21)
+        row = rng.randn(1, 8192).astype(np.float32)
+        want = row[0] * 8.0
+
+        def summed(keys):
+            total = np.zeros(8192, np.float64)
+            for r in range(8):
+                q, s = _quantize(jnp.asarray(row),
+                                 key=None if keys is None else keys[r])
+                deq = (np.asarray(q, np.float64)
+                       * np.asarray(s, np.float64)).reshape(-1)
+                total += deq
+            return total
+
+        det = np.abs(summed(None) - want).mean()
+        keys = [jax.random.fold_in(jax.random.key(7), r) for r in range(8)]
+        stoch = np.abs(summed(keys) - want).mean()
+        assert stoch < 0.7 * det, (stoch, det)
+
+    def test_stochastic_error_bound(self):
+        rng = np.random.RandomState(22)
+        x = jnp.asarray(rng.randn(8, 4096).astype(np.float32))
+        out = np.asarray(self._allreduce(x, Compression.int8_stochastic))
+        want = np.asarray(x).sum(0)
+        # floor(x+u) errors are <= 1 scale-unit per rank per phase
+        amax = np.abs(np.asarray(x)).max()
+        assert np.abs(out - want).max() <= (8 + 1) * 2 * amax / 127
+
+    def test_dither_varies_with_payload(self):
+        # the traced key folds the payload bits, so two different
+        # inputs see different dither patterns under ONE jit trace
+        rng = np.random.RandomState(23)
+        a = rng.randn(8, 2048).astype(np.float32)
+        b = a + np.float32(1e-6)
+        err_a = np.asarray(self._allreduce(jnp.asarray(a),
+                                           Compression.int8_stochastic))
+        err_b = np.asarray(self._allreduce(jnp.asarray(b),
+                                           Compression.int8_stochastic))
+        # same values to fp32-block-scale precision, different dither
+        assert not np.array_equal(err_a, err_b)
+
+    def test_stochastic_skips_ring_kernel(self, monkeypatch):
+        # HVTPU_QUANTIZED_RING routes int8 through the deterministic
+        # per-hop ring; the stochastic compressor must keep the XLA
+        # dithered path (documented semantics win over the ring opt-in)
+        monkeypatch.setenv("HVTPU_QUANTIZED_RING", "1")
+        monkeypatch.setenv("HVTPU_PALLAS_INTERPRET", "1")
+        from horovod_tpu.ops import ring as ring_mod
+
+        calls = []
+        real = ring_mod.ring_allreduce
+        monkeypatch.setattr(
+            ring_mod, "ring_allreduce",
+            lambda *a, **kw: (calls.append(kw), real(*a, **kw))[1],
+        )
+        rng = np.random.RandomState(24)
+        x = jnp.asarray(rng.randn(8, 2048).astype(np.float32))
+        self._allreduce(x, Compression.int8_stochastic)
+        assert not calls

@@ -121,6 +121,27 @@ class TestRingAllreduce:
         # and it must be far better than not reducing at all
         assert err.mean() < 0.1
 
+    def test_quantized_identical_on_every_rank(self):
+        """The allreduce contract: every rank must hold bit-identical
+        output.  Regression for the per-hop-requantizing all-gather,
+        where the chunk owner kept its raw f32 accumulator while peers
+        got quantize round-trips that drifted with ring distance —
+        replica parameters silently diverged in DP training."""
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(8, 4096).astype(np.float32))
+        # collect each rank's full output instead of letting shard_map
+        # assume replication
+        per_rank = _run(
+            lambda xs: ring_allreduce(
+                xs[0], axis_name=AXIS, quantized=True
+            )[None],
+            x, out_specs=P(AXIS),
+        )
+        got = np.asarray(per_rank)
+        assert got.shape[0] == 8
+        for r in range(1, 8):
+            np.testing.assert_array_equal(got[0], got[r])
+
 
 class TestRingAllgather:
     def test_matches_all_gather(self):
