@@ -53,7 +53,27 @@ from .version import __version__
 # ---------------------------------------------------------------------------
 
 def init(config: Optional[Config] = None):
-    """Initialize horovod_tpu (idempotent)."""
+    """Initialize horovod_tpu (idempotent).
+
+    Rank ↔ process ↔ device model (pod shape):
+
+    * One **Horovod rank = one process**: ``rank()``/``size()`` count
+      processes, exactly like the reference (``hvd.rank/size``).  A
+      process may own **several accelerator devices** (the usual TPU
+      pod shape: P hosts × D chips each).
+    * The **jit/SPMD path** (``world_mesh()`` + ``shard_map`` +
+      ``DistributedOptimizer(axis_name=...)``) spans ALL
+      ``jax.device_count()`` devices — the same jitted program runs on
+      every process and XLA executes per-host partitions over the
+      global mesh.  This is the flagship path and uses every chip.
+    * The **eager path** (``allreduce``/``allgather``/... on concrete
+      arrays) is PROCESS-granularity: each process contributes one
+      tensor, carried on its designated transport device (the first
+      local device).  With D>1 local devices the other devices are
+      simply not participants of eager collectives — they are the
+      jit path's compute surface, not extra eager ranks.  ``init()``
+      logs this at INFO when it detects D>1.
+    """
     return _state.init(config)
 
 

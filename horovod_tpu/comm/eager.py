@@ -23,6 +23,14 @@ before they reach this layer.
 Single-process mode (P == 1, any number of local devices) degenerates to
 local math, so the same user program runs unmodified from a laptop to a
 pod — collectives over local devices belong to the SPMD layer instead.
+
+Pod shape (P > 1, D > 1 local devices): the eager data plane stays
+process-granularity — rank = process, and each process's contribution
+rides its FIRST local device (``Topology.proc_mesh``); the remaining
+local devices are deliberately not eager participants, they are the
+jit/SPMD path's compute surface (``world_mesh`` spans all P×D devices).
+``init()`` logs this at INFO so a D>1 profile of an eager-only program
+reads as designed behavior, not a bug.
 """
 
 from __future__ import annotations

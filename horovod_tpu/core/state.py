@@ -91,9 +91,9 @@ def init(config: Optional[Config] = None) -> GlobalState:
             "info": _logging.INFO, "warning": _logging.WARNING,
             "error": _logging.ERROR, "fatal": _logging.CRITICAL,
         }
-        _logging.getLogger("horovod_tpu").setLevel(
-            _LEVELS.get(str(cfg.log_level).lower(), _logging.WARNING)
-        )
+        _logger = _logging.getLogger("horovod_tpu")
+        _level = _LEVELS.get(str(cfg.log_level).lower(), _logging.WARNING)
+        _logger.setLevel(_level)
 
         # Elastic worker: install the driver-notification (SIGUSR1)
         # handler BEFORE the (potentially long) rendezvous below, so a
@@ -139,6 +139,23 @@ def init(config: Optional[Config] = None) -> GlobalState:
         _state.config = cfg
         _state.rank = jax.process_index()
         _state.size = jax.process_count()
+
+        # Below-WARNING levels need a real handler: Python's lastResort
+        # handler only emits WARNING+, so an explicit HVTPU_LOG_LEVEL of
+        # info/debug would otherwise be silently inert (the reference's
+        # LOG() always writes to stderr when HOROVOD_LOG_LEVEL allows).
+        # Runs AFTER rank resolution so the label is the true rank even
+        # when the user initialized jax.distributed themselves, and only
+        # when the app has configured no logging of its own anywhere on
+        # the hierarchy (hasHandlers walks ancestors) — an app-routed
+        # sink keeps receiving hvtpu records via normal propagation.
+        if _level < _logging.WARNING and not _logger.hasHandlers():
+            _h = _logging.StreamHandler()
+            _h.setFormatter(_logging.Formatter(
+                f"[hvtpu rank {_state.rank}] %(levelname)s %(message)s"
+            ))
+            _logger.addHandler(_h)
+            _logger.propagate = False
         # local/cross topology comes from the launcher when present;
         # single-host default is local == world.
         if cfg.size > 1:
@@ -156,6 +173,25 @@ def init(config: Optional[Config] = None) -> GlobalState:
         _state.process_set_table = ProcessSetTable(
             _state.topology, _state.size
         )
+
+        # Pod shape (P processes x D>1 local devices): say the quiet
+        # part out loud — eager collectives are process-granularity
+        # (one rank = one process, contribution on the first local
+        # device); the other local devices serve the jit/SPMD path
+        # over world_mesh().  Without this note a user could read
+        # "2 of 8 devices active" off a profile of an eager-only
+        # program and suspect a bug.
+        if _state.size > 1:
+            n_local = _state.topology.num_local_devices
+            if n_local > 1:
+                _logging.getLogger("horovod_tpu").info(
+                    "pod shape: %d processes x %d local devices; eager "
+                    "collectives run at process granularity (rank = "
+                    "process, transport device = first local device); "
+                    "use the jit/SPMD path (world_mesh + shard_map) to "
+                    "engage all %d devices",
+                    _state.size, n_local, _state.size * n_local,
+                )
 
         if cfg.timeline_filename:
             from ..obs.timeline import Timeline
