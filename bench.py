@@ -163,10 +163,16 @@ def main():
                     "TPU-fast BatchNorm (flattened 2-D stats, bf16 "
                     "normalize pass). HBM-bandwidth-bound: profiled "
                     "step is 34% BN stats/grad column-reduces, 25% "
-                    "BN/ReLU elementwise, 24% convs, i.e. ~96% of the "
-                    "77 GB/step roofline at 819 GB/s (2723 img/s "
-                    "ceiling); batch 512, remat, s2d stem, 64 "
-                    "steps/dispatch all measured <=0 gain"
+                    "BN/ReLU elementwise, 24% convs, 12% residual "
+                    "adds, i.e. ~96% of the 77 GB/step roofline at "
+                    "829 GB/s (2720 img/s ceiling). Round-3 kernel "
+                    "audit (docs/benchmarks.md): Pallas data-plane "
+                    "kernels measure 105-237 GB/s vs XLA's 829 on "
+                    "this stack, MXU dot-stats ties the fused reduce "
+                    "by construction — fused conv+BN byte removal is "
+                    "the only lever left and sits inside XLA's conv. "
+                    "Batch 512, remat, s2d stem, 64 steps/dispatch, "
+                    "standalone Pallas BN all measured <=0 gain"
                 ),
             }
         )
