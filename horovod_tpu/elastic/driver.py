@@ -203,9 +203,14 @@ class ElasticDriver:
                 # collectives. Tell them to reset at the commit
                 # boundary, then escalate to SIGTERM.
                 return self._finish_incarnation(workers, slots, crashed)
-            # 2. poll discovery for membership changes
+            # 2. poll discovery for membership changes.  Compare the
+            # EFFECTIVE world (capped at max_np) to the running one —
+            # comparing raw discovered slots would restart-thrash
+            # forever when discovery grows past --max-np.
             if self._refresh_hosts() and not notified:
                 cur = self.hosts.available_slots()
+                if self.max_np is not None:
+                    cur = min(cur, self.max_np)
                 if cur != len(slots) and cur >= 1:
                     self._notify_hosts_updated(workers)
                     notified = True

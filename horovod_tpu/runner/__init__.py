@@ -39,13 +39,18 @@ class RunError(RuntimeError):
         self.worker_traceback = worker_traceback
 
 
-def _dump_fn(fn: Callable, args, kwargs, path: str):
+def _dump_fn(fn: Callable, args, kwargs, path: str, key: str):
+    """Pickle + HMAC-sign the function blob (parity: secret.py-signed
+    service messages; workers refuse unsigned/tampered payloads)."""
+    from . import secret
+
     try:
         import cloudpickle as pickler
     except ImportError:  # pragma: no cover - cloudpickle is available
         import pickle as pickler
+    blob = pickler.dumps((fn, tuple(args), dict(kwargs or {})))
     with open(path, "wb") as f:
-        f.write(pickler.dumps((fn, tuple(args), dict(kwargs or {}))))
+        f.write(secret.sign(key, blob))
 
 
 def run(
@@ -75,12 +80,14 @@ def run(
     (parity: horovod.run's start_timeout), not job duration.
     """
     from . import launch as launch_mod
+    from . import secret
 
+    job_key = secret.make_secret_key()
     with tempfile.TemporaryDirectory(prefix="hvtpurun_") as tmp:
         fn_path = os.path.join(tmp, "fn.pkl")
         out_dir = os.path.join(tmp, "results")
         os.makedirs(out_dir)
-        _dump_fn(fn, args, kwargs, fn_path)
+        _dump_fn(fn, args, kwargs, fn_path, job_key)
         argv = ["-np", str(np)]
         if cpu_devices is not None:
             argv += ["--cpu-devices", str(cpu_devices)]
@@ -96,6 +103,14 @@ def run(
         ns = launch_mod.parse_args(argv)
         base_env = dict(os.environ)
         base_env.update(env or {})
+        # key travels by 0600 file, not env value: the ssh path
+        # serializes the worker env into world-readable argv (the
+        # fn/result channel already requires a shared filesystem, so
+        # the key file rides the same one)
+        key_path = os.path.join(tmp, "job.key")
+        secret.write_key_file(job_key, key_path)
+        base_env[secret.ENV_KEY_FILE] = key_path
+        base_env.pop(secret.ENV_KEY, None)
         # hosts: e.g. "localhost:2,127.0.0.1:2" to shape local/cross
         # topology while still spawning locally (both names are local)
         host_spec = hosts or f"localhost:{np}"
@@ -118,7 +133,11 @@ def run(
             path = os.path.join(out_dir, f"rank_{r}.pkl")
             if os.path.exists(path):
                 with open(path, "rb") as f:
-                    payloads[r] = pickle.load(f)
+                    # verify the worker's signature before unpickling —
+                    # result files cross the same trust boundary as the
+                    # shipped function
+                    blob = secret.verify(job_key, f.read())
+                payloads[r] = pickle.loads(blob)
         for r in range(np):
             item = payloads.get(r)
             if item is not None and not item[0]:

@@ -40,18 +40,16 @@ def _default_coordinator_addr(slots: List[SlotInfo]) -> str:
     """Address workers use to reach rank 0's coordination service.
 
     Loopback is only usable when EVERY worker is local; a mixed spec
-    needs a routable address the user must provide
-    (--network-interface), since guessing NICs silently hangs remote
-    workers until the rendezvous timeout.
+    probes this host's NICs for a routable address (parity:
+    driver_service.py's interface discovery), with --network-interface
+    as the explicit override when the probe picks a wrong one.
     """
     host0 = slots[0].hostname
     if hosts_mod.is_local_host(host0):
         if any(not hosts_mod.is_local_host(s.hostname) for s in slots):
-            raise ValueError(
-                "rank 0 is on localhost but other workers are remote; "
-                "pass --network-interface with an address remote hosts "
-                "can reach"
-            )
+            from . import nic
+
+            return nic.probe_coordinator_addr()
         return "127.0.0.1"
     return host0
 
@@ -210,17 +208,31 @@ def build_ssh_command(
     exports = " ".join(
         f"{k}={shlex.quote(v)}"
         for k, v in sorted(env.items())
-        if k.startswith(("HVTPU_", "HOROVOD_", "JAX_", "XLA_", "TPU_"))
+        if k.startswith(("HVTPU_", "HOROVOD_", "JAX_", "XLA_", "TPU_",
+                         "PYTHONPATH"))
+        # never serialize the HMAC key itself into argv — it would be
+        # world-readable via /proc/*/cmdline on both ends; the key
+        # rides a 0600 file (HVTPU_SECRET_FILE) instead
+        and k != "HVTPU_SECRET_KEY"
     )
     inner = " ".join(shlex.quote(c) for c in command)
     if cwd:
         inner = f"cd {shlex.quote(cwd)} && env {exports} {inner}"
     else:
         inner = f"env {exports} {inner}"
-    ssh = ["ssh", "-o", "PasswordAuthentication=no",
-           "-o", "StrictHostKeyChecking=no"]
-    if ssh_port:
-        ssh += ["-p", str(ssh_port)]
+    # HVTPU_SSH_COMMAND swaps the transport binary (integration tests
+    # use a local shim so the REAL remote code path — env export
+    # serialization, quoting, cwd, piping, exit propagation — executes
+    # on machines without sshd; parity: the reference's ssh command is
+    # also centrally constructed and test-substituted).
+    override = os.environ.get("HVTPU_SSH_COMMAND")
+    if override:
+        ssh = shlex.split(override)
+    else:
+        ssh = ["ssh", "-o", "PasswordAuthentication=no",
+               "-o", "StrictHostKeyChecking=no"]
+        if ssh_port:
+            ssh += ["-p", str(ssh_port)]
     return ssh + [hostname, inner]
 
 
@@ -290,7 +302,12 @@ def _run(args: argparse.Namespace) -> int:
     slots = hosts_mod.get_host_assignments(
         hosts_mod.parse_host_spec(host_spec), args.np
     )
-    coordinator_addr = args.nic or _default_coordinator_addr(slots)
+    if args.nic:
+        from . import nic as nic_mod
+
+        coordinator_addr = nic_mod.resolve_interface(args.nic)
+    else:
+        coordinator_addr = _default_coordinator_addr(slots)
     port = args.coordinator_port or find_free_port()
     if args.verbose:
         print(

@@ -1,0 +1,99 @@
+"""Network-interface discovery for the launcher.
+
+Parity surface: ``horovod/runner/driver/driver_service.py`` — before a
+multi-host launch the reference starts a driver service, has every host
+probe its NICs, and intersects the routable interface set so workers
+get a rendezvous address they can actually reach
+(``HorovodRunDriverService`` + ``network.get_local_host_addresses``).
+
+TPU-native scope: the coordination service lives in rank 0's worker, so
+only rank 0's host needs probing — workers just need ONE address of
+that host which is routable from the others.  The probe prefers
+globally-scoped, up, non-loopback IPv4 interfaces from ``ip -j addr``
+(with a pure-socket fallback), and ``--network-interface`` accepts an
+interface NAME (resolved here, as the reference's flag does) or a
+literal address.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+from typing import List, Tuple
+
+
+def local_interfaces(usable_only: bool = False) -> List[Tuple[str, str]]:
+    """``[(ifname, ipv4_addr), ...]`` for this host.  Uses
+    ``ip -j addr``; falls back to resolving the hostname when ``ip`` is
+    unavailable (containers, macOS).
+
+    ``usable_only=True`` keeps only addresses a remote peer could
+    plausibly reach: globally-scoped (drops loopback and 169.254/…
+    link-local) on interfaces that are not operationally DOWN — the
+    filter the coordinator probe needs so a docker bridge or dead NIC
+    listed first in ifindex order cannot silently hang the rendezvous.
+    """
+    try:
+        out = subprocess.run(
+            ["ip", "-j", "addr"], capture_output=True, text=True,
+            timeout=10, check=True,
+        ).stdout
+        result = []
+        for iface in json.loads(out):
+            if usable_only and iface.get("operstate") == "DOWN":
+                continue
+            for info in iface.get("addr_info", []):
+                if info.get("family") != "inet":
+                    continue
+                if usable_only and info.get("scope") != "global":
+                    continue
+                result.append((iface["ifname"], info["local"]))
+        if result or usable_only:
+            return result
+    except Exception:  # noqa: BLE001 — any failure falls through
+        pass
+    result = [] if usable_only else [("lo", "127.0.0.1")]
+    try:
+        for addr in socket.gethostbyname_ex(socket.gethostname())[2]:
+            if not addr.startswith("127."):
+                result.append(("host", addr))
+    except OSError:
+        pass
+    return result
+
+
+def resolve_interface(nic: str) -> str:
+    """``--network-interface`` value → coordinator address.  Accepts an
+    interface name (``eth0`` — resolved like the reference's flag) or a
+    literal address/hostname.  A value that is neither a local
+    interface nor resolvable as an address raises immediately (a typo
+    must not become a silent rendezvous hang)."""
+    ifaces = local_interfaces()
+    for ifname, addr in ifaces:
+        if nic == ifname:
+            return addr
+    try:
+        socket.getaddrinfo(nic, None)
+        return nic
+    except OSError:
+        names = ", ".join(sorted({n for n, _ in ifaces}))
+        raise ValueError(
+            f"--network-interface {nic!r} is neither a local interface "
+            f"(have: {names}) nor a resolvable address"
+        ) from None
+
+
+def probe_coordinator_addr() -> str:
+    """A usable (global-scope, iface up) non-loopback IPv4 address of
+    this host that remote workers can plausibly reach (the reference's
+    NIC intersection degenerates to this when only rank 0's host serves
+    the rendezvous).  Raises with the ``--network-interface`` escape
+    hatch when no such address exists."""
+    for _, addr in local_interfaces(usable_only=True):
+        if not addr.startswith("127."):
+            return addr
+    raise ValueError(
+        "no usable non-loopback interface found for the coordinator; "
+        "pass --network-interface with an address remote hosts can reach"
+    )

@@ -13,10 +13,16 @@ import pickle
 import sys
 import traceback
 
+from . import secret
+
 
 def _load(path: str):
+    """Verify the HMAC before a single byte is unpickled (parity:
+    secret.py-signed service messages — unverified pickle is code
+    execution)."""
     with open(path, "rb") as f:
-        blob = f.read()
+        signed = f.read()
+    blob = secret.verify(secret.require_env_key(), signed)
     try:
         import cloudpickle
 
@@ -37,8 +43,16 @@ def main(fn_path: str, out_dir: str) -> int:
         payload = (False, traceback.format_exc())
         code = 1
     tmp = result_path + ".tmp"
+    blob = pickle.dumps(payload)
+    try:
+        signed = secret.sign(secret.require_env_key(), blob)
+    except secret.SignatureError:
+        # no key (e.g. run_task invoked by hand): ship the failure
+        # traceback unsigned — the launcher only accepts this when it
+        # also has no key
+        signed = blob
     with open(tmp, "wb") as f:
-        pickle.dump(payload, f)
+        f.write(signed)
     os.replace(tmp, result_path)
     return code
 

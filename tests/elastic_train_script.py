@@ -31,17 +31,25 @@ def main():
                     f"total={state.total}",
                     flush=True,
                 )
+            # Crash injection: rank CRASH_RANK dies once it reaches
+            # CRASH_EPOCH, up to CRASH_COUNT times total (the marker
+            # file carries the count across incarnations) — repeated
+            # crashes on one host drive the driver's blacklist policy.
             crash_marker = os.environ.get("CRASH_MARKER")
             if (
                 crash_marker
                 and hvt.rank() == int(os.environ.get("CRASH_RANK", "1"))
-                and state.epoch == int(os.environ.get("CRASH_EPOCH", "2"))
-                and not os.path.exists(crash_marker)
+                and state.epoch >= int(os.environ.get("CRASH_EPOCH", "2"))
             ):
-                open(crash_marker, "w").close()
-                print(f"CRASHING rank={hvt.rank()}", file=sys.stderr,
-                      flush=True)
-                os._exit(1)
+                n = 0
+                if os.path.exists(crash_marker):
+                    n = int(open(crash_marker).read().strip() or 0)
+                if n < int(os.environ.get("CRASH_COUNT", "1")):
+                    with open(crash_marker, "w") as f:
+                        f.write(str(n + 1))
+                    print(f"CRASHING rank={hvt.rank()} (strike {n + 1})",
+                          file=sys.stderr, flush=True)
+                    os._exit(1)
             state.epoch += 1
             time.sleep(sleep_s)
             state.commit()

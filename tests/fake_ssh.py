@@ -1,0 +1,36 @@
+"""Local ssh transport shim for remote-path integration tests.
+
+Accepts the ssh-shaped argv that ``build_ssh_command`` produces
+(``[flags...] hostname command``) and executes the command with
+``sh -c`` locally — so the launcher's REAL remote code path (env export
+serialization, shell quoting, cwd handling, output piping, exit-code
+propagation) runs end-to-end on a machine without sshd.  Selected via
+``HVTPU_SSH_COMMAND="python tests/fake_ssh.py"``.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    args = sys.argv[1:]
+    # skip ssh-style flags: -o/-p consume a value, bare -X flags don't
+    i = 0
+    while i < len(args) and args[i].startswith("-"):
+        i += 2 if args[i] in ("-o", "-p", "-i", "-l") else 1
+    if i >= len(args):
+        print("fake_ssh: no hostname", file=sys.stderr)
+        return 255
+    hostname = args[i]
+    command = " ".join(args[i + 1:])
+    if not command:
+        print("fake_ssh: no command", file=sys.stderr)
+        return 255
+    # visible marker so tests can assert this transport actually ran
+    print(f"FAKE_SSH host={hostname}", file=sys.stderr, flush=True)
+    os.execvp("sh", ["sh", "-c", command])
+    return 255  # pragma: no cover - execvp does not return
+
+
+if __name__ == "__main__":
+    sys.exit(main())

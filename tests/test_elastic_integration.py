@@ -87,26 +87,139 @@ def test_worker_crash_recovers_from_commit(tmp_path):
     assert "DONE size=2 epoch=5" in out, out[-3000:]
 
 
+def _stream_until_exit(proc, on_line, deadline_s=240.0):
+    """Read rank-prefixed output until the job exits, firing
+    ``on_line`` per line.  The deadline is enforced with select() so a
+    job that hangs WITHOUT producing output still fails the test
+    instead of blocking readline() forever."""
+    import select
+
+    lines = []
+    start = time.monotonic()
+    fd = proc.stdout
+    while True:
+        remaining = deadline_s - (time.monotonic() - start)
+        if remaining <= 0:
+            proc.kill()
+            pytest.fail("timeout:\n" + "\n".join(lines[-40:]))
+        ready, _, _ = select.select([fd], [], [], min(remaining, 5.0))
+        if not ready:
+            if proc.poll() is not None:
+                break
+            continue
+        line = fd.readline()
+        if not line:
+            break
+        lines.append(line.rstrip())
+        on_line(line)
+    proc.wait(timeout=30)
+    return lines
+
+
 def test_discovery_shrink_resizes_world(tmp_path):
     """Rewrite the discovery output mid-run (3 -> 2 slots): the driver
     must notify workers (SIGUSR1), relaunch at the new size, and the
     job must finish with size=2 while keeping committed progress."""
     hosts_file, disc = _make_discovery(tmp_path, "localhost:3")
     proc = _launch(disc, min_np=2, epochs=10, sleep_s=0.4)
-    shrunk = False
-    lines = []
-    start = time.monotonic()
-    for line in proc.stdout:
-        lines.append(line.rstrip())
-        if not shrunk and "EPOCH epoch=1 " in line:
+    state = {"shrunk": False}
+
+    def on_line(line):
+        if not state["shrunk"] and "EPOCH epoch=1 " in line:
             hosts_file.write_text("localhost:2\n")
-            shrunk = True
-        if time.monotonic() - start > 240:
-            proc.kill()
-            pytest.fail("timeout:\n" + "\n".join(lines[-40:]))
-    proc.wait(timeout=30)
+            state["shrunk"] = True
+
+    lines = _stream_until_exit(proc, on_line)
+    shrunk = state["shrunk"]
     out = "\n".join(lines)
     assert proc.returncode == 0, out[-3000:]
     assert shrunk, out[-2000:]
     assert any("size=3" in ln for ln in lines), out[-3000:]
     assert "DONE size=2 epoch=10" in out, out[-3000:]
+
+
+def test_discovery_grow_resizes_world(tmp_path):
+    """Grow path (reference: ElasticDriver host-add): rewrite discovery
+    2 -> 3 slots mid-run; the driver must notify, relaunch at size 3,
+    and resume from the commit rather than restarting at epoch 0."""
+    hosts_file, disc = _make_discovery(tmp_path, "localhost:2")
+    proc = _launch(disc, min_np=2, epochs=10, sleep_s=0.4)
+    state = {"grown": False}
+
+    def on_line(line):
+        if not state["grown"] and "EPOCH epoch=1 " in line:
+            hosts_file.write_text("localhost:3\n")
+            state["grown"] = True
+
+    lines = _stream_until_exit(proc, on_line)
+    grown = state["grown"]
+    out = "\n".join(lines)
+    assert proc.returncode == 0, out[-3000:]
+    assert grown, out[-2000:]
+    assert any("size=2" in ln for ln in lines), out[-3000:]
+    assert "DONE size=3 epoch=10" in out, out[-3000:]
+    # resume-from-commit: the size-3 incarnation must not replay epoch 0
+    sizes_by_epoch = [
+        (int(ln.split("epoch=")[1].split()[0]), "size=3" in ln)
+        for ln in lines if "EPOCH epoch=" in ln
+    ]
+    first3 = next(i for i, (_, is3) in enumerate(sizes_by_epoch) if is3)
+    assert sizes_by_epoch[first3][0] >= 1, out[-3000:]
+
+
+def test_max_np_caps_growth(tmp_path):
+    """--max-np must cap the world when discovery grows past it, and
+    the driver must NOT restart-thrash chasing uncappable slots
+    (regression: _supervise compared raw discovered slots to the
+    running world instead of the max_np-capped effective world)."""
+    hosts_file, disc = _make_discovery(tmp_path, "localhost:2")
+    proc = _launch(disc, min_np=2, max_np=2, epochs=8, sleep_s=0.3)
+    state = {"grown": False}
+
+    def on_line(line):
+        if not state["grown"] and "EPOCH epoch=1 " in line:
+            hosts_file.write_text("localhost:4\n")
+            state["grown"] = True
+
+    lines = _stream_until_exit(proc, on_line)
+    grown = state["grown"]
+    out = "\n".join(lines)
+    assert proc.returncode == 0, out[-3000:]
+    assert grown, out[-2000:]
+    assert "DONE size=2 epoch=8" in out, out[-3000:]
+    assert not any("size=3" in ln or "size=4" in ln for ln in lines), \
+        out[-3000:]
+    # no restart-thrash: the job must complete in ONE incarnation
+    # (epoch sequence strictly increasing, no replay)
+    epochs_seen = [
+        int(ln.split("epoch=")[1].split()[0])
+        for ln in lines if "EPOCH epoch=" in ln
+    ]
+    assert epochs_seen == sorted(epochs_seen), out[-3000:]
+
+
+def test_blacklist_after_three_strikes(tmp_path):
+    """A host whose workers crash BLACKLIST_THRESHOLD times must be
+    excluded from subsequent incarnations (parity: registration.py
+    blacklist); the job then finishes on the surviving host."""
+    _, disc = _make_discovery(tmp_path, "localhost:1\n127.0.0.1:1")
+    marker = tmp_path / "strikes.txt"
+    proc = _launch(
+        disc,
+        extra_env={
+            "CRASH_MARKER": str(marker),
+            "CRASH_RANK": "1",       # rank 1 lands on 127.0.0.1
+            "CRASH_EPOCH": "2",
+            "CRASH_COUNT": "3",
+        },
+        min_np=1, epochs=5,
+    )
+    out, _ = proc.communicate(timeout=240)
+    assert proc.returncode == 0, out[-3000:]
+    assert marker.exists() and marker.read_text().strip() == "3", \
+        out[-3000:]
+    # hosts are launched in sorted order (127.0.0.1 first), so rank 1
+    # — the crasher — lands on "localhost"
+    assert "blacklisting localhost" in out, out[-3000:]
+    assert "launching 1 workers on 127.0.0.1:1" in out, out[-3000:]
+    assert "DONE size=1 epoch=5" in out, out[-3000:]

@@ -143,3 +143,147 @@ class TestFreePort:
     def test_find_free_port(self):
         p1 = launch_mod.find_free_port()
         assert 1024 < p1 < 65536
+
+
+class TestNicDiscovery:
+    """NIC probing (parity: driver_service.py interface discovery)."""
+
+    def test_local_interfaces_include_loopback(self):
+        from horovod_tpu.runner import nic
+
+        ifaces = nic.local_interfaces()
+        assert any(a.startswith("127.") for _, a in ifaces)
+
+    def test_probe_returns_non_loopback(self):
+        from horovod_tpu.runner import nic
+
+        addr = nic.probe_coordinator_addr()
+        assert not addr.startswith("127.")
+        assert addr in {a for _, a in nic.local_interfaces()}
+
+    def test_resolve_interface_name_and_literal(self):
+        from horovod_tpu.runner import nic
+
+        ifaces = nic.local_interfaces()
+        name, addr = ifaces[0]
+        assert nic.resolve_interface(name) == addr
+        # literal addresses pass through untouched
+        assert nic.resolve_interface("10.1.2.3") == "10.1.2.3"
+
+    def test_resolve_interface_typo_raises(self):
+        # a typo'd interface name must error immediately, not become a
+        # bogus coordinator address and a silent rendezvous hang
+        from horovod_tpu.runner import nic
+
+        with pytest.raises(ValueError, match="neither a local interface"):
+            nic.resolve_interface("eth00-definitely-not-real")
+
+    def test_mixed_spec_uses_probe(self, monkeypatch):
+        from horovod_tpu.runner import launch, nic
+        from horovod_tpu.runner.hosts import get_host_assignments, \
+            parse_host_spec
+
+        monkeypatch.setattr(nic, "probe_coordinator_addr",
+                            lambda: "10.9.8.7")
+        slots = get_host_assignments(
+            parse_host_spec("localhost:1,remote1:1"), 2)
+        assert launch._default_coordinator_addr(slots) == "10.9.8.7"
+
+    def test_all_local_stays_loopback(self):
+        from horovod_tpu.runner import launch
+        from horovod_tpu.runner.hosts import get_host_assignments, \
+            parse_host_spec
+
+        slots = get_host_assignments(
+            parse_host_spec("localhost:2"), 2)
+        assert launch._default_coordinator_addr(slots) == "127.0.0.1"
+
+    def test_ssh_command_override(self, monkeypatch):
+        from horovod_tpu.runner import launch
+
+        monkeypatch.setenv("HVTPU_SSH_COMMAND", "python /x/fake_ssh.py")
+        cmd = launch.build_ssh_command(
+            "h1", ["python", "train.py"], {"HVTPU_RANK": "1"})
+        assert cmd[:2] == ["python", "/x/fake_ssh.py"]
+        assert cmd[2] == "h1"
+        assert "HVTPU_RANK=1" in cmd[3]
+
+
+class TestSignedFunctionChannel:
+    """HMAC signing of run()'s pickle channel (parity:
+    horovod/runner/common/util/secret.py): tampered payloads must fail
+    CLOSED — never unpickled."""
+
+    def test_sign_verify_roundtrip(self):
+        from horovod_tpu.runner import secret
+
+        key = secret.make_secret_key()
+        blob = b"payload-bytes"
+        assert secret.verify(key, secret.sign(key, blob)) == blob
+
+    def test_tampered_blob_rejected(self):
+        from horovod_tpu.runner import secret
+
+        key = secret.make_secret_key()
+        signed = bytearray(secret.sign(key, b"payload"))
+        signed[-1] ^= 0x01
+        with pytest.raises(secret.SignatureError):
+            secret.verify(key, bytes(signed))
+
+    def test_wrong_key_rejected(self):
+        from horovod_tpu.runner import secret
+
+        signed = secret.sign(secret.make_secret_key(), b"x")
+        with pytest.raises(secret.SignatureError):
+            secret.verify(secret.make_secret_key(), signed)
+
+    def test_worker_refuses_tampered_fn_file(self, tmp_path,
+                                             monkeypatch):
+        """run_task fails closed on a flipped byte: the tampered pickle
+        is never loaded and the rank reports the signature error."""
+        import pickle
+
+        from horovod_tpu.runner import run_task, secret
+
+        key = secret.make_secret_key()
+        monkeypatch.setenv(secret.ENV_KEY, key)
+        monkeypatch.setenv("HVTPU_RANK", "0")
+        import cloudpickle
+
+        blob = cloudpickle.dumps((lambda: 42, (), {}))
+        signed = bytearray(secret.sign(key, blob))
+        signed[40] ^= 0xFF  # flip a payload byte past the digest
+        fn_path = tmp_path / "fn.pkl"
+        fn_path.write_bytes(bytes(signed))
+        out_dir = tmp_path / "out"
+        out_dir.mkdir()
+        code = run_task.main(str(fn_path), str(out_dir))
+        assert code == 1
+        payload = pickle.loads(secret.verify(
+            key, (out_dir / "rank_0.pkl").read_bytes()))
+        assert payload[0] is False
+        assert "SignatureError" in payload[1]
+
+    def test_worker_refuses_missing_key(self, tmp_path, monkeypatch):
+        from horovod_tpu.runner import run_task, secret
+
+        monkeypatch.delenv(secret.ENV_KEY, raising=False)
+        monkeypatch.delenv(secret.ENV_KEY_FILE, raising=False)
+        monkeypatch.setenv("HVTPU_RANK", "0")
+        fn_path = tmp_path / "fn.pkl"
+        fn_path.write_bytes(b"whatever-bytes-no-signature-possible")
+        out_dir = tmp_path / "out"
+        out_dir.mkdir()
+        assert run_task.main(str(fn_path), str(out_dir)) == 1
+
+    def test_signed_channel_end_to_end(self):
+        """run() works end-to-end with signing on (the launcher-side
+        rejection of foreign result files is the wrong-key unit test:
+        the launcher verifies every rank_N.pkl with the job key before
+        unpickling)."""
+        from horovod_tpu import runner as runner_mod
+
+        def body():
+            return "ok"
+
+        assert runner_mod.run(body, np=1, cpu_devices=1) == ["ok"]
