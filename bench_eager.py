@@ -68,6 +68,45 @@ def run_sweep(sizes_mb, iters, warmup=3):
     return results
 
 
+def run_tf_graph_sweep(sizes_mb, iters, warmup=3):
+    """tf.py_function collective overhead (VERDICT round-2 task 6):
+    the graph-mode TF frontend routes collectives through
+    tf.py_function; this measures eager vs traced dispatch so the
+    round-trip cost is a tracked number, not folklore."""
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    results = []
+    for mb in sizes_mb:
+        n = int(mb * (1 << 20) / 4)
+        t = tf.ones((n,), tf.float32)
+
+        for mode in ("eager", "graph"):
+            if mode == "graph":
+                @tf.function
+                def red(x):
+                    return hvd.allreduce(x, op=hvd.Sum)
+                fn = red
+            else:
+                def fn(x):
+                    return hvd.allreduce(x, op=hvd.Sum)
+            for _ in range(warmup):
+                fn(t)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn(t)
+            dt = (time.perf_counter() - t0) / iters
+            results.append({
+                "bench": "eager_allreduce_tf", "nbytes": n * 4,
+                "mode": mode, "gbps": n * 4 / dt / 1e9,
+                "us_per_op": dt * 1e6,
+            })
+    return results
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--sizes-mb", default="0.25,1,4,16,64")
@@ -75,6 +114,9 @@ def main():
     p.add_argument("--np", type=int, default=1,
                    help="worker processes (1 = in-process)")
     p.add_argument("--cpu-devices", type=int, default=None)
+    p.add_argument("--tf", action="store_true",
+                   help="run the TF frontend sweep (eager vs "
+                        "tf.function/py_function dispatch)")
     args = p.parse_args()
     sizes = [float(s) for s in args.sizes_mb.split(",")]
 
@@ -84,12 +126,14 @@ def main():
 
             jax.config.update("jax_platforms", "cpu")
             jax.config.update("jax_num_cpu_devices", args.cpu_devices)
-        results = run_sweep(sizes, args.iters)
+        sweep = run_tf_graph_sweep if args.tf else run_sweep
+        results = sweep(sizes, args.iters)
     else:
         from horovod_tpu.runner import run as hvt_run
 
         per_rank = hvt_run(
-            run_sweep, args=(sizes, args.iters), np=args.np,
+            run_tf_graph_sweep if args.tf else run_sweep,
+            args=(sizes, args.iters), np=args.np,
             cpu_devices=args.cpu_devices or 1,
         )
         results = per_rank[0]

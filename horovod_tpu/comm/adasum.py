@@ -19,6 +19,14 @@ log2(n) hops, each a single neighbor exchange that XLA schedules on ICI.
 Requires a power-of-two axis size (as the reference's recursive
 algorithm effectively does per node group); callers fall back to
 averaging otherwise.
+
+Hierarchical variant (parity: ``adasum_gpu_operations.cc``): under
+``HVTPU_HIERARCHICAL_ALLREDUCE`` with a uniform (dcn, ici) layout the
+eager engine sums within each host over ici and runs this combine only
+ACROSS hosts (``comm/eager.py`` ``allreduce_hier_adasum``) — the host
+count must be a power of two; like the reference, the local stage is a
+SUM, so learning-rate scaling by local_size is the caller's
+responsibility.
 """
 
 from __future__ import annotations

@@ -182,6 +182,41 @@ def _jitted(kind: str, mesh: Mesh, static: Tuple):
 
         return jax.jit(fn)
 
+    if kind == "allreduce_hier_adasum":
+        # Hierarchical Adasum (parity: adasum_gpu_operations.cc —
+        # local reduce within the node, Adasum across node leaders,
+        # result shared back): intra-host SUM over the fast ici links,
+        # then the scale-invariant combine only across hosts.  As in
+        # the reference, the local stage is a SUM — with k slots per
+        # host the effective per-host gradient is k× a single worker's,
+        # and learning-rate scaling is the user's documented
+        # responsibility.
+        (compression,) = static
+
+        def fn(stacked, prescale, postscale):
+            def body(shard, pre, post):
+                from .adasum import adasum_reduce
+
+                x = shard[0]
+                x = x * pre.astype(x.dtype)
+                x = lax.psum(x, ICI_AXIS)
+                wire, cctx = compression.compress(x)
+                out = adasum_reduce(
+                    wire, DCN_AXIS, lax.axis_size(DCN_AXIS)
+                )
+                out = compression.decompress(out, cctx)
+                return out * post.astype(out.dtype)
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P((DCN_AXIS, ICI_AXIS)), P(), P()),
+                out_specs=P(),
+                check_vma=False,
+            )(stacked, prescale, postscale)
+
+        return jax.jit(fn)
+
     if kind == "allgather":
 
         def fn(stacked):
@@ -284,6 +319,18 @@ def allreduce(
     name: Optional[str] = None,
 ):
     rop = normalize_op(op, average)
+    if rop == ReduceOp.ADASUM:
+        from .spmd import _is_int8
+
+        if _is_int8(compression):
+            # same guard as spmd.allreduce, enforced size-independently
+            # (a 1-process dev run must fail the same way the pod
+            # does): dot products over per-rank block-scaled int8
+            # codes are meaningless
+            raise ValueError(
+                "int8 compression cannot ride Adasum (per-rank scales "
+                "would corrupt the dot products); use fp16/bf16/none"
+            )
     st, ps = _resolve_process_set(process_set)
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
@@ -299,19 +346,27 @@ def allreduce(
             # averaging / sum over one participant is identity
             out = out * jnp.asarray(postscale_factor, out.dtype)
         else:
-            # Adasum is per-pair math (not two-stage associative), and
-            # integer AVERAGE floor-divides per stage which differs
-            # from a single flat division — both stay on the flat path.
+            # integer AVERAGE floor-divides per stage, which differs
+            # from a single flat division — stays on the flat path.
+            # Adasum rides the hierarchy only when the HOST count is a
+            # power of two (its recursive doubling runs across hosts).
             int_avg = (rop == ReduceOp.AVERAGE
                        and jnp.issubdtype(x.dtype, jnp.integer))
-            hier = (None if (rop == ReduceOp.ADASUM or int_avg)
+            hier = (None if int_avg
                     else _hierarchical_mesh_or_none(st, ps, p))
-            if hier is not None:
-                stacked = _stack_global(x, hier)
-                fn = _jitted("allreduce_hier", hier, (rop, compression))
-            else:
+            if (rop == ReduceOp.ADASUM and hier is not None
+                    and st.cross_size & (st.cross_size - 1)):
+                hier = None
+            if hier is None:
                 stacked = _stack_global(x, mesh)
                 fn = _jitted("allreduce", mesh, (rop, compression))
+            elif rop == ReduceOp.ADASUM:
+                stacked = _stack_global(x, hier)
+                fn = _jitted("allreduce_hier_adasum", hier,
+                             (compression,))
+            else:
+                stacked = _stack_global(x, hier)
+                fn = _jitted("allreduce_hier", hier, (rop, compression))
             out = _fetch(
                 fn(
                     stacked,

@@ -1,0 +1,78 @@
+"""Build glue: compile the native control-plane core at install time.
+
+Parity role: the reference's ``setup.py`` + ``CMakeLists.txt`` compile
+``horovod/common`` into the wheel (SURVEY.md §2.3).  Here the native
+core is a plain C-ABI shared library (no Python includes — it is
+loaded via ctypes by ``horovod_tpu/native/core.py``), so the standard
+``build_ext`` machinery is overridden to emit
+``horovod_tpu/native/libhvt_core.so`` instead of a Python extension
+module.
+
+The build is OPTIONAL: on a box without a C++ toolchain the wheel
+ships without the library and the runtime falls back to the Python
+twin (``native/fallback.py``), exactly like a source checkout where
+the lazy ``make`` in ``native/core.py`` fails.
+"""
+
+import os
+
+from setuptools import Extension, setup
+from setuptools.command.build_ext import build_ext
+
+_SRC = [
+    "horovod_tpu/native/src/message.cc",
+    "horovod_tpu/native/src/controller.cc",
+    "horovod_tpu/native/src/thread_pool.cc",
+    "horovod_tpu/native/src/timeline.cc",
+    "horovod_tpu/native/src/gaussian_process.cc",
+    "horovod_tpu/native/src/c_api.cc",
+]
+_CXXFLAGS = ["-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
+             "-pthread"]
+
+
+class SharedLib(Extension):
+    """A plain shared library (ctypes-loaded), not a Python module."""
+
+
+class BuildNativeCore(build_ext):
+    def get_ext_filename(self, fullname):
+        if fullname.endswith("libhvt_core"):
+            # fixed name, no python-version/ABI suffix: core.py loads
+            # exactly "libhvt_core.so" next to itself
+            parts = fullname.split(".")[:-1] + ["libhvt_core.so"]
+            return os.path.join(*parts)
+        return super().get_ext_filename(fullname)
+
+    def build_extension(self, ext):
+        if not isinstance(ext, SharedLib):
+            return super().build_extension(ext)
+        try:
+            objects = self.compiler.compile(
+                ext.sources,
+                output_dir=self.build_temp,
+                extra_postargs=_CXXFLAGS,
+            )
+            out = self.get_ext_fullpath(ext.name)
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+            # distutils links with the C driver; name libstdc++
+            # explicitly or the .so carries unresolved C++ runtime
+            # symbols and fails at dlopen
+            self.compiler.link_shared_object(
+                objects, out, libraries=["stdc++"],
+                extra_postargs=["-pthread"],
+            )
+        except Exception as e:  # noqa: BLE001 — optional native build
+            self.warn(
+                f"native core build failed ({e}); the wheel will use "
+                "the pure-Python control-plane twin "
+                "(horovod_tpu.native.fallback)"
+            )
+
+
+setup(
+    ext_modules=[
+        SharedLib("horovod_tpu.native.libhvt_core", sources=_SRC),
+    ],
+    cmdclass={"build_ext": BuildNativeCore},
+)

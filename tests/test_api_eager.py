@@ -160,3 +160,17 @@ class TestNegotiationTimeline:
         assert any(e.get("name") == "NEGOTIATE_ALLREDUCE"
                    and e.get("args", {}).get("tensor") == "post"
                    for e in events)
+
+
+def test_adasum_rejects_int8_compression(hvt):
+    """The eager path must enforce the same int8+Adasum guard as spmd
+    (the hierarchical Adasum kernel would otherwise silently run dot
+    products over per-rank block-scaled codes)."""
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from horovod_tpu.comm.compression import Compression
+
+    with _pytest.raises(ValueError, match="Adasum"):
+        hvt.allreduce(jnp.ones(8), op=hvt.Adasum,
+                      compression=Compression.int8)

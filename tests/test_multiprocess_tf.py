@@ -90,3 +90,46 @@ def test_keras_fit_lockstep_2proc():
     # broadcast + averaged grads keep ranks bit-identical despite
     # different data and different seeds
     assert w0 == w1
+
+
+def test_tf_graph_mode_fused_broadcast_2proc():
+    """Graph-mode (tf.function) broadcast_variables across real
+    processes: the fused per-dtype path must deliver rank-0 values to
+    every rank inside a traced function."""
+
+    def body():
+        import numpy as np
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvd
+
+        hvd.init()
+        r = hvd.rank()
+        vs = [tf.Variable(tf.fill((4,), float((r + 1) * (i + 1))))
+              for i in range(6)]
+        iv = tf.Variable(tf.constant([r, r], tf.int32))
+
+        @tf.function
+        def sync():
+            hvd.broadcast_variables(vs + [iv], root_rank=0)
+
+        sync()
+        # rank 0's values everywhere: (i+1) for the floats, [0, 0] int
+        ok_f = all(
+            np.allclose(v.numpy(), np.full((4,), float(i + 1)))
+            for i, v in enumerate(vs)
+        )
+        ok_i = iv.numpy().tolist() == [0, 0]
+
+        # graph-mode collective correctness too (allreduce in a trace)
+        @tf.function
+        def red():
+            return hvd.allreduce(tf.constant([float(r + 1)]), op=hvd.Sum)
+
+        s = float(red().numpy()[0])
+        return (r, ok_f, ok_i, s)
+
+    results = run(body, np=2, cpu_devices=1, env=_ENV)
+    for r, ok_f, ok_i, s in results:
+        assert ok_f and ok_i
+        assert s == 3.0

@@ -467,3 +467,39 @@ class TestNativeGaussianProcess:
                 length_scale=0.3, noise=1e-4, signal_variance=1.0,
                 best_y=0.0, xi=0.01,
             )
+
+
+class TestWheelBuild:
+    """pip install compiles the native core into the wheel (parity:
+    the reference's setup.py/CMake build — SURVEY.md §2.3; VERDICT
+    round-2 task 8: 'pip install . on a clean box yields the C++
+    path')."""
+
+    def test_wheel_contains_loadable_native_core(self, tmp_path):
+        import ctypes
+        import glob
+        import subprocess
+        import sys
+        import zipfile
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        wheel_dir = tmp_path / "whl"
+        r = subprocess.run(
+            [sys.executable, "-m", "pip", "wheel", "--no-deps",
+             "--no-build-isolation", "-w", str(wheel_dir), repo],
+            capture_output=True, text=True, timeout=600,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        (whl,) = glob.glob(str(wheel_dir / "*.whl"))
+        names = zipfile.ZipFile(whl).namelist()
+        assert "horovod_tpu/native/libhvt_core.so" in names
+
+        # the wheel's artifact loads standalone and speaks the exact
+        # ABI core.py expects — i.e. an installed user gets the C++
+        # control plane, not the Python twin
+        site = tmp_path / "site"
+        zipfile.ZipFile(whl).extractall(site)
+        lib = ctypes.CDLL(str(site / "horovod_tpu/native/libhvt_core.so"))
+        lib.hvt_abi_version.restype = ctypes.c_int
+        assert lib.hvt_abi_version() == 2
