@@ -467,6 +467,7 @@ from .api.checkpoint import (  # noqa: E402
     restore_checkpoint,
     save_checkpoint,
 )
+from .api.sharded_checkpoint import ShardedCheckpointer  # noqa: E402
 
 __all__ = [
     "__version__",
@@ -486,6 +487,7 @@ __all__ = [
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
     "Checkpointer", "save_checkpoint", "restore_checkpoint",
+    "ShardedCheckpointer",
     "Compression", "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max",
     "Product",
     "ProcessSet", "add_process_set", "remove_process_set",

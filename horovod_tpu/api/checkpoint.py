@@ -51,6 +51,30 @@ def _flush_pending_saves_at_exit():
             print(f"hvtpu.Checkpointer: {e}", file=sys.stderr)
 
 
+def step_dir_name(step: int) -> str:
+    """Shared step-directory naming (used by both checkpointers — the
+    layouts must never diverge)."""
+    return f"step_{step:012d}"
+
+
+def list_steps(directory: str, require_file: Optional[str] = None
+               ) -> List[int]:
+    """Sorted step numbers under ``directory``; ``require_file`` keeps
+    only steps whose dir contains that file (commit marker)."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        m = re.fullmatch(r"step_(\d+)", name)
+        if not m:
+            continue
+        if require_file and not os.path.exists(
+                os.path.join(directory, name, require_file)):
+            continue
+        out.append(int(m.group(1)))
+    return sorted(out)
+
+
 class Checkpointer:
     """Async, rank-0-writes checkpointing (orbax-backed when available).
 
@@ -86,7 +110,7 @@ class Checkpointer:
 
     # -- write side ----------------------------------------------------
     def _step_dir(self, step: int) -> str:
-        return os.path.join(self.directory, f"step_{step:012d}")
+        return os.path.join(self.directory, step_dir_name(step))
 
     def save(self, step: int, payload: Dict[str, Any]):
         """Queue an async save of ``payload`` at ``step`` (rank 0 only;
@@ -140,14 +164,7 @@ class Checkpointer:
 
     # -- read side -----------------------------------------------------
     def all_steps(self) -> List[int]:
-        if not os.path.isdir(self.directory):
-            return []
-        out = []
-        for name in os.listdir(self.directory):
-            m = re.fullmatch(r"step_(\d+)", name)
-            if m:
-                out.append(int(m.group(1)))
-        return sorted(out)
+        return list_steps(self.directory)
 
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()

@@ -1,0 +1,225 @@
+"""Pod-scale sharded checkpointing (api/sharded_checkpoint.py): every
+process writes its addressable shards; restore reassembles onto the
+CURRENT mesh — possibly a different process count or sharding than the
+saving job (the elastic-resize resume story at pod scale, where the
+reference's rank-0-writes idiom cannot go)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu
+from horovod_tpu.runner import run
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(horovod_tpu.__file__))
+_ENV = {"PYTHONPATH": _REPO_ROOT + os.pathsep
+        + os.environ.get("PYTHONPATH", "")}
+
+
+class TestSingleProcess:
+    def _tree(self, mesh):
+        rng = np.random.RandomState(0)
+        w = rng.randn(16, 8).astype(np.float32)
+        b = rng.randn(64).astype(np.float32)
+        rows = NamedSharding(mesh, P("world"))
+        repl = NamedSharding(mesh, P())
+        return {
+            "w": jax.device_put(w, rows),
+            "nested": {"b": jax.device_put(b, repl)},
+        }, {"w": w, "b": b}
+
+    def test_roundtrip_same_sharding(self, hvt, tmp_path):
+        from horovod_tpu import ShardedCheckpointer
+
+        mesh = hvt.world_mesh()
+        tree, raw = self._tree(mesh)
+        ckpt = ShardedCheckpointer(str(tmp_path))
+        ckpt.save(3, tree)
+        assert ckpt.all_steps() == [3]
+        out = ckpt.restore(tree)
+        np.testing.assert_array_equal(np.asarray(out["w"]), raw["w"])
+        np.testing.assert_array_equal(
+            np.asarray(out["nested"]["b"]), raw["b"])
+
+    def test_restore_onto_different_sharding(self, hvt, tmp_path):
+        """Saved row-sharded, restored replicated AND column-sharded —
+        the assembly path must stitch shards across layouts."""
+        from horovod_tpu import ShardedCheckpointer
+
+        mesh = hvt.world_mesh()
+        tree, raw = self._tree(mesh)
+        ckpt = ShardedCheckpointer(str(tmp_path))
+        ckpt.save(0, tree)
+
+        repl = NamedSharding(mesh, P())
+        cols = NamedSharding(mesh, P(None, "world"))
+        template = {
+            "w": jax.ShapeDtypeStruct((16, 8), jnp.float32,
+                                      sharding=cols),
+            "nested": {"b": jax.ShapeDtypeStruct((64,), jnp.float32,
+                                                 sharding=repl)},
+        }
+        out = ckpt.restore(template)
+        assert out["w"].sharding == cols
+        np.testing.assert_array_equal(np.asarray(out["w"]), raw["w"])
+        np.testing.assert_array_equal(
+            np.asarray(out["nested"]["b"]), raw["b"])
+
+    def test_missing_leaf_raises(self, hvt, tmp_path):
+        from horovod_tpu import ShardedCheckpointer
+
+        mesh = hvt.world_mesh()
+        tree, _ = self._tree(mesh)
+        ckpt = ShardedCheckpointer(str(tmp_path))
+        ckpt.save(0, tree)
+        bad = dict(tree)
+        bad["extra"] = tree["w"]
+        with pytest.raises(KeyError, match="extra"):
+            ckpt.restore(bad)
+
+
+@pytest.mark.multiprocess
+def test_pod_save_then_restore_on_different_topology(tmp_path):
+    """2 processes x 4 devices save a world-sharded tree (each process
+    writes only its 4 shards); the PARENT process (1 proc x 8 devices —
+    a different topology) restores and verifies every element."""
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    def body():
+        import numpy as np
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        import horovod_tpu as hvt
+
+        hvt.init()
+        assert hvt.size() == 2 and jax.local_device_count() == 4
+        mesh = hvt.world_mesh()
+        rng = np.random.RandomState(7)
+        w = rng.randn(32, 4).astype(np.float32)
+        s = rng.randn(8).astype(np.float32)
+        tree = {
+            "w": jax.make_array_from_callback(
+                w.shape, NamedSharding(mesh, P("world")),
+                lambda i: w[i]),
+            "s": jax.make_array_from_callback(
+                s.shape, NamedSharding(mesh, P()), lambda i: s[i]),
+        }
+        hvt.ShardedCheckpointer(ckpt_dir).save(11, tree)
+        return hvt.rank()
+
+    import cloudpickle
+    import sys
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    try:
+        results = run(body, np=2, cpu_devices=4, env=_ENV,
+                      start_timeout=300.0)
+    finally:
+        cloudpickle.unregister_pickle_by_value(sys.modules[__name__])
+    assert sorted(results) == [0, 1]
+
+    # parent: different topology (1 process, its own 8 CPU devices)
+    import horovod_tpu as hvt
+
+    hvt.init()
+    try:
+        from horovod_tpu import ShardedCheckpointer
+
+        mesh = hvt.world_mesh()
+        template = {
+            "w": jax.ShapeDtypeStruct(
+                (32, 4), jnp.float32,
+                sharding=NamedSharding(mesh, P("world"))),
+            "s": jax.ShapeDtypeStruct(
+                (8,), jnp.float32,
+                sharding=NamedSharding(mesh, P())),
+        }
+        ckpt = ShardedCheckpointer(ckpt_dir)
+        assert ckpt.latest_step() == 11
+        out = ckpt.restore(template)
+        rng = np.random.RandomState(7)
+        np.testing.assert_array_equal(
+            np.asarray(out["w"]), rng.randn(32, 4).astype(np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(out["s"]), rng.randn(8).astype(np.float32))
+    finally:
+        hvt.shutdown()
+
+
+class TestDurabilitySemantics:
+    def test_resave_discards_stale_pieces(self, hvt, tmp_path):
+        """Re-saving a step must clear prior content: orphan pieces
+        from a larger world's earlier save of the SAME step must not
+        blend into the restored data."""
+        from horovod_tpu import ShardedCheckpointer
+        import json
+
+        mesh = hvt.world_mesh()
+        rows = NamedSharding(mesh, P("world"))
+        w1 = np.arange(32, dtype=np.float32).reshape(8, 4)
+        w2 = w1 + 100.0
+        ckpt = ShardedCheckpointer(str(tmp_path))
+        ckpt.save(0, {"w": jax.device_put(w1, rows)})
+
+        # plant an orphan "process 9" manifest+piece overlapping rows
+        step_dir = tmp_path / "step_000000000000"
+        garbage = np.full((8, 4), -1.0, np.float32)
+        np.save(step_dir / "pieces" / "orphan.p9.0.npy", garbage)
+        key = json.load(open(step_dir / "manifest_p0.json"))
+        orphan_key = next(iter(key))
+        (step_dir / "manifest_p9.json").write_text(json.dumps(
+            {orphan_key: [{"file": "orphan.p9.0.npy",
+                           "slices": [[0, 8], [0, 4]]}]}))
+
+        ckpt.save(0, {"w": jax.device_put(w2, rows)})
+        out = ckpt.restore({"w": jax.device_put(w2, rows)})
+        np.testing.assert_array_equal(np.asarray(out["w"]), w2)
+
+    def test_step_without_commit_marker_is_invisible(self, hvt,
+                                                     tmp_path):
+        """A step dir missing meta.json (a rank died mid-save) must be
+        skipped by all_steps/latest_step so resume falls back to the
+        last intact checkpoint."""
+        from horovod_tpu import ShardedCheckpointer
+
+        mesh = hvt.world_mesh()
+        rows = NamedSharding(mesh, P("world"))
+        w = np.ones((8, 4), np.float32)
+        tree = {"w": jax.device_put(w, rows)}
+        ckpt = ShardedCheckpointer(str(tmp_path))
+        ckpt.save(1, tree)
+        # half-written step 2: pieces but no commit marker
+        (tmp_path / "step_000000000002" / "pieces").mkdir(parents=True)
+        assert ckpt.all_steps() == [1]
+        assert ckpt.latest_step() == 1
+        out = ckpt.restore(tree)
+        np.testing.assert_array_equal(np.asarray(out["w"]), w)
+
+    def test_host_leaf_takes_rank0_value_once(self, hvt, tmp_path):
+        """Plain numpy leaves are written once (rank 0), not once per
+        process, and roundtrip exactly."""
+        from horovod_tpu import ShardedCheckpointer
+        import json
+
+        mesh = hvt.world_mesh()
+        rows = NamedSharding(mesh, P("world"))
+        tree = {
+            "w": jax.device_put(np.ones((8, 2), np.float32), rows),
+            "host_counter": np.int64(42),
+        }
+        ckpt = ShardedCheckpointer(str(tmp_path))
+        ckpt.save(0, tree)
+        manifest = json.load(open(
+            tmp_path / "step_000000000000" / "manifest_p0.json"))
+        host_entries = [e for k, es in manifest.items() for e in es
+                        if e["file"].endswith(".host.npy")]
+        assert len(host_entries) == 1
+        out = ckpt.restore(tree)
+        assert int(np.asarray(out["host_counter"])) == 42
