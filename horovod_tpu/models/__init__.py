@@ -14,6 +14,8 @@ from .resnet import (
     ResNet101,
     ResNet152,
 )
+from .vgg import VGG, VGG16, VGG19
+from .inception import InceptionV3
 
 __all__ = [
     "MLP",
@@ -28,4 +30,6 @@ __all__ = [
     "ResNet50",
     "ResNet101",
     "ResNet152",
+    "VGG", "VGG16", "VGG19",
+    "InceptionV3",
 ]

@@ -1,0 +1,121 @@
+"""Inception V3 in flax.linen, bf16-first.
+
+Third member of the reference's README benchmark trio (Inception V3 /
+ResNet-101 / VGG-16 — ``docs/benchmarks.rst``; Inception is its
+~90%-scaling compute-bound case).  Standard V3 topology (stem, 3×A,
+B, 4×C, D, 2×E, 299×299 input) without the auxiliary head — the
+benchmark path never trains it.
+
+TPU notes: bf16 compute, fp32 params/BN stats; NHWC; TpuBatchNorm for
+the flattened 2-D stat reduce (see models/tpu_norm.py) with optional
+cross-replica sync via ``bn_axis_name``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .tpu_norm import TpuBatchNorm
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = TpuBatchNorm(
+            use_running_average=not self.train, momentum=0.9,
+            epsilon=1e-3, dtype=self.dtype,
+            axis_name=self.bn_axis_name if self.train else None,
+        )(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype, train=train,
+                       bn_axis_name=self.bn_axis_name)
+        x = x.astype(self.dtype)
+
+        # stem (299x299x3 -> 35x35x192)
+        x = conv(32, (3, 3), (2, 2), padding="VALID")(x)
+        x = conv(32, (3, 3), padding="VALID")(x)
+        x = conv(64, (3, 3))(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = conv(80, (1, 1), padding="VALID")(x)
+        x = conv(192, (3, 3), padding="VALID")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+
+        # 3x Inception-A
+        for pool_features in (32, 64, 64):
+            b1 = conv(64, (1, 1))(x)
+            b5 = conv(64, (5, 5))(conv(48, (1, 1))(x))
+            b3 = conv(96, (3, 3))(conv(96, (3, 3))(conv(64, (1, 1))(x)))
+            bp = conv(pool_features, (1, 1))(_avg_pool_same(x))
+            x = jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+        # Inception-B (grid reduction 35 -> 17)
+        b3 = conv(384, (3, 3), (2, 2), padding="VALID")(x)
+        bd = conv(96, (3, 3), (2, 2), padding="VALID")(
+            conv(96, (3, 3))(conv(64, (1, 1))(x)))
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = jnp.concatenate([b3, bd, bp], axis=-1)
+
+        # 4x Inception-C with factorized 7x7
+        for c7 in (128, 160, 160, 192):
+            b1 = conv(192, (1, 1))(x)
+            b7 = conv(192, (7, 1))(conv(c7, (1, 7))(conv(c7, (1, 1))(x)))
+            bdbl = conv(c7, (1, 1))(x)
+            bdbl = conv(c7, (1, 7))(conv(c7, (7, 1))(bdbl))
+            bdbl = conv(192, (7, 1))(conv(c7, (1, 7))(bdbl))
+            bp = conv(192, (1, 1))(_avg_pool_same(x))
+            x = jnp.concatenate([b1, b7, bdbl, bp], axis=-1)
+
+        # Inception-D (grid reduction 17 -> 8)
+        b3 = conv(320, (3, 3), (2, 2), padding="VALID")(
+            conv(192, (1, 1))(x))
+        b7 = conv(192, (1, 7))(conv(192, (1, 1))(x))
+        b7 = conv(192, (3, 3), (2, 2), padding="VALID")(
+            conv(192, (7, 1))(b7))
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = jnp.concatenate([b3, b7, bp], axis=-1)
+
+        # 2x Inception-E
+        for _ in range(2):
+            b1 = conv(320, (1, 1))(x)
+            b3 = conv(384, (1, 1))(x)
+            b3 = jnp.concatenate(
+                [conv(384, (1, 3))(b3), conv(384, (3, 1))(b3)], axis=-1)
+            bd = conv(384, (3, 3))(conv(448, (1, 1))(x))
+            bd = jnp.concatenate(
+                [conv(384, (1, 3))(bd), conv(384, (3, 1))(bd)], axis=-1)
+            bp = conv(192, (1, 1))(_avg_pool_same(x))
+            x = jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+        # head: flattened spatial mean (same TPU reduce note as ResNet)
+        n, h, w, c = x.shape
+        x = jnp.mean(x.reshape(n, h * w, c), axis=1)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

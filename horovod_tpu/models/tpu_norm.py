@@ -67,9 +67,11 @@ class TpuBatchNorm(nn.Module):
             mean_sq = jnp.mean(
                 jnp.square(x2.astype(jnp.float32)), axis=0
             )
-            if self.axis_name is not None:
-                # cross-replica sync (SyncBatchNorm): average the
-                # moments, not the variances
+            # cross-replica sync (SyncBatchNorm): average the moments,
+            # not the variances.  Skipped while initializing — init()
+            # runs OUTSIDE shard_map, where the axis name is unbound
+            # (and init-time stats are discarded defaults anyway).
+            if self.axis_name is not None and not self.is_initializing():
                 mean = jax.lax.pmean(mean, self.axis_name)
                 mean_sq = jax.lax.pmean(mean_sq, self.axis_name)
             var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)

@@ -1,0 +1,57 @@
+"""VGG in flax.linen, bf16-first.
+
+The reference's README benchmark trio is Inception V3 / ResNet-101 /
+VGG-16 (``docs/benchmarks.rst``; VGG-16 is its comm-bound case — ~68%
+scaling at 128 GPUs because of the 138M-parameter dense head), so the
+same architectures are available here for like-for-like scaling runs.
+
+TPU notes: bf16 compute, fp32 params and logits; NHWC convs; the
+classifier keeps the reference's 4096-wide FC stack — exactly the
+gradient payload that makes VGG the fusion/compression stress test.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Each entry: number of 3x3 convs in the stage, then a 2x2/2 maxpool.
+_CFG = {
+    11: (1, 1, 2, 2, 2),
+    13: (2, 2, 2, 2, 2),
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+_WIDTHS = (64, 128, 256, 512, 512)
+
+
+class VGG(nn.Module):
+    depth: int = 16
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train  # no batch norm / dropout state in the classic net
+        x = x.astype(self.dtype)
+        for stage, (reps, width) in enumerate(
+                zip(_CFG[self.depth], _WIDTHS)):
+            for i in range(reps):
+                x = nn.Conv(width, (3, 3), padding="SAME",
+                            dtype=self.dtype,
+                            name=f"conv{stage}_{i}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        n = x.shape[0]
+        x = x.reshape(n, -1)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+VGG16 = partial(VGG, depth=16)
+VGG19 = partial(VGG, depth=19)

@@ -149,3 +149,72 @@ class TestTpuBatchNorm:
         np.testing.assert_allclose(np.asarray(y_sharded),
                                    np.asarray(y_dense),
                                    rtol=2e-5, atol=2e-5)
+
+
+class TestBenchmarkTrio:
+    """The reference's README benchmark trio (docs/benchmarks.rst):
+    Inception V3 / ResNet-101 / VGG-16 — all available for
+    like-for-like scaling runs (bench.py HVTPU_BENCH_MODEL)."""
+
+    def test_vgg16_forward_and_grads(self):
+        import optax
+
+        from horovod_tpu.models import VGG16
+
+        model = VGG16(num_classes=10, dtype=jnp.float32)
+        x = jnp.ones((2, 64, 64, 3))
+        variables = model.init(jax.random.PRNGKey(0), x)
+        out = model.apply(variables, x)
+        assert out.shape == (2, 10) and out.dtype == jnp.float32
+
+        def loss_fn(params):
+            logits = model.apply({"params": params}, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, jnp.zeros((2,), jnp.int32)).mean()
+
+        grads = jax.grad(loss_fn)(variables["params"])
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree_util.tree_leaves(grads))
+
+    def test_vgg16_imagenet_param_count(self):
+        from horovod_tpu.models import VGG16
+
+        model = VGG16(num_classes=1000, dtype=jnp.float32)
+        v = model.init(jax.random.PRNGKey(0),
+                       jnp.ones((1, 224, 224, 3)))
+        n = sum(int(np.prod(p.shape))
+                for p in jax.tree_util.tree_leaves(v["params"]))
+        # torchvision vgg16: 138,357,544 params
+        assert abs(n - 138_357_544) < 1e5, n
+
+    def test_inception3_forward_and_stats(self):
+        from horovod_tpu.models import InceptionV3
+
+        model = InceptionV3(num_classes=10, dtype=jnp.float32)
+        x = jnp.ones((2, 96, 96, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        out, mutated = model.apply(
+            variables, x, train=True, mutable=["batch_stats"])
+        assert out.shape == (2, 10)
+        assert "batch_stats" in mutated
+
+    def test_inception3_imagenet_param_count(self):
+        from horovod_tpu.models import InceptionV3
+
+        model = InceptionV3(num_classes=1000, dtype=jnp.float32)
+        v = model.init(jax.random.PRNGKey(0),
+                       jnp.ones((1, 299, 299, 3)), train=False)
+        n = sum(int(np.prod(p.shape))
+                for p in jax.tree_util.tree_leaves(v["params"]))
+        # torchvision inception_v3 (aux_logits=False): 23,834,568
+        assert abs(n - 23_834_568) < 2e5, n
+
+    def test_resnet101_forward(self):
+        from horovod_tpu.models import ResNet101
+
+        model = ResNet101(num_classes=10, num_filters=8,
+                          dtype=jnp.float32)
+        x = jnp.ones((2, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (2, 10)
