@@ -32,10 +32,15 @@ from ..core.exceptions import (  # noqa: F401
     HorovodInternalError,
     HostsUpdatedInterrupt,
 )
-from .state import JaxState, ObjectState, State  # noqa: F401
+from .state import (  # noqa: F401
+    JaxState,
+    ObjectState,
+    ShardedJaxState,
+    State,
+)
 from .worker import RESET_EXIT_CODE, run  # noqa: F401
 
 __all__ = [
-    "State", "ObjectState", "JaxState", "run", "RESET_EXIT_CODE",
+    "State", "ObjectState", "JaxState", "ShardedJaxState", "run", "RESET_EXIT_CODE",
     "HorovodInternalError", "HostsUpdatedInterrupt",
 ]

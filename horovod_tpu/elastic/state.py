@@ -182,6 +182,10 @@ class JaxState(ObjectState):
 
     Arrays are pulled to host numpy for the durable snapshot so a
     restarted world (possibly a different device count) can load it.
+    NOTE: that requires fully-addressable arrays (single-controller /
+    per-process values); for GLOBAL arrays spanning processes (the pod
+    shape) use :class:`ShardedJaxState`, whose durable commit writes
+    each process's shards and reshards on resync.
     """
 
     def _to_disk_payload(self):
@@ -204,3 +208,124 @@ class JaxState(ObjectState):
         import jax
 
         self._apply(jax.tree.map(back, payload))
+
+
+class ShardedJaxState(JaxState):
+    """Elastic state for the POD SHAPE: tracked attributes may hold
+    GLOBAL ``jax.Array``s sharded across processes.
+
+    ``JaxState``'s durable path (``np.asarray`` per leaf) raises on a
+    non-fully-addressable global array; here the durable commit rides
+    :class:`~horovod_tpu.api.sharded_checkpoint.ShardedCheckpointer`
+    instead — every process writes its own shards, and ``sync()`` after
+    an elastic restart reassembles each leaf onto the NEW world's
+    shardings (taken from the freshly-initialized attribute values the
+    restarted trainer constructed, which serve as the restore
+    template).  Non-array attributes keep the rank-0 pickle +
+    broadcast path.
+
+    Commit is collective (all ranks call ``commit()`` at the same
+    boundary — already the elastic contract); the two newest commits
+    are retained.
+    """
+
+    _KEEP_COMMITS = 2
+
+    def _sharded_dir(self) -> Optional[str]:
+        d = _state_dir()
+        return os.path.join(d, "sharded") if d else None
+
+    def _split(self, payload: Dict[str, Any]):
+        """(array_attrs, plain_attrs): an attribute whose pytree holds
+        any jax.Array goes through the sharded checkpointer (its
+        host-leaf path covers mixed trees); the rest ride pickle."""
+        import jax
+
+        arrays, rest = {}, {}
+        for k, v in payload.items():
+            if any(isinstance(leaf, jax.Array)
+                   for leaf in jax.tree_util.tree_leaves(v)):
+                arrays[k] = v
+            else:
+                rest[k] = v
+        return arrays, rest
+
+    def save(self):
+        self.save_to_memory()
+        d = self._sharded_dir()
+        if not d:
+            return
+        from ..api.sharded_checkpoint import ShardedCheckpointer
+
+        st = core_state.require_init("elastic sharded commit")
+        # split the snapshot save_to_memory already deep-copied — a
+        # second _capture() would transiently duplicate every global
+        # device array at the commit boundary (ShardedCheckpointer
+        # only reads, so sharing the snapshot is safe)
+        arrays, rest = self._split(self._saved)
+        ckpt = ShardedCheckpointer(d)
+        step = (ckpt.latest_step() or 0) + 1
+        ckpt.save(step, arrays)
+        if st.rank == 0:
+            fd, tmp = tempfile.mkstemp(dir=_state_dir(), suffix=".tmp")
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump({"step": step, "rest": rest,
+                             "array_attrs": sorted(arrays)}, f)
+            os.replace(tmp, _commit_path(_state_dir()))
+            # retention: drop all but the newest _KEEP_COMMITS steps
+            import shutil
+
+            for s in ckpt.all_steps()[:-self._KEEP_COMMITS]:
+                shutil.rmtree(ckpt._step_dir(s), ignore_errors=True)
+
+    def sync(self):
+        from ..api import functions as api_functions
+        from ..api.sharded_checkpoint import ShardedCheckpointer
+
+        st = core_state.require_init("elastic state sync")
+        d = self._sharded_dir()
+        # Rank 0 ALONE decides the branch and broadcasts it: a per-rank
+        # os.path.exists over a shared filesystem can disagree across
+        # hosts (NFS attribute caches), and divergent branches would
+        # desync the collective sequence — some ranks inside the
+        # restore's make_array_from_callback, others not.
+        if st.rank == 0:
+            disk = None
+            if d and not self._synced and os.path.exists(
+                    _commit_path(_state_dir())):
+                with open(_commit_path(_state_dir()), "rb") as f:
+                    disk = pickle.load(f)
+            msg = {"disk": disk}
+        else:
+            msg = None
+        msg = api_functions.broadcast_object(msg, root_rank=0)
+        disk = msg["disk"]
+        if disk is not None:
+            self._apply(disk["rest"])
+            # current attribute values carry the NEW world's shardings:
+            # use them as the restore template
+            arrays, _ = self._split(self._capture())
+            # every array attr the saver committed must be restorable
+            # through the template — a fresh value that is not a
+            # jax.Array tree (e.g. params=None placeholder) would
+            # silently keep its uninitialized state otherwise
+            missing = set(disk.get("array_attrs", [])) - set(arrays)
+            if missing:
+                raise ValueError(
+                    "ShardedJaxState.sync: committed array attributes "
+                    f"{sorted(missing)} have no jax.Array template in "
+                    "the restarted state; construct them (device_put "
+                    "with the new mesh's sharding) before sync()"
+                )
+            restored = ShardedCheckpointer(d).restore(
+                arrays, step=disk["step"]
+            )
+            self._apply(restored)
+        else:
+            # no durable commit: plain-attr broadcast only; global
+            # arrays are already identical by SPMD construction
+            _, rest = self._split(self._capture())
+            payload = api_functions.broadcast_object(rest, root_rank=0)
+            self._apply(payload)
+        self.save_to_memory()
+        self._synced = True

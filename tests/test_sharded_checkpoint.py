@@ -223,3 +223,91 @@ class TestDurabilitySemantics:
         assert len(host_entries) == 1
         out = ckpt.restore(tree)
         assert int(np.asarray(out["host_counter"])) == 42
+
+
+@pytest.mark.multiprocess
+def test_sharded_elastic_state_resync_across_topologies(tmp_path):
+    """ShardedJaxState: a 2-proc x 2-dev world commits GLOBAL sharded
+    arrays (JaxState's np.asarray path would raise on them); a
+    'restarted' trainer on a different topology (parent: 1 proc x 8
+    devs) constructs fresh state and sync() reassembles the committed
+    values onto the new world's shardings."""
+    state_dir = str(tmp_path / "elastic_state")
+
+    def body():
+        import os
+
+        import numpy as np
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        import horovod_tpu as hvt
+        import horovod_tpu.elastic as elastic
+
+        os.environ["HVTPU_ELASTIC_STATE_DIR"] = state_dir
+        hvt.init()
+        assert hvt.size() == 2 and jax.local_device_count() == 2
+        mesh = hvt.world_mesh()
+        w = np.arange(32, dtype=np.float32).reshape(8, 4)
+        state = elastic.ShardedJaxState(
+            params={"w": jax.make_array_from_callback(
+                w.shape, NamedSharding(mesh, P("world")),
+                lambda i: w[i])},
+            epoch=0,
+        )
+        state.epoch = 3
+        state.params = {"w": state.params["w"] * 2.0}
+        state.commit()
+        return hvt.rank()
+
+    import cloudpickle
+    import sys
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    try:
+        results = run(body, np=2, cpu_devices=2, env=_ENV,
+                      start_timeout=300.0)
+    finally:
+        cloudpickle.unregister_pickle_by_value(sys.modules[__name__])
+    assert sorted(results) == [0, 1]
+
+    # "restarted" world: the parent process with its own 8-dev mesh
+    import horovod_tpu as hvt
+    import horovod_tpu.elastic as elastic
+
+    os.environ["HVTPU_ELASTIC_STATE_DIR"] = state_dir
+    hvt.init()
+    try:
+        mesh = hvt.world_mesh()
+        fresh = {"w": jax.device_put(
+            np.zeros((8, 4), np.float32),
+            NamedSharding(mesh, P("world")))}
+        state = elastic.ShardedJaxState(params=fresh, epoch=0)
+        state.sync()
+        assert state.epoch == 3
+        want = np.arange(32, dtype=np.float32).reshape(8, 4) * 2.0
+        np.testing.assert_array_equal(
+            np.asarray(state.params["w"]), want)
+    finally:
+        os.environ.pop("HVTPU_ELASTIC_STATE_DIR", None)
+        hvt.shutdown()
+
+
+def test_sharded_state_sync_rejects_missing_array_template(hvt,
+                                                           tmp_path,
+                                                           monkeypatch):
+    """A committed array attribute whose fresh value holds no jax.Array
+    must fail sync loudly instead of silently keeping zeros."""
+    import horovod_tpu.elastic as elastic
+
+    monkeypatch.setenv("HVTPU_ELASTIC_STATE_DIR", str(tmp_path))
+    mesh = hvt.world_mesh()
+    w = jax.device_put(np.ones((8, 2), np.float32),
+                       NamedSharding(mesh, P("world")))
+    state = elastic.ShardedJaxState(params={"w": w}, epoch=1)
+    state.commit()
+
+    fresh = elastic.ShardedJaxState(params=None, epoch=0)
+    with pytest.raises(ValueError, match="params"):
+        fresh.sync()
