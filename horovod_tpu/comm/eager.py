@@ -25,12 +25,15 @@ local math, so the same user program runs unmodified from a laptop to a
 pod — collectives over local devices belong to the SPMD layer instead.
 
 Pod shape (P > 1, D > 1 local devices): the eager data plane stays
-process-granularity — rank = process, and each process's contribution
-rides its FIRST local device (``Topology.proc_mesh``); the remaining
-local devices are deliberately not eager participants, they are the
-jit/SPMD path's compute surface (``world_mesh`` spans all P×D devices).
-``init()`` logs this at INFO so a D>1 profile of an eager-only program
-reads as designed behavior, not a bug.
+process-granularity — rank = process.  ``allreduce`` shards each
+process's contribution across ALL D local devices (``_multidev_mesh``:
+D parallel reduction lanes, each psumming 1/D of the payload — same
+numerics, D× the link bandwidth; ``HVTPU_EAGER_MULTIDEVICE=0``
+disables).  The other eager ops ride the process's FIRST local device
+(``Topology.proc_mesh``); either way the remaining devices are
+primarily the jit/SPMD path's compute surface (``world_mesh`` spans
+all P×D devices).  ``init()`` logs the layout at INFO so a D>1
+profile of an eager-only program reads as designed behavior.
 """
 
 from __future__ import annotations
@@ -45,7 +48,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import state as core_state
-from ..core.topology import DCN_AXIS, ICI_AXIS, PROC_AXIS
+from ..core.topology import DCN_AXIS, ICI_AXIS, LDEV_AXIS, PROC_AXIS
 from . import spmd
 from .compression import NoneCompressor
 from .reduce_ops import ReduceOp, normalize_op
@@ -81,6 +84,80 @@ def _stack_global(x, mesh: Mesh):
     return jax.make_array_from_single_device_arrays(
         (p,) + tuple(x.shape), sharding, [local]
     )
+
+
+def _multidev_mesh_or_none(ps):
+    """(proc, ldev) mesh for multi-lane eager allreduce, or None.
+
+    With D > 1 local devices per process, a single-transport-device
+    eager allreduce pushes the whole payload through ONE device's
+    links; sharding each process's contribution across its D local
+    devices gives D parallel reduction lanes (each lane psums 1/D of
+    the payload with its counterparts) — same numerics, D× the link
+    bandwidth.  Requires a uniform local device count across the set's
+    processes; disabled via ``HVTPU_EAGER_MULTIDEVICE=0``, which is
+    SNAPSHOTTED at init (all processes must agree or they would
+    compile mismatched collective programs and hang — the launcher
+    distributes the env uniformly, like HIERARCHICAL_ALLREDUCE).
+    """
+    cfg = core_state.global_state().config
+    if cfg is None or not cfg.eager_multidevice:
+        return None
+    mesh = getattr(ps, "_multidev_mesh", None)
+    if mesh is not None:
+        return mesh if mesh is not False else None
+    # collect every device of every member process
+    proc_devs = {}
+    member_procs = {d.process_index for d in ps.proc_mesh().devices.flat}
+    for d in jax.devices():
+        if d.process_index in member_procs:
+            proc_devs.setdefault(d.process_index, []).append(d)
+    counts = {len(v) for v in proc_devs.values()}
+    if len(counts) != 1 or counts == {1}:
+        ps._multidev_mesh = False
+        return None
+    grid = np.asarray(
+        [sorted(proc_devs[p], key=lambda d: d.id)
+         for p in sorted(proc_devs)],
+        dtype=object,
+    )
+    mesh = Mesh(grid, (PROC_AXIS, LDEV_AXIS))
+    ps._multidev_mesh = mesh
+    return mesh
+
+
+def _stack_global_multidev(x, mesh: Mesh):
+    """Global (P, D, chunk) f-contiguous array: shard (p, d) is process
+    p's d-th slice of its flattened (padded) tensor, resident on that
+    process's d-th device.  Returns (stacked, flat_size)."""
+    d_count = mesh.devices.shape[1]
+    flat = x.reshape(-1)
+    size = flat.shape[0]
+    chunk = -(-size // d_count)
+    pad = chunk * d_count - size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    p_count = mesh.devices.shape[0]
+    pid = jax.process_index()
+    local_row = None
+    for r, row in enumerate(mesh.devices):
+        if row[0].process_index == pid:
+            local_row = r
+            break
+    if local_row is None:
+        raise RuntimeError("process not a member of the multidev mesh")
+    sharding = NamedSharding(mesh, P(PROC_AXIS, LDEV_AXIS))
+    locals_ = [
+        jax.device_put(
+            flat[d * chunk:(d + 1) * chunk][None, None],
+            mesh.devices[local_row][d],
+        )
+        for d in range(d_count)
+    ]
+    stacked = jax.make_array_from_single_device_arrays(
+        (p_count, d_count, chunk), sharding, locals_
+    )
+    return stacked, size
 
 
 def _hierarchical_mesh_or_none(st, ps, p: int):
@@ -140,6 +217,33 @@ def _jitted(kind: str, mesh: Mesh, static: Tuple):
                 body,
                 mesh=mesh,
                 in_specs=(P(PROC_AXIS), P(), P()),
+                out_specs=P(),
+                check_vma=False,
+            )(stacked, prescale, postscale)
+
+        return jax.jit(fn)
+
+    if kind == "allreduce_multidev":
+        # D parallel reduction lanes: lane d psums 1/D of the payload
+        # over the proc axis, then the lanes all_gather so every
+        # device (and thus the process) holds the full result.
+        (rop, compression) = static
+
+        def fn(stacked, prescale, postscale):
+            def body(shard, pre, post):
+                x = shard[0, 0]
+                x = x * pre.astype(x.dtype)
+                out = spmd.allreduce(
+                    x, axis_name=PROC_AXIS, op=rop,
+                    compression=compression,
+                )
+                out = out * post.astype(out.dtype)
+                return lax.all_gather(out, LDEV_AXIS, tiled=True)
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(PROC_AXIS, LDEV_AXIS), P(), P()),
                 out_specs=P(),
                 check_vma=False,
             )(stacked, prescale, postscale)
@@ -357,7 +461,21 @@ def allreduce(
             if (rop == ReduceOp.ADASUM and hier is not None
                     and st.cross_size & (st.cross_size - 1)):
                 hier = None
-            if hier is None:
+            # int8 stays off the lane path: block-absmax quantization
+            # boundaries depend on the chunking, so per-lane chunks
+            # would change numerics vs the single-transport path
+            md = (None if (rop == ReduceOp.ADASUM or hier is not None
+                           or spmd._is_int8(compression))
+                  else _multidev_mesh_or_none(ps))
+            postprocess = None
+            if md is not None:
+                stacked, flat_size = _stack_global_multidev(x, md)
+                fn = _jitted("allreduce_multidev", md,
+                             (rop, compression))
+                postprocess = (
+                    lambda o: o[:flat_size].reshape(x.shape)
+                )
+            elif hier is None:
                 stacked = _stack_global(x, mesh)
                 fn = _jitted("allreduce", mesh, (rop, compression))
             elif rop == ReduceOp.ADASUM:
@@ -374,6 +492,8 @@ def allreduce(
                     jnp.asarray(postscale_factor, jnp.float32),
                 )
             )
+            if postprocess is not None:
+                out = postprocess(out)
         if timeline is not None:
             # Timeline mode trades async dispatch for accurate spans
             # (the reference's timeline also serializes op completion).

@@ -69,6 +69,10 @@ class Config:
     # two-stage eager allreduce over the (dcn, ici) process grid
     # (parity: HOROVOD_HIERARCHICAL_ALLREDUCE / NCCLHierarchicalAllreduce)
     hierarchical_allreduce: bool = False
+    # multi-lane eager allreduce across a process's local devices
+    # (snapshotted at init so a mid-run env flip cannot make one
+    # process compile a different collective program than its peers)
+    eager_multidevice: bool = True
     # set by the launcher when every host has the SAME slot count (0 =
     # non-uniform or unknown); hierarchical collectives require it so
     # all ranks agree on the (dcn, ici) grid
@@ -140,6 +144,7 @@ class Config:
             adasum=_env_bool("ADASUM", False),
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE",
                                              False),
+            eager_multidevice=_env_bool("EAGER_MULTIDEVICE", True),
             uniform_local_size=_env_int("UNIFORM_LOCAL_SIZE", 0),
             timeline_filename=_env_str("TIMELINE"),
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),

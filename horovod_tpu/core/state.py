@@ -184,13 +184,20 @@ def init(config: Optional[Config] = None) -> GlobalState:
         if _state.size > 1:
             n_local = _state.topology.num_local_devices
             if n_local > 1:
+                lanes = (
+                    f"allreduce streams over all {n_local} local "
+                    "lanes, other ops use the first local device"
+                    if cfg.eager_multidevice
+                    and not cfg.hierarchical_allreduce
+                    else "transport device = first local device"
+                )
                 _logging.getLogger("horovod_tpu").info(
                     "pod shape: %d processes x %d local devices; eager "
                     "collectives run at process granularity (rank = "
-                    "process, transport device = first local device); "
-                    "use the jit/SPMD path (world_mesh + shard_map) to "
-                    "engage all %d devices",
-                    _state.size, n_local, _state.size * n_local,
+                    "process; %s); the jit/SPMD path (world_mesh + "
+                    "shard_map) engages all %d devices",
+                    _state.size, n_local, lanes,
+                    _state.size * n_local,
                 )
 
         if cfg.timeline_filename:

@@ -35,6 +35,7 @@ WORLD_AXIS = "world"   # flat axis over all devices
 DCN_AXIS = "dcn"       # cross-host / cross-slice (data-center network)
 ICI_AXIS = "ici"       # intra-slice interconnect
 PROC_AXIS = "proc"     # one device per process (eager data plane)
+LDEV_AXIS = "ldev"     # local devices of a process (eager multi-lane)
 
 
 class Topology:

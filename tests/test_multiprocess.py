@@ -823,3 +823,94 @@ def test_hierarchical_adasum_4proc():
     want = adasum_reduce_reference([h0, h1])
     for r, out in results:
         np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_eager_multidevice_lanes_2proc_x_4dev():
+    """Multi-lane eager allreduce at the pod shape: each process's
+    payload is sharded across its 4 local devices (4 parallel
+    reduction lanes) with numerics identical to the process-level
+    contract, across ops/dtypes/odd sizes; HVTPU_EAGER_MULTIDEVICE=0
+    falls back to the single-transport-device path with equal
+    results."""
+    import numpy as np
+
+    def body():
+        import os
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvt
+        from jax.sharding import Mesh
+
+        hvt.init()
+        r = hvt.rank()
+        assert hvt.size() == 2 and jax.local_device_count() == 4
+        out = {}
+
+        x = jnp.arange(1000, dtype=jnp.float32) + 1000.0 * r
+        out["sum"] = np.asarray(hvt.allreduce(x, op=hvt.Sum)).tolist()
+        out["mx"] = np.asarray(
+            hvt.allreduce(jnp.full((7,), float(r)), op=hvt.Max)
+        ).tolist()
+        out["bf16"] = np.asarray(hvt.allreduce(
+            jnp.full((9,), 2.0, jnp.bfloat16), op=hvt.Average
+        ).astype(jnp.float32)).tolist()
+        out["int_avg"] = np.asarray(hvt.allreduce(
+            jnp.full((3,), 3 + r, jnp.int32), op=hvt.Average
+        )).tolist()
+
+        # the multi-lane mesh actually engaged (cached on the set)
+        st = hvt.core.state.global_state()
+        gset = st.process_set_table.global_process_set
+        out["lanes"] = isinstance(
+            getattr(gset, "_multidev_mesh", None), Mesh
+        )
+
+        # mid-run env flips must have NO effect: the flag is
+        # snapshotted at init (divergent per-process settings would
+        # compile mismatched collective programs and hang)
+        os.environ["HVTPU_EAGER_MULTIDEVICE"] = "0"
+        out["sum_after_flip"] = np.asarray(
+            hvt.allreduce(x, op=hvt.Sum, name="flip")
+        ).tolist()
+        out["lanes_after_flip"] = isinstance(
+            getattr(gset, "_multidev_mesh", None), Mesh
+        )
+        os.environ.pop("HVTPU_EAGER_MULTIDEVICE")
+        return (r, out)
+
+    results = _run(body, np=2, cpu_devices=4)
+    want_sum = (np.arange(1000) * 2 + 1000.0).tolist()
+    for _, out in sorted(results):
+        assert out["sum"] == want_sum
+        assert out["mx"] == [1.0] * 7
+        assert out["bf16"] == [2.0] * 9
+        assert out["int_avg"] == [3] * 3  # floor((3 + 4)/2)
+        assert out["lanes"] is True
+        assert out["sum_after_flip"] == want_sum
+        assert out["lanes_after_flip"] is True
+
+    # uniform opt-out (launcher-distributed env): single-transport
+    # fallback with identical numbers
+    def body_single():
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvt
+
+        hvt.init()
+        r = hvt.rank()
+        x = jnp.arange(1000, dtype=jnp.float32) + 1000.0 * r
+        s = np.asarray(hvt.allreduce(x, op=hvt.Sum)).tolist()
+        st = hvt.core.state.global_state()
+        gset = st.process_set_table.global_process_set
+        return (r, s, getattr(gset, "_multidev_mesh", None) is None)
+
+    results = run(body_single, np=2, cpu_devices=4,
+                  env={**_ENV, "HVTPU_EAGER_MULTIDEVICE": "0"},
+                  start_timeout=300.0)
+    for _, s, no_lanes in sorted(results):
+        assert s == want_sum
+        assert no_lanes
