@@ -21,19 +21,53 @@ from ..comm import eager
 from ..core import state as core_state
 
 
+def _write_back(container, new):
+    """Update mutable containers (dict/list) in place with the new
+    leaves so the reference's statement-style call pattern
+    (``hvd.broadcast_parameters(state_dict)``) works — a user migrating
+    from the in-place torch API would otherwise silently keep the old
+    values.  Tuples are immutable but their MUTABLE descendants are
+    still updated in place (a dict held from inside a tuple must not
+    go stale); the functional return value is always complete.
+    Structure mismatches raise (tree_map guarantees matching trees, so
+    a mismatch is a bug, not something to skip silently)."""
+    if isinstance(container, dict) and isinstance(new, dict):
+        for k in container:
+            child = _write_back(container[k], new[k])
+            if child is not None:
+                container[k] = child
+        return None
+    if isinstance(container, list) and isinstance(new, list):
+        for i in range(len(container)):
+            child = _write_back(container[i], new[i])
+            if child is not None:
+                container[i] = child
+        return None
+    if isinstance(container, tuple) and isinstance(new, tuple):
+        for c, n in zip(container, new):
+            _write_back(c, n)
+        return new  # the tuple slot itself is replaced by the parent
+    return new  # leaf (or other immutable node): caller assigns
+
+
 def broadcast_parameters(params, root_rank: int = 0, process_set=None):
     """Broadcast a pytree of arrays from ``root_rank`` to all ranks.
 
-    Returns the broadcast tree (functional, unlike the reference's
-    in-place torch version — JAX arrays are immutable).
+    Returns the broadcast tree; when ``params`` is built of mutable
+    containers (dicts/lists), their leaves are ALSO updated in place so
+    the reference's statement-style idiom works unchanged.  (JAX
+    arrays themselves are immutable — in-place here means container
+    slots, not buffers.)
     """
     core_state.require_init("broadcast_parameters")
-    return jax.tree_util.tree_map(
+    new = jax.tree_util.tree_map(
         lambda t: eager.broadcast(
             jnp.asarray(t), root_rank=root_rank, process_set=process_set
         ),
         params,
     )
+    _write_back(params, new)
+    return new
 
 
 def broadcast_optimizer_state(opt_state, root_rank: int = 0, process_set=None):
@@ -48,7 +82,10 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0, process_set=None):
             )
         return broadcast_object(t, root_rank=root_rank, process_set=process_set)
 
-    return jax.tree_util.tree_map(bcast_leaf, opt_state)
+    new = jax.tree_util.tree_map(bcast_leaf, opt_state)
+    # same statement-style ergonomics as broadcast_parameters
+    _write_back(opt_state, new)
+    return new
 
 
 def broadcast_object(obj: Any, root_rank: int = 0, process_set=None) -> Any:

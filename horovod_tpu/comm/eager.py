@@ -86,6 +86,14 @@ def _stack_global(x, mesh: Mesh):
     )
 
 
+# Below this payload size the lane path is pure overhead (pad-to-D, D
+# device_puts, an extra all_gather) with no bandwidth to parallelize —
+# e.g. broadcast_object's 4-byte size header.  All ranks see the same
+# tensor size for a given collective, so size-based routing is
+# rank-consistent.
+_MULTIDEV_MIN_BYTES = 64 * 1024
+
+
 def _multidev_mesh_or_none(ps):
     """(proc, ldev) mesh for multi-lane eager allreduce, or None.
 
@@ -356,6 +364,31 @@ def _jitted(kind: str, mesh: Mesh, static: Tuple):
 
         return jax.jit(fn)
 
+    if kind == "broadcast_multidev":
+        # lane-parallel broadcast: lane d moves 1/D of the root's
+        # payload over the proc axis, then the lanes all_gather —
+        # broadcast_parameters' fused startup buffer rides all local
+        # links instead of one
+        (root_rank,) = static
+
+        def fn(stacked):
+            def body(shard):
+                out = spmd.broadcast(
+                    shard[0, 0], root_rank=root_rank,
+                    axis_name=PROC_AXIS,
+                )
+                return lax.all_gather(out, LDEV_AXIS, tiled=True)
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(PROC_AXIS, LDEV_AXIS),),
+                out_specs=P(),
+                check_vma=False,
+            )(stacked)
+
+        return jax.jit(fn)
+
     if kind == "reducescatter":
         (rop,) = static
 
@@ -465,7 +498,8 @@ def allreduce(
             # boundaries depend on the chunking, so per-lane chunks
             # would change numerics vs the single-transport path
             md = (None if (rop == ReduceOp.ADASUM or hier is not None
-                           or spmd._is_int8(compression))
+                           or spmd._is_int8(compression)
+                           or x.nbytes < _MULTIDEV_MIN_BYTES)
                   else _multidev_mesh_or_none(ps))
             postprocess = None
             if md is not None:
@@ -588,6 +622,14 @@ def broadcast(tensor, *, root_rank: int = 0, process_set=None):
             f"root_rank {root_rank} is not a member of process set "
             f"{ps.process_set_id} (ranks {ps.ranks})"
         )
+    md = (None if x.nbytes < _MULTIDEV_MIN_BYTES
+          else _multidev_mesh_or_none(ps))
+    if md is not None:
+        stacked, flat_size = _stack_global_multidev(x, md)
+        out = _fetch(
+            _jitted("broadcast_multidev", md, (root_in_set,))(stacked)
+        )
+        return out[:flat_size].reshape(x.shape)
     stacked = _stack_global(x, mesh)
     out = _jitted("broadcast", mesh, (root_in_set,))(stacked)
     return _fetch(out)

@@ -174,3 +174,36 @@ def test_adasum_rejects_int8_compression(hvt):
     with _pytest.raises(ValueError, match="Adasum"):
         hvt.allreduce(jnp.ones(8), op=hvt.Adasum,
                       compression=Compression.int8)
+
+
+def test_broadcast_parameters_updates_mutable_containers(hvt):
+    """Reference ergonomics: statement-style
+    hvd.broadcast_parameters(state_dict) must take effect — leaves in
+    mutable containers are updated in place (the functional return is
+    also complete).  numpy leaves make this non-vacuous: broadcast
+    returns NEW jax arrays, so without the write-back the containers
+    would still hold the numpy originals."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    params = {"w": np.zeros((4,), np.float32),
+              "inner": {"b": np.ones((2,), np.float32)},
+              "lst": [np.full((3,), 2.0, np.float32)],
+              "tup": ({"t": np.full((2,), 5.0, np.float32)},)}
+    inner_tuple_dict = params["tup"][0]
+    ret = hvt.broadcast_parameters(params, root_rank=0)
+    assert params["w"] is ret["w"]
+    assert isinstance(params["w"], jax.Array)
+    assert params["inner"]["b"] is ret["inner"]["b"]
+    assert params["lst"][0] is ret["lst"][0]
+    # mutable dict held from inside an (immutable) tuple is updated too
+    assert isinstance(inner_tuple_dict["t"], jax.Array)
+    np.testing.assert_array_equal(np.asarray(inner_tuple_dict["t"]),
+                                  np.full((2,), 5.0))
+
+    # broadcast_optimizer_state gets the same ergonomics
+    opt_state = {"m": np.zeros((3,), np.float32), "step": 7}
+    ret2 = hvt.broadcast_optimizer_state(opt_state, root_rank=0)
+    assert opt_state["m"] is ret2["m"]
+    assert isinstance(opt_state["m"], jax.Array)
