@@ -11,6 +11,14 @@ Run:  python examples/transformer_hybrid.py --cpu-devices 8
 """
 
 import argparse
+import os
+import sys
+
+# source-checkout convenience: this example is run directly (no
+# launcher to inject PYTHONPATH), so make the repo root importable
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
 def main():

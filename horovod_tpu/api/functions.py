@@ -53,6 +53,13 @@ def _write_back(container, new):
 def broadcast_parameters(params, root_rank: int = 0, process_set=None):
     """Broadcast a pytree of arrays from ``root_rank`` to all ranks.
 
+    All leaves ride ONE fused byte buffer (packed in parallel by the
+    native thread pool — the same FusionBufferManager-style fast path
+    as the torch frontend's broadcast_parameters): one collective and
+    one compiled program for the whole startup fan-out instead of one
+    per leaf, which also lets the pod-shape multi-lane transport
+    engage (per-leaf payloads rarely clear its size threshold).
+
     Returns the broadcast tree; when ``params`` is built of mutable
     containers (dicts/lists), their leaves are ALSO updated in place so
     the reference's statement-style idiom works unchanged.  (JAX
@@ -60,12 +67,47 @@ def broadcast_parameters(params, root_rank: int = 0, process_set=None):
     slots, not buffers.)
     """
     core_state.require_init("broadcast_parameters")
-    new = jax.tree_util.tree_map(
-        lambda t: eager.broadcast(
-            jnp.asarray(t), root_rank=root_rank, process_set=process_set
-        ),
-        params,
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if len(leaves) <= 1:
+        new = jax.tree_util.tree_map(
+            lambda t: eager.broadcast(
+                jnp.asarray(t), root_rank=root_rank,
+                process_set=process_set
+            ),
+            params,
+        )
+        _write_back(params, new)
+        return new
+
+    from ..native import core as native_core
+
+    vals = [np.ascontiguousarray(np.asarray(jnp.asarray(l)))
+            for l in leaves]
+    shapes = [v.shape for v in vals]
+    dtypes = [v.dtype for v in vals]
+    views = [v.reshape(-1).view(np.uint8) for v in vals]
+    total = sum(v.nbytes for v in views)
+    buf = np.empty(total, np.uint8)
+    native_core.parallel_gather(
+        memoryview(buf), [memoryview(v) for v in views]
     )
+    out = np.asarray(eager.broadcast(
+        jnp.asarray(buf), root_rank=root_rank, process_set=process_set
+    ))
+    pieces = []
+    off = 0
+    for shape, dtype, v in zip(shapes, dtypes, vals):
+        n = v.nbytes
+        chunk = out[off:off + n]
+        try:
+            piece = chunk.view(dtype).reshape(shape)
+        except ValueError:  # unaligned offset for this dtype
+            piece = np.frombuffer(
+                chunk.tobytes(), dtype=dtype
+            ).reshape(shape)
+        pieces.append(jnp.asarray(piece))
+        off += n
+    new = jax.tree_util.tree_unflatten(treedef, pieces)
     _write_back(params, new)
     return new
 
@@ -83,6 +125,16 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0, process_set=None):
         return broadcast_object(t, root_rank=root_rank, process_set=process_set)
 
     new = jax.tree_util.tree_map(bcast_leaf, opt_state)
+    # Reference parity: scalar state entries (step counters, lr floats)
+    # come back as Python scalars, not 0-d arrays — the torch version
+    # casts back after the wire trip, and the in-place write-back must
+    # not clobber the caller's dict with un-serializable Arrays.
+    new = jax.tree_util.tree_map(
+        lambda orig, n: (type(orig)(n.item())
+                         if isinstance(orig, (bool, int, float))
+                         and hasattr(n, "item") else n),
+        opt_state, new,
+    )
     # same statement-style ergonomics as broadcast_parameters
     _write_back(opt_state, new)
     return new

@@ -25,12 +25,13 @@ local math, so the same user program runs unmodified from a laptop to a
 pod — collectives over local devices belong to the SPMD layer instead.
 
 Pod shape (P > 1, D > 1 local devices): the eager data plane stays
-process-granularity — rank = process.  ``allreduce`` shards each
-process's contribution across ALL D local devices (``_multidev_mesh``:
-D parallel reduction lanes, each psumming 1/D of the payload — same
-numerics, D× the link bandwidth; ``HVTPU_EAGER_MULTIDEVICE=0``
-disables).  The other eager ops ride the process's FIRST local device
-(``Topology.proc_mesh``); either way the remaining devices are
+process-granularity — rank = process.  ``allreduce`` AND ``broadcast``
+shard payloads of at least ``_MULTIDEV_MIN_BYTES`` across ALL D local
+devices (``_multidev_mesh``: D parallel lanes, each moving 1/D of the
+payload — same numerics, D× the link bandwidth;
+``HVTPU_EAGER_MULTIDEVICE=0`` disables, snapshotted at init).  Smaller
+payloads and the other eager ops ride the process's FIRST local
+device (``Topology.proc_mesh``); either way the remaining devices are
 primarily the jit/SPMD path's compute surface (``world_mesh`` spans
 all P×D devices).  ``init()`` logs the layout at INFO so a D>1
 profile of an eager-only program reads as designed behavior.

@@ -207,3 +207,52 @@ def test_broadcast_parameters_updates_mutable_containers(hvt):
     ret2 = hvt.broadcast_optimizer_state(opt_state, root_rank=0)
     assert opt_state["m"] is ret2["m"]
     assert isinstance(opt_state["m"], jax.Array)
+
+
+def test_broadcast_parameters_fuses_one_collective(hvt, monkeypatch):
+    """N leaves must ride ONE fused byte-buffer broadcast (the torch
+    frontend's FusionBufferManager-style fast path), not N per-leaf
+    collectives/compilations."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.comm import eager as eager_comm
+
+    calls = []
+    real = eager_comm.broadcast
+
+    def spy(tensor, **kw):
+        calls.append(np.asarray(tensor).nbytes)
+        return real(tensor, **kw)
+
+    monkeypatch.setattr(eager_comm, "broadcast", spy)
+    import horovod_tpu.api.functions as fns
+
+    params = {"w": jnp.ones((10, 3)), "b": jnp.zeros((7,)),
+              "s": jnp.full((2,), 2.0, jnp.bfloat16)}
+    out = fns.broadcast_parameters(params, root_rank=0)
+    assert len(calls) == 1
+    assert calls[0] == 10 * 3 * 4 + 7 * 4 + 2 * 2
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.ones((10, 3)))
+    assert out["s"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out["s"].astype(jnp.float32)), np.full((2,), 2.0))
+
+
+def test_broadcast_optimizer_state_preserves_scalar_types(hvt):
+    """Reference parity: Python scalar state entries come back as
+    Python scalars (torch's version casts back after the wire trip) —
+    the in-place write-back must not clobber the caller's dict with
+    un-serializable 0-d Arrays."""
+    import json
+
+    import numpy as np
+
+    opt = {"step": 7, "lr": 0.01, "nesterov": True,
+           "m": np.zeros((3,), np.float32)}
+    ret = hvt.broadcast_optimizer_state(opt, root_rank=0)
+    assert type(opt["step"]) is int and opt["step"] == 7
+    assert type(opt["lr"]) is float and abs(opt["lr"] - 0.01) < 1e-9
+    assert type(opt["nesterov"]) is bool
+    json.dumps({k: v for k, v in opt.items() if k != "m"})  # serializable
+    assert type(ret["step"]) is int
