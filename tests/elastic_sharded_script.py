@@ -1,0 +1,69 @@
+"""Elastic training script holding GLOBAL sharded arrays
+(ShardedJaxState) — used by the pod-resize fault-injection test: the
+driver relaunches at a different world size and sync() must reshard
+the committed params onto the new global mesh.
+
+Each epoch adds +1 to every element of a world-sharded parameter
+vector, so the committed value encodes exactly how many epochs ran —
+replays or lost state are immediately visible.
+"""
+
+import os
+import sys
+import time
+
+
+def main():
+    import numpy as np
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvt
+    import horovod_tpu.elastic as elastic
+
+    hvt.init()
+    epochs = int(os.environ.get("ELASTIC_EPOCHS", "6"))
+    sleep_s = float(os.environ.get("EPOCH_SLEEP", "0.3"))
+
+    mesh = hvt.world_mesh()
+    n_dev = mesh.devices.size
+    init_w = np.zeros((24, 4), np.float32)  # divisible by 4 AND 6 devices
+    state = elastic.ShardedJaxState(
+        params=jax.make_array_from_callback(
+            init_w.shape, NamedSharding(mesh, P("world")),
+            lambda i: init_w[i]),
+        epoch=0,
+    )
+
+    @elastic.run
+    def train(state):
+        import jax.numpy as jnp
+
+        while state.epoch < epochs:
+            # one "step": params += 1 everywhere (value == epochs run)
+            state.params = jax.tree_util.tree_map(
+                lambda a: a + jnp.ones_like(a), state.params
+            )
+            state.epoch += 1
+            if hvt.rank() == 0:
+                first = float(np.asarray(
+                    state.params.addressable_data(0)).ravel()[0])
+                print(
+                    f"EPOCH epoch={state.epoch} size={hvt.size()} "
+                    f"ndev={n_dev} w0={first}",
+                    flush=True,
+                )
+            time.sleep(sleep_s)
+            state.commit()
+
+    train(state)
+    if hvt.rank() == 0:
+        final = float(np.asarray(
+            state.params.addressable_data(0)).ravel()[0])
+        print(f"DONE size={hvt.size()} epoch={state.epoch} w0={final}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,7 +34,7 @@ def _make_discovery(tmp_path, spec: str):
 
 
 def _launch(discovery_script, extra_env=None, min_np=2, max_np=None,
-            epochs=6, sleep_s=0.3):
+            epochs=6, sleep_s=0.3, cpu_devices=1, script=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["ELASTIC_EPOCHS"] = str(epochs)
@@ -45,11 +45,11 @@ def _launch(discovery_script, extra_env=None, min_np=2, max_np=None,
         sys.executable, "-m", "horovod_tpu.runner",
         "--host-discovery-script", discovery_script,
         "--min-np", str(min_np),
-        "--cpu-devices", "1", "--verbose",
+        "--cpu-devices", str(cpu_devices), "--verbose",
     ]
     if max_np:
         cmd += ["--max-np", str(max_np)]
-    cmd += ["--", sys.executable, _SCRIPT]
+    cmd += ["--", sys.executable, script or _SCRIPT]
     return subprocess.Popen(
         cmd, env=env, cwd=_REPO, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True,
@@ -223,3 +223,36 @@ def test_blacklist_after_three_strikes(tmp_path):
     assert "blacklisting localhost" in out, out[-3000:]
     assert "launching 1 workers on 127.0.0.1:1" in out, out[-3000:]
     assert "DONE size=1 epoch=5" in out, out[-3000:]
+
+
+_SHARDED_SCRIPT = os.path.join(_REPO, "tests", "elastic_sharded_script.py")
+
+
+def test_elastic_resize_with_sharded_global_arrays(tmp_path):
+    """The full pod resize-resume loop: workers hold GLOBAL
+    world-sharded arrays (ShardedJaxState, 2 devices per worker);
+    discovery grows 2 -> 3 workers mid-run, the driver relaunches, and
+    the committed params reshard onto the LARGER global mesh (4 -> 6
+    devices) with progress exactly preserved (w0 counts epochs run —
+    any replay or loss shows up in the final value)."""
+    hosts_file, disc = _make_discovery(tmp_path, "localhost:2")
+    proc = _launch(disc, min_np=2, epochs=8, sleep_s=0.4,
+                   cpu_devices=2, script=_SHARDED_SCRIPT)
+    state = {"grown": False}
+
+    def on_line(line):
+        if not state["grown"] and "EPOCH epoch=2 " in line:
+            hosts_file.write_text("localhost:3\n")
+            state["grown"] = True
+
+    lines = _stream_until_exit(proc, on_line)
+    out = "\n".join(lines)
+    assert proc.returncode == 0, out[-3000:]
+    assert state["grown"], out[-2000:]
+    assert any("size=2 ndev=4" in ln for ln in lines), out[-3000:]
+    assert any("size=3 ndev=6" in ln for ln in lines), out[-3000:]
+    # progress exactly preserved: w0 == epochs run, monotone epochs
+    assert "DONE size=3 epoch=8 w0=8.0" in out, out[-3000:]
+    epochs_seen = [int(ln.split("epoch=")[1].split()[0])
+                   for ln in lines if "EPOCH epoch=" in ln]
+    assert epochs_seen == sorted(epochs_seen), out[-3000:]
