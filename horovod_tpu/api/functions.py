@@ -79,34 +79,14 @@ def broadcast_parameters(params, root_rank: int = 0, process_set=None):
         _write_back(params, new)
         return new
 
-    from ..native import core as native_core
+    from ..comm.packing import pack_bytes, unpack_bytes
 
-    vals = [np.ascontiguousarray(np.asarray(jnp.asarray(l)))
-            for l in leaves]
-    shapes = [v.shape for v in vals]
-    dtypes = [v.dtype for v in vals]
-    views = [v.reshape(-1).view(np.uint8) for v in vals]
-    total = sum(v.nbytes for v in views)
-    buf = np.empty(total, np.uint8)
-    native_core.parallel_gather(
-        memoryview(buf), [memoryview(v) for v in views]
-    )
+    raws = [np.asarray(jnp.asarray(l)) for l in leaves]
+    buf, specs = pack_bytes(raws)
     out = np.asarray(eager.broadcast(
         jnp.asarray(buf), root_rank=root_rank, process_set=process_set
     ))
-    pieces = []
-    off = 0
-    for shape, dtype, v in zip(shapes, dtypes, vals):
-        n = v.nbytes
-        chunk = out[off:off + n]
-        try:
-            piece = chunk.view(dtype).reshape(shape)
-        except ValueError:  # unaligned offset for this dtype
-            piece = np.frombuffer(
-                chunk.tobytes(), dtype=dtype
-            ).reshape(shape)
-        pieces.append(jnp.asarray(piece))
-        off += n
+    pieces = [jnp.asarray(p) for p in unpack_bytes(out, specs)]
     new = jax.tree_util.tree_unflatten(treedef, pieces)
     _write_back(params, new)
     return new

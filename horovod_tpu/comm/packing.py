@@ -33,3 +33,57 @@ def unpack_flat(flat, specs) -> List[Any]:
         outs.append(flat[off : off + size].reshape(shape).astype(dtype))
         off += size
     return outs
+
+
+def pack_bytes(raws, parallel: bool = True):
+    """Pack host arrays into ONE uint8 buffer, byte-exact per dtype
+    (the native-thread-pool fused path of broadcast_parameters /
+    broadcast_variables — unlike :func:`pack_flat` there is no dtype
+    promotion: each leaf's bytes ride verbatim).
+
+    ``raws``: numpy arrays (any dtype incl. ml_dtypes bf16).  Returns
+    ``(buf, specs)`` with specs = [(shape, dtype, nbytes), ...].
+    NOTE: shapes are recorded BEFORE ``ascontiguousarray``, which
+    promotes 0-d arrays to 1-d — the bug this helper exists to fix
+    exactly once.
+    """
+    import numpy as np
+
+    shapes = [r.shape for r in raws]
+    vals = [np.ascontiguousarray(r) for r in raws]
+    views = [v.reshape(-1).view(np.uint8) for v in vals]
+    buf = np.empty(sum(v.nbytes for v in views), np.uint8)
+    if parallel:
+        from ..native import core as native_core
+
+        native_core.parallel_gather(
+            memoryview(buf), [memoryview(v) for v in views]
+        )
+    else:  # pragma: no cover - used only where native core is absent
+        off = 0
+        for v in views:
+            buf[off:off + v.nbytes] = v
+            off += v.nbytes
+    specs = [(s, v.dtype, v.nbytes)
+             for s, v in zip(shapes, vals)]
+    return buf, specs
+
+
+def unpack_bytes(buf, specs):
+    """Inverse of :func:`pack_bytes` → list of numpy arrays (views
+    where alignment allows, copies otherwise)."""
+    import numpy as np
+
+    out = []
+    off = 0
+    for shape, dtype, nbytes in specs:
+        chunk = buf[off:off + nbytes]
+        try:
+            piece = chunk.view(dtype).reshape(shape)
+        except ValueError:  # unaligned offset for this dtype
+            piece = np.frombuffer(
+                chunk.tobytes(), dtype=dtype
+            ).reshape(shape)
+        out.append(piece)
+        off += nbytes
+    return out

@@ -81,41 +81,22 @@ def broadcast_variables(variables, root_rank: int = 0, process_set=None):
     """
     import numpy as np
 
-    from ..native import core as native_core
-
     variables = [v for v in variables if v is not None]
     if not variables:
         return
     if len(variables) == 1 or not tf.executing_eagerly():
         _broadcast_variables_graph(variables, root_rank, process_set)
         return
-    raws = [v.numpy() for v in variables]
-    # NB: np.ascontiguousarray promotes 0-d to 1-d; keep true shapes
-    shapes = [r.shape for r in raws]
-    vals = [np.ascontiguousarray(r) for r in raws]
-    views = [val.reshape(-1).view(np.uint8) for val in vals]
-    total = sum(v.nbytes for v in views)
-    buf = np.empty(total, np.uint8)
-    native_core.parallel_gather(
-        memoryview(buf), [memoryview(v) for v in views]
-    )
     from ..comm import eager as _eager_comm
+    from ..comm.packing import pack_bytes, unpack_bytes
 
+    raws = [v.numpy() for v in variables]
+    buf, specs = pack_bytes(raws)
     out = np.asarray(_eager_comm.broadcast(
         buf, root_rank=root_rank, process_set=process_set
     ))
-    off = 0
-    for var, val, shape in zip(variables, vals, shapes):
-        n = val.nbytes
-        chunk = out[off:off + n]
-        try:
-            piece = chunk.view(val.dtype).reshape(shape)
-        except ValueError:  # unaligned offset for this dtype
-            piece = np.frombuffer(
-                chunk.tobytes(), dtype=val.dtype
-            ).reshape(shape)
+    for var, piece in zip(variables, unpack_bytes(out, specs)):
         var.assign(piece)
-        off += n
 
 
 def _broadcast_variables_graph(variables, root_rank, process_set):

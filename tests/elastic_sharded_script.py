@@ -9,7 +9,6 @@ replays or lost state are immediately visible.
 """
 
 import os
-import sys
 import time
 
 

@@ -229,10 +229,12 @@ def test_broadcast_parameters_fuses_one_collective(hvt, monkeypatch):
     import horovod_tpu.api.functions as fns
 
     params = {"w": jnp.ones((10, 3)), "b": jnp.zeros((7,)),
-              "s": jnp.full((2,), 2.0, jnp.bfloat16)}
+              "s": jnp.full((2,), 2.0, jnp.bfloat16),
+              "scalar": jnp.float32(4.0)}
     out = fns.broadcast_parameters(params, root_rank=0)
     assert len(calls) == 1
-    assert calls[0] == 10 * 3 * 4 + 7 * 4 + 2 * 2
+    assert calls[0] == 10 * 3 * 4 + 7 * 4 + 2 * 2 + 4
+    assert out["scalar"].shape == () and float(out["scalar"]) == 4.0
     np.testing.assert_array_equal(np.asarray(out["w"]), np.ones((10, 3)))
     assert out["s"].dtype == jnp.bfloat16
     np.testing.assert_array_equal(
