@@ -109,6 +109,33 @@ def cross_size() -> int:
     return _state.require_init("cross_size()").cross_size
 
 
+def is_homogeneous() -> bool:
+    """True when every host runs the same number of ranks (parity:
+    ``hvd.is_homogeneous``).  Upstream allgathers local sizes; here a
+    single-host world (``cross_size == 1``) is provably homogeneous
+    from held state, and multi-host worlds rely on the launcher's
+    uniformity certificate (``HVTPU_UNIFORM_LOCAL_SIZE``)."""
+    st = _state.require_init("is_homogeneous()")
+    if st.size == 1 or st.cross_size == 1:
+        return True
+    return bool(st.config and st.config.uniform_local_size > 0)
+
+
+def __getattr__(name: str):
+    # PEP 562: `hvt.global_process_set` mirrors the reference's
+    # module-level attribute (horovod/common/process_sets.py) while
+    # resolving to the LIVE table entry, which only exists after init.
+    # Must raise AttributeError (never NotInitializedError) so
+    # hasattr/getattr-with-default probes keep their contract.
+    if name == "global_process_set":
+        if not _state.initialized():
+            raise AttributeError(
+                "global_process_set is available after hvt.init()"
+            )
+        return _state.global_state().process_set_table.global_process_set
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 def num_devices() -> int:
     """Total accelerator devices in the job (devices ≠ ranks on TPU:
     one process drives many chips)."""
@@ -487,6 +514,7 @@ __all__ = [
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
     "Checkpointer", "save_checkpoint", "restore_checkpoint",
+    "is_homogeneous",
     "ShardedCheckpointer",
     "Compression", "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max",
     "Product",

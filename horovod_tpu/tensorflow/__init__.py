@@ -69,6 +69,38 @@ from .mpi_ops import (  # noqa: E402
 # variable / object helpers
 # ---------------------------------------------------------------------------
 
+is_homogeneous = _hvt.is_homogeneous
+
+
+def size_op(process_set_id: int = 0, name=None):
+    """Graph-usable size of the given process set (parity:
+    hvd.size_op).  The value is fixed for the life of the (static)
+    job, so a constant tensor is the faithful TPU-native lowering."""
+    if process_set_id == 0:
+        n = size()
+    else:
+        st = _hvt.core.state.require_init("size_op")
+        n = st.process_set_table.get(process_set_id).size
+    return tf.constant(n, tf.int32, name=name or "horovod_size")
+
+
+def rank_op(name=None):
+    """Graph-usable rank (parity: hvd.rank_op)."""
+    return tf.constant(rank(), tf.int32, name=name or "horovod_rank")
+
+
+def local_rank_op(name=None):
+    """Graph-usable local rank (parity: hvd.local_rank_op)."""
+    return tf.constant(local_rank(), tf.int32,
+                       name=name or "horovod_local_rank")
+
+
+def local_size_op(name=None):
+    """Graph-usable local size (parity: hvd.local_size_op)."""
+    return tf.constant(local_size(), tf.int32,
+                       name=name or "horovod_local_size")
+
+
 def broadcast_variables(variables, root_rank: int = 0, process_set=None):
     """Assign every variable its root-rank value (parity:
     hvd.broadcast_variables).
@@ -313,5 +345,15 @@ __all__ = [
     "allreduce", "grouped_allreduce", "allgather", "broadcast",
     "alltoall", "reducescatter", "barrier", "join",
     "broadcast_variables", "broadcast_object", "allgather_object",
+    "is_homogeneous", "size_op", "rank_op", "local_rank_op",
+    "local_size_op",
     "Compression", "DistributedGradientTape", "DistributedOptimizer",
 ]
+
+
+def __getattr__(name: str):
+    # forward the live module attribute (parity: per-frontend
+    # hvd.global_process_set); AttributeError keeps hasattr contracts
+    if name == "global_process_set":
+        return getattr(_hvt, "global_process_set")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

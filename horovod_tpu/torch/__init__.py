@@ -30,6 +30,7 @@ local_rank = _hvt.local_rank
 local_size = _hvt.local_size
 cross_rank = _hvt.cross_rank
 cross_size = _hvt.cross_size
+is_homogeneous = _hvt.is_homogeneous
 mpi_enabled = _hvt.mpi_enabled
 mpi_built = _hvt.mpi_built
 mpi_threads_supported = _hvt.mpi_threads_supported
@@ -117,3 +118,11 @@ __all__ = [
     "broadcast_object", "allgather_object",
     "DistributedOptimizer", "SyncBatchNorm",
 ]
+
+
+def __getattr__(name: str):
+    # forward the live module attribute (parity: per-frontend
+    # hvd.global_process_set); AttributeError keeps hasattr contracts
+    if name == "global_process_set":
+        return getattr(_hvt, "global_process_set")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -129,3 +129,22 @@ class TestProcessSets:
         # possible with real devices; exercise the partition math via
         # explicit groups on the SPMD API instead (test_spmd_collectives).
         pass
+
+
+class TestSmallParitySurface:
+    def test_is_homogeneous_single_process(self, hvt):
+        assert hvt.is_homogeneous() is True
+
+    def test_global_process_set_attribute(self, hvt):
+        gps = hvt.global_process_set
+        assert gps.process_set_id == 0
+
+    def test_global_process_set_requires_init(self):
+        import horovod_tpu as mod
+
+        if mod.is_initialized():
+            mod.shutdown()
+        # AttributeError (not NotInitializedError): hasattr/getattr
+        # probes must keep their contract pre-init
+        assert not hasattr(mod, "global_process_set")
+        assert getattr(mod, "global_process_set", None) is None

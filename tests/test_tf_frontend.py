@@ -361,3 +361,29 @@ class TestGraphModeBroadcastFusion:
         do()
         for i, v in enumerate(vs):
             np.testing.assert_allclose(v.numpy(), np.full((3,), i + 1.0))
+
+
+class TestGraphTopologyOps:
+    def test_size_rank_ops_in_graph(self, hvt):
+        import horovod_tpu.tensorflow as hvd_tf
+
+        @tf.function
+        def f():
+            return (hvd_tf.size_op() + hvd_tf.rank_op()
+                    + hvd_tf.local_rank_op() + hvd_tf.local_size_op())
+
+        assert int(f().numpy()) == 1 + 0 + 0 + 1
+        assert hvd_tf.is_homogeneous() is True
+
+
+def test_size_op_and_global_process_set(hvt):
+    import pytest as _pytest
+
+    import horovod_tpu.tensorflow as hvd_tf
+
+    assert int(hvd_tf.size_op().numpy()) == 1
+    assert hvd_tf.global_process_set.process_set_id == 0
+    # non-global ids resolve through the live table (unknown id raises
+    # rather than silently returning world size)
+    with _pytest.raises(ValueError):
+        hvd_tf.size_op(process_set_id=42)
