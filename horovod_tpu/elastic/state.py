@@ -264,7 +264,19 @@ class ShardedJaxState(JaxState):
         # only reads, so sharing the snapshot is safe)
         arrays, rest = self._split(self._saved)
         ckpt = ShardedCheckpointer(d)
-        step = (ckpt.latest_step() or 0) + 1
+        # Rank 0 ALONE picks the step number and broadcasts it: a
+        # per-rank latest_step() is a shared-filesystem directory
+        # listing, and NFS attribute/dircache skew can make ranks
+        # disagree — shards then land in different step_NNN dirs and
+        # the committed step is incomplete (same hazard class sync()
+        # guards against with its rank-0-decides branch).
+        from ..api import functions as api_functions
+
+        if st.rank == 0:
+            step = (ckpt.latest_step() or 0) + 1
+        else:
+            step = None
+        step = api_functions.broadcast_object(step, root_rank=0)
         ckpt.save(step, arrays)
         if st.rank == 0:
             fd, tmp = tempfile.mkstemp(dir=_state_dir(), suffix=".tmp")

@@ -129,19 +129,47 @@ def run(
         # informative failure: a rank that wrote (ok=False, traceback)
         # beats 'no result file' from a peer the launcher terminated.
         payloads: Dict[int, tuple] = {}
+        bad_signature: Dict[int, str] = {}
         for r in range(np):
             path = os.path.join(out_dir, f"rank_{r}.pkl")
             if os.path.exists(path):
                 with open(path, "rb") as f:
                     # verify the worker's signature before unpickling —
                     # result files cross the same trust boundary as the
-                    # shipped function
-                    blob = secret.verify(job_key, f.read())
+                    # shipped function.  A bad signature on one rank must
+                    # not abort collection of the rest: record it and keep
+                    # going so the report carries every rank's status
+                    # (the tampered blob is still never unpickled).
+                    try:
+                        blob = secret.verify(job_key, f.read())
+                    except secret.SignatureError as e:
+                        bad_signature[r] = str(e)
+                        continue
                 payloads[r] = pickle.loads(blob)
+        def _others(r: int) -> str:
+            return "Other ranks: " + ", ".join(
+                f"rank {q}: "
+                + ("failed" if q in payloads and not payloads[q][0] else
+                   "ok" if q in payloads else
+                   "bad signature" if q in bad_signature else
+                   "no result file")
+                for q in range(np) if q != r
+            )
+
         for r in range(np):
             item = payloads.get(r)
             if item is not None and not item[0]:
-                raise RunError(r, item[1])
+                # a concurrent tampering signal must not be buried under
+                # an ordinary worker crash — carry every rank's status
+                raise RunError(r, item[1] + "\n" + _others(r))
+        if bad_signature:
+            r = min(bad_signature)
+            raise RunError(
+                r,
+                f"result file failed signature verification "
+                f"({bad_signature[r]}); the blob was not unpickled. "
+                + _others(r),
+            )
         for r in range(np):
             if r not in payloads:
                 raise RunError(

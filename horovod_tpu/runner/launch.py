@@ -46,10 +46,17 @@ def _default_coordinator_addr(slots: List[SlotInfo]) -> str:
     """
     host0 = slots[0].hostname
     if hosts_mod.is_local_host(host0):
-        if any(not hosts_mod.is_local_host(s.hostname) for s in slots):
+        remotes = [s.hostname for s in slots
+                   if not hosts_mod.is_local_host(s.hostname)]
+        if remotes:
             from . import nic
 
-            return nic.probe_coordinator_addr()
+            addr = nic.probe_coordinator_addr(remote_host=remotes[0])
+            # always announce the auto-chosen address: a wrong guess is
+            # otherwise a silent rendezvous hang with nothing to debug
+            print(f"hvtpurun: coordinator address auto-selected: {addr} "
+                  "(override with --network-interface)", file=sys.stderr)
+            return addr
         return "127.0.0.1"
     return host0
 

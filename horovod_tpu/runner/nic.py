@@ -84,16 +84,41 @@ def resolve_interface(nic: str) -> str:
         ) from None
 
 
-def probe_coordinator_addr() -> str:
+def _egress_addr(probe_target: str) -> str | None:
+    """The local address the kernel's routing table picks to reach
+    ``probe_target`` — a connect() on a UDP socket does the route
+    lookup without sending a packet.  Returns None when no route."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect((probe_target, 9))
+            return s.getsockname()[0]
+    except OSError:
+        return None
+
+
+def probe_coordinator_addr(remote_host: str | None = None) -> str:
     """A usable (global-scope, iface up) non-loopback IPv4 address of
     this host that remote workers can plausibly reach (the reference's
     NIC intersection degenerates to this when only rank 0's host serves
-    the rendezvous).  Raises with the ``--network-interface`` escape
-    hatch when no such address exists."""
-    for _, addr in local_interfaces(usable_only=True):
-        if not addr.startswith("127."):
-            return addr
-    raise ValueError(
-        "no usable non-loopback interface found for the coordinator; "
-        "pass --network-interface with an address remote hosts can reach"
-    )
+    the rendezvous).
+
+    Preference order: the EGRESS address toward ``remote_host`` (or a
+    public anchor when none is given) — i.e. the interface carrying the
+    actual route — then the first usable interface.  Enumeration order
+    alone is a trap: a docker/VM bridge (172.17.0.1 is global scope on
+    an UP interface) can sort first and silently hang remote workers
+    until the rendezvous timeout.  Raises with the
+    ``--network-interface`` escape hatch when no address exists."""
+    usable = [a for _, a in local_interfaces(usable_only=True)
+              if not a.startswith("127.")]
+    if not usable:
+        raise ValueError(
+            "no usable non-loopback interface found for the coordinator; "
+            "pass --network-interface with an address remote hosts can "
+            "reach"
+        )
+    for target in filter(None, (remote_host, "8.8.8.8")):
+        egress = _egress_addr(target)
+        if egress in usable:
+            return egress
+    return usable[0]

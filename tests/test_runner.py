@@ -157,6 +157,10 @@ class TestNicDiscovery:
     def test_probe_returns_non_loopback(self):
         from horovod_tpu.runner import nic
 
+        if not [a for _, a in nic.local_interfaces(usable_only=True)
+                if not a.startswith("127.")]:
+            pytest.skip("loopback-only host: the probe correctly raises "
+                        "here; nothing to assert about selection")
         addr = nic.probe_coordinator_addr()
         assert not addr.startswith("127.")
         assert addr in {a for _, a in nic.local_interfaces()}
@@ -183,11 +187,40 @@ class TestNicDiscovery:
         from horovod_tpu.runner.hosts import get_host_assignments, \
             parse_host_spec
 
-        monkeypatch.setattr(nic, "probe_coordinator_addr",
-                            lambda: "10.9.8.7")
+        seen = {}
+
+        def fake_probe(remote_host=None):
+            seen["remote_host"] = remote_host
+            return "10.9.8.7"
+
+        monkeypatch.setattr(nic, "probe_coordinator_addr", fake_probe)
         slots = get_host_assignments(
             parse_host_spec("localhost:1,remote1:1"), 2)
         assert launch._default_coordinator_addr(slots) == "10.9.8.7"
+        # the probe must aim at an actual remote worker host so the
+        # route lookup reflects the fabric the job will really use
+        assert seen["remote_host"] == "remote1"
+
+    def test_probe_prefers_egress_over_enumeration_order(self, monkeypatch):
+        # a docker bridge (172.17.0.1: global scope, iface UP) sorting
+        # first must NOT win over the interface carrying the route
+        from horovod_tpu.runner import nic
+
+        monkeypatch.setattr(
+            nic, "local_interfaces",
+            lambda usable_only=False: [("docker0", "172.17.0.1"),
+                                       ("eth0", "10.0.0.5")])
+        monkeypatch.setattr(nic, "_egress_addr", lambda target: "10.0.0.5")
+        assert nic.probe_coordinator_addr("remote1") == "10.0.0.5"
+
+    def test_probe_falls_back_when_no_route(self, monkeypatch):
+        from horovod_tpu.runner import nic
+
+        monkeypatch.setattr(
+            nic, "local_interfaces",
+            lambda usable_only=False: [("eth0", "10.0.0.5")])
+        monkeypatch.setattr(nic, "_egress_addr", lambda target: None)
+        assert nic.probe_coordinator_addr() == "10.0.0.5"
 
     def test_all_local_stays_loopback(self):
         from horovod_tpu.runner import launch
@@ -287,3 +320,30 @@ class TestSignedFunctionChannel:
             return "ok"
 
         assert runner_mod.run(body, np=1, cpu_devices=1) == ["ok"]
+
+    def test_bad_rank_signature_reported_with_context(self, monkeypatch):
+        """A result file failing verification surfaces through RunError
+        WITH the rank and the other ranks' statuses — not a bare
+        SignatureError that aborts collection of the remaining ranks."""
+        from horovod_tpu import runner as runner_mod
+        from horovod_tpu.runner import secret
+
+        real_verify = secret.verify
+        calls = {"n": 0}
+
+        def flaky_verify(key, signed):
+            # launcher-side collection reads rank_0 first; fail it only
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise secret.SignatureError("digest mismatch (test)")
+            return real_verify(key, signed)
+
+        def body():
+            return "ok"
+
+        monkeypatch.setattr(secret, "verify", flaky_verify)
+        with pytest.raises(runner_mod.RunError) as ei:
+            runner_mod.run(body, np=2, cpu_devices=1)
+        assert ei.value.rank == 0
+        assert "signature verification" in str(ei.value)
+        assert "rank 1: ok" in str(ei.value)
