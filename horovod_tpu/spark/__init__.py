@@ -1,27 +1,39 @@
 """Spark integration surface, local-mode functional.
 
-Parity surface: ``horovod.spark.run(fn)`` (horovod/spark/__init__.py /
-runner.py) — run ``fn`` as one Horovod rank per Spark executor and
-return the per-rank results.  TPU pods are launched by ``hvtpurun`` /
-the cluster scheduler, so a Spark-executor placement backend is out of
-scope (SURVEY.md §7.3); what IS provided is the same API executed in
-**local mode**: ranks are launched as local worker processes through
-the hvtpurun machinery (the reference itself falls back to local-mode
-Spark in its tests — SURVEY §4's localhost-as-cluster pattern).
+Parity surface: ``horovod.spark`` (horovod/spark/__init__.py /
+runner.py + common/ + torch/ + keras/) — ``run(fn)`` executes one
+Horovod rank per process and returns per-rank results, and the
+Estimator surface (``TorchEstimator``/``KerasEstimator`` over a
+``Store`` + ``Backend``) gives DataFrame-in → trained-Model-out.
 
-The Estimator surface (KerasEstimator/TorchEstimator, Petastorm data
-paths) remains out of scope and raises with a pointer.
+TPU pods are launched by ``hvtpurun`` / the cluster scheduler, so a
+Spark-executor *placement* backend is out of scope (SURVEY.md §7.3);
+everything else is the same API executed in **local mode**: ranks are
+launched as local worker processes through the hvtpurun machinery (the
+reference itself runs its estimator CI on local-mode Spark — SURVEY
+§4's localhost-as-cluster pattern), DataFrames are pandas/dict frames
+(pyspark frames accepted when pyspark is importable), and Petastorm's
+role is played by columnar npz materialization in the Store
+(``common.data``).
 """
 
 from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Optional
 
-_ESTIMATOR_MSG = (
-    "horovod_tpu does not ship Spark Estimators (Petastorm/Store data "
-    "paths are out of scope, SURVEY.md §7.3); use horovod_tpu.spark.run "
-    "for function-style jobs or hvtpurun for scripts."
+from .common import (  # noqa: F401
+    Backend,
+    EstimatorParams,
+    FilesystemStore,
+    HorovodEstimator,
+    HorovodModel,
+    LocalBackend,
+    LocalStore,
+    SparkBackend,
+    Store,
 )
+from .keras import KerasEstimator, KerasModel  # noqa: F401
+from .torch import TorchEstimator, TorchModel  # noqa: F401
 
 
 def run(
@@ -60,13 +72,3 @@ def run_elastic(*args, **kwargs):
         "a Spark-executor elastic backend is out of scope "
         "(SURVEY.md §7.3)."
     )
-
-
-class KerasEstimator:  # pragma: no cover - stub surface
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(_ESTIMATOR_MSG)
-
-
-class TorchEstimator:  # pragma: no cover - stub surface
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(_ESTIMATOR_MSG)

@@ -1,0 +1,222 @@
+"""KerasEstimator — Spark-style estimator over the keras frontend.
+
+Parity surface: ``horovod/spark/keras/estimator.py``
+(``KerasEstimator``, ``KerasModel``) + ``.../keras/remote.py``: fit()
+rebuilds the model on every rank from its architecture JSON + initial
+weights, compiles it with the wrapped ``DistributedOptimizer`` and the
+Horovod callbacks (broadcast at start, metric averaging), trains
+``model.fit`` on the rank's shard, checkpoints through the Store, and
+returns a KerasModel for transform().
+
+TPU-native notes: the gradient fabric under the wrapped optimizer is
+the JAX/XLA collective path of ``horovod_tpu.keras``; data is the
+Store's materialized npz (common.data), not Petastorm.  The optimizer
+ships as a keras config dict (not pickle) — its slot variables are
+rank-local and must be built fresh against the rebuilt model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+from ..common.data import TRAIN_NPZ, VAL_NPZ, load_shard
+from ..common.estimator import HorovodEstimator, HorovodModel
+
+CHECKPOINT_FILE = "checkpoint.npz"
+MODEL_JSON_FILE = "model.json"
+
+
+def _keras_trainer(spec: Dict[str, Any]):
+    """Per-rank training loop (reference: keras/remote.py) —
+    module-level so the launcher channel pickles it by reference."""
+    import cloudpickle
+    import numpy as np
+
+    import horovod_tpu.keras as hvd
+    from ..common.store import FilesystemStore
+
+    hvd.init()
+    import keras
+
+    p = spec["params"]
+    seed = p.get("random_seed")
+    if seed is not None:
+        keras.utils.set_random_seed(seed + hvd.rank())
+
+    model = keras.models.model_from_json(
+        spec["model_json"], custom_objects=spec["custom_objects"])
+    model.set_weights(cloudpickle.loads(spec["weights_blob"]))
+    optimizer = keras.optimizers.deserialize(
+        json.loads(spec["optimizer_config"]))
+    loss, metrics, user_callbacks, transformation_fn = \
+        cloudpickle.loads(spec["train_blob"])
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(optimizer),
+        loss=loss, metrics=metrics or None,
+        weighted_metrics=None,
+    )
+
+    store = FilesystemStore(spec["store_prefix"])
+    run_id = spec["run_id"]
+    shard = load_shard(store.get_train_data_path(), TRAIN_NPZ,
+                       hvd.rank(), hvd.size())
+
+    feature_cols = p["feature_cols"]
+    label_cols = p["label_cols"]
+
+    def xy(source):
+        xs = [source[c] for c in feature_cols]
+        ys = [source[c] for c in label_cols]
+        x = xs[0] if len(xs) == 1 else xs
+        y = ys[0] if len(ys) == 1 else ys
+        if transformation_fn is not None:
+            x, y = transformation_fn(x, y)
+        return x, y
+
+    x, y = xy(shard)
+    fit_kwargs: Dict[str, Any] = {}
+    if spec["n_val"]:
+        fit_kwargs["validation_data"] = xy(
+            load_shard(store.get_val_data_path(), VAL_NPZ,
+                       hvd.rank(), hvd.size()))
+        if p.get("validation_steps_per_epoch") is not None:
+            fit_kwargs["validation_steps"] = \
+                p["validation_steps_per_epoch"]
+    if p.get("sample_weight_col"):
+        fit_kwargs["sample_weight"] = shard[p["sample_weight_col"]]
+    if p.get("train_steps_per_epoch") is not None:
+        fit_kwargs["steps_per_epoch"] = p["train_steps_per_epoch"]
+
+    ckpt_dir = store.get_checkpoint_path(run_id)
+
+    class _Checkpoint(keras.callbacks.Callback):
+        """rank-0 per-epoch Store checkpoint (reference: the estimator
+        installs a best-model checkpoint callback writing to the
+        Store)."""
+
+        def on_epoch_end(self, epoch, logs=None):
+            if hvd.rank() != 0:
+                return
+            os.makedirs(ckpt_dir, exist_ok=True)
+            tmp = os.path.join(ckpt_dir, CHECKPOINT_FILE + ".tmp.npz")
+            np.savez(tmp, **{f"w{i}": w for i, w in
+                             enumerate(self.model.get_weights())})
+            os.replace(tmp, os.path.join(ckpt_dir, CHECKPOINT_FILE))
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        _Checkpoint(),
+    ] + list(user_callbacks or [])
+
+    hist = model.fit(
+        x, y,
+        batch_size=p["batch_size"],
+        epochs=p["epochs"],
+        shuffle=p.get("shuffle", True),
+        verbose=p.get("verbose", 1) if hvd.rank() == 0 else 0,
+        callbacks=callbacks,
+        **fit_kwargs,
+    )
+
+    history = {k: [float(v) for v in vs] for k, vs in
+               hist.history.items()}
+    result: Dict[str, Any] = {"history": history}
+    if hvd.rank() == 0:
+        store.write_text(
+            os.path.join(store.get_logs_path(run_id), "history.json"),
+            json.dumps(history))
+        store.write_text(
+            os.path.join(ckpt_dir, MODEL_JSON_FILE), spec["model_json"])
+        result["weights_blob"] = cloudpickle.dumps(model.get_weights())
+    hvd.shutdown()
+    return result
+
+
+class KerasEstimator(HorovodEstimator):
+    """Reference-shaped params: ``model`` (keras.Model), ``optimizer``
+    (keras optimizer instance or name), ``loss`` (name or callable),
+    ``custom_objects`` for model rebuild on the ranks."""
+
+    _param_defs = {
+        "optimizer": None,
+        "custom_objects": {},
+    }
+
+    def _check_params(self):
+        super()._check_params()
+        if self.getOptimizer() is None:
+            raise ValueError("optimizer param is required")
+        if self.getLoss() is None:
+            raise ValueError("loss param is required")
+
+    def _serialize_training_spec(self) -> Dict[str, Any]:
+        import cloudpickle
+        import keras
+
+        model = self.getModel()
+        if not model.built:
+            raise ValueError(
+                "the keras model must be built before fit() so its "
+                "initial weights can broadcast — call model.build() "
+                "or pass an Input layer")
+        opt = self.getOptimizer()
+        if isinstance(opt, str):
+            opt = keras.optimizers.get(opt)
+        return {
+            "model_json": model.to_json(),
+            "weights_blob": cloudpickle.dumps(model.get_weights()),
+            "optimizer_config": json.dumps(
+                keras.optimizers.serialize(opt)),
+            "custom_objects": dict(self.getCustomObjects() or {}),
+            "train_blob": cloudpickle.dumps((
+                self.getLoss(), list(self.getMetrics() or []),
+                list(self.getCallbacks() or []),
+                self.getTransformationFn())),
+        }
+
+    def _remote_trainer(self):
+        return _keras_trainer
+
+    def _create_model(self, rank_results, run_id, store):
+        import cloudpickle
+        import keras
+
+        weights = cloudpickle.loads(
+            next(r["weights_blob"] for r in rank_results
+                 if "weights_blob" in r))
+        trained = keras.models.model_from_json(
+            self.getModel().to_json(),
+            custom_objects=dict(self.getCustomObjects() or {}))
+        trained.set_weights(weights)
+        return KerasModel(
+            model=trained,
+            feature_cols=list(self.getFeatureCols()),
+            label_cols=list(self.getLabelCols()),
+            output_cols=self.getOutputCols(),
+            run_id=run_id, store=store,
+            history=rank_results[0]["history"],
+            batch_size=self.getBatchSize(),
+        )
+
+
+class KerasModel(HorovodModel):
+    _param_defs = {"custom_objects": {}}
+
+    def _predict_columns(self, features):
+        import numpy as np
+
+        model = self.getModel()
+        xs = [features[c] for c in self.getFeatureCols()]
+        x = xs[0] if len(xs) == 1 else xs
+        out = model.predict(x, batch_size=self.getBatchSize(),
+                            verbose=0)
+        if not isinstance(out, (tuple, list)):
+            out = [out]
+        return [np.asarray(m).reshape(-1)
+                if np.asarray(m).ndim == 2 and np.asarray(m).shape[1] == 1
+                else (list(np.asarray(m)) if np.asarray(m).ndim > 1
+                      else np.asarray(m))
+                for m in out]
