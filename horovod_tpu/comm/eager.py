@@ -51,6 +51,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core import state as core_state
 from ..core.topology import DCN_AXIS, ICI_AXIS, LDEV_AXIS, PROC_AXIS
 from . import spmd
+from . import stall
 from .compression import NoneCompressor
 from .reduce_ops import ReduceOp, normalize_op
 
@@ -479,6 +480,8 @@ def allreduce(
     if timeline is not None:
         timeline.begin(tname, "ICI_ALLREDUCE")
     try:
+        stall.check(
+            st, ps, f"allreduce:{tuple(x.shape)}:{x.dtype}:{rop.name}")
         if p == 1:
             out = x * jnp.asarray(prescale_factor, x.dtype)
             # averaging / sum over one participant is identity
@@ -593,6 +596,10 @@ def allgather(tensor, *, process_set=None):
     p = mesh.devices.size
     if p == 1:
         return x
+    # dim0 excluded from the descriptor: per-rank sizes are legitimate
+    # for allgather and negotiated right below
+    stall.check(
+        st, ps, f"allgather:{tuple(x.shape[1:])}:{x.dtype}")
     sizes = _exchange_dim0_sizes(x.shape[0], mesh)
     maxd = int(sizes.max())
     padded = (
@@ -623,6 +630,9 @@ def broadcast(tensor, *, root_rank: int = 0, process_set=None):
             f"root_rank {root_rank} is not a member of process set "
             f"{ps.process_set_id} (ranks {ps.ranks})"
         )
+    stall.check(
+        st, ps,
+        f"broadcast:{tuple(x.shape)}:{x.dtype}:root{root_rank}")
     md = (None if x.nbytes < _MULTIDEV_MIN_BYTES
           else _multidev_mesh_or_none(ps))
     if md is not None:
@@ -664,6 +674,8 @@ def alltoall(tensor, splits=None, *, process_set=None):
         raise ValueError("splits must be a (size,) vector summing to dim0")
     if p == 1:
         return (x, jnp.asarray(splits)) if return_splits else x
+    stall.check(
+        st, ps, f"alltoall:{tuple(x.shape[1:])}:{x.dtype}")
 
     # Negotiate the split matrix: row r = rank r's send splits.
     split_matrix = np.asarray(
@@ -703,6 +715,9 @@ def reducescatter(tensor, *, op=None, process_set=None):
     p = ps.size
     if p == 1:
         return x
+    stall.check(
+        st, ps,
+        f"reducescatter:{tuple(x.shape)}:{x.dtype}:{rop.name}")
     if x.shape[0] % p == 0:
         mesh = ps.proc_mesh()
         stacked = _stack_global(x, mesh)

@@ -1,0 +1,182 @@
+"""Stall watchdog for the SYNC eager data plane.
+
+Parity surface: ``horovod/common/stall_inspector.cc``
+(``StallInspector::CheckForStalledTensors`` /
+``InvalidateStalledCachedResponses``) — the reference's coordinator
+names every tensor some rank has submitted that others haven't, warns
+after ``HOROVOD_STALL_CHECK_TIME_SECONDS`` and aborts after
+``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``.  The *async* path here has
+its own inspector inside the eager mini-controller
+(``eager/controller.py``); this module covers the **sync** ops in
+``comm/eager.py``, which otherwise enter an XLA collective that simply
+blocks forever when a rank diverges — the classic Horovod deadlock
+this subsystem exists to catch (SURVEY §5.2 calls it essential).
+
+TPU-native design: an XLA collective cannot be interrupted once
+entered, so detection must happen **before** dispatch.  Every sync
+collective performs a cheap KV rendezvous over the JAX coordination
+service (the store that already hosts init and the async controller's
+transport): post ``stall/<gen>/<set>/<seq>/<rank> = op-descriptor``,
+then await the other member ranks' marks for the same sequence number.
+Arrival order per (process set) is rank-consistent by the SPMD
+contract, so the sequence number needs no negotiation.  Outcomes:
+
+- all marks arrive (normal case: one try_get per peer) → dispatch;
+- a peer's mark carries a DIFFERENT descriptor → the ranks have
+  diverged onto different collectives — raise immediately, naming
+  both ops (the reference logs this as a mismatched-tensor error);
+- past ``stall_check_time_seconds`` → warn, naming the op, the wait,
+  and exactly which ranks are absent (repeats each interval);
+- past ``stall_shutdown_time_seconds`` (when > 0) → raise
+  ``HorovodInternalError`` instead of hanging — which the elastic
+  ``run`` decorator already catches as a recoverable failure, so a
+  stalled elastic job rolls back and re-rendezvouses like the
+  reference's shutdown-on-stall path.
+
+The async controller's cycle thread executes its (already negotiated)
+responses through the same ``comm/eager`` functions; it registers
+itself via ``bypass_thread()`` so those dispatches skip the
+rendezvous.  Nested internal collectives (barrier's allreduce,
+reducescatter's uneven-path allreduce) rendezvous on their own — the
+nesting is part of the op's implementation, hence identical on every
+rank, so the extra checks stay consistent and only refine diagnostics.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from ..core import state as core_state
+from ..core.exceptions import HorovodInternalError
+
+logger = logging.getLogger("horovod_tpu")
+
+_NS = "hvtstall"
+_tls = threading.local()
+
+
+def bypass_thread():
+    """Mark the CURRENT thread's eager collectives as exempt from the
+    sync rendezvous (used by the async controller's cycle thread, whose
+    op order is already negotiated and stall-inspected)."""
+    _tls.bypass = True
+
+
+class SyncStallInspector:
+    """Per-process rendezvous bookkeeping over the coordination KV."""
+
+    def __init__(self, client, rank: int, warn_s: float, abort_s: float,
+                 generation: int = 0):
+        self._kv = client
+        self.rank = rank
+        self.warn_s = warn_s
+        self.abort_s = abort_s
+        self.gen = generation
+        self._seq: Dict[int, int] = {}
+
+    # -- key helpers --------------------------------------------------
+    def _key(self, set_id: int, seq: int, rank: int) -> str:
+        return f"{_NS}/{self.gen}/{set_id}/{seq}/{rank}"
+
+    def _try_get(self, key: str) -> Optional[str]:
+        try:
+            return self._kv.key_value_try_get(key)
+        except Exception:
+            return None
+
+    # -- the rendezvous -----------------------------------------------
+    def rendezvous(self, set_id: int, member_ranks, desc: str):
+        """Block until every member rank posts a mark for this set's
+        next sequence number; warn/abort on deadline."""
+        seq = self._seq.get(set_id, 0)
+        self._seq[set_id] = seq + 1
+        self._kv.key_value_set(self._key(set_id, seq, self.rank), desc)
+
+        pending = [r for r in member_ranks if r != self.rank]
+        start = time.monotonic()
+        next_warn = self.warn_s
+        sleep = 0.0
+        while pending:
+            still = []
+            for r in pending:
+                val = self._try_get(self._key(set_id, seq, r))
+                if val is None:
+                    still.append(r)
+                elif val != desc:
+                    raise HorovodInternalError(
+                        f"collective mismatch at process set {set_id} "
+                        f"op #{seq}: this rank ({self.rank}) is entering "
+                        f"[{desc}] but rank {r} posted [{val}]. Ranks "
+                        "have diverged onto different collectives; this "
+                        "would deadlock or corrupt the wire."
+                    )
+            pending = still
+            if not pending:
+                break
+            elapsed = time.monotonic() - start
+            if self.abort_s > 0 and elapsed > self.abort_s:
+                raise HorovodInternalError(
+                    f"stalled collective [{desc}] (process set {set_id}, "
+                    f"op #{seq}): waited {elapsed:.1f}s > stall shutdown "
+                    f"time {self.abort_s:.1f}s; ranks not at the "
+                    f"rendezvous: {pending}. One or more ranks skipped "
+                    "this collective or died before reaching it."
+                )
+            if self.warn_s > 0 and elapsed > next_warn:
+                next_warn += self.warn_s
+                logger.warning(
+                    "stalled collective [%s] (process set %d, op #%d): "
+                    "waited %.1fs; ranks not at the rendezvous: %s",
+                    desc, set_id, seq, elapsed, pending,
+                )
+            # back off from a hot spin to a 50ms poll
+            sleep = min(0.05, sleep + 0.002)
+            time.sleep(sleep)
+
+        # rolling cleanup: every member has posted seq, so nobody can
+        # still be waiting on marks older than seq — drop our own
+        # previous mark to keep the KV bounded (each rank deletes only
+        # its own keys; no cross-rank races)
+        if seq > 0:
+            try:
+                self._kv.key_value_delete(
+                    self._key(set_id, seq - 1, self.rank))
+            except Exception:
+                pass
+
+
+def check(st, ps, desc: str) -> None:
+    """The eager ops' pre-dispatch hook: rendezvous with the other
+    member ranks (the XLA collective entered next is uninterruptible),
+    or no-op when stall checking cannot or should not engage (single
+    member, controller thread, disabled, no coordination client)."""
+    if ps.size <= 1 or getattr(_tls, "bypass", False):
+        return
+    cfg = st.config
+    if cfg is None or cfg.stall_check_disable:
+        return
+    inspector = st.sync_stall
+    if inspector is None:
+        try:
+            from jax._src import distributed as _jd
+
+            client = _jd.global_state.client
+        except Exception:
+            client = None
+        if client is None:
+            st.sync_stall = False
+            return
+        inspector = SyncStallInspector(
+            client, st.rank,
+            warn_s=cfg.stall_check_time_seconds,
+            abort_s=cfg.stall_shutdown_time_seconds,
+            generation=st.init_generation,
+        )
+        st.sync_stall = inspector
+    elif inspector is False:
+        return
+    members = ps.ranks if ps.ranks is not None else range(st.size)
+    inspector.rendezvous(ps.process_set_id, list(members), desc)

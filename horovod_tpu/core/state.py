@@ -40,6 +40,13 @@ class GlobalState:
         self.distributed_initialized_by_us = False
         # Lazily-started eager mini-controller (horovod_tpu.eager).
         self.controller = None
+        # Sync-path stall inspector (comm/stall.py), created lazily on
+        # the first guarded eager collective; False = probed, no client.
+        self.sync_stall = None
+        # Monotonic per-process init counter: namespaces the stall KV
+        # marks so a shutdown→init cycle (elastic in-process resync)
+        # can never read the previous session's stale marks.
+        self.init_generation = 0
         # Timeline writer (horovod_tpu.obs.timeline), if enabled.
         self.timeline = None
         # Autotuner (horovod_tpu.obs.autotune), if enabled.
@@ -213,6 +220,7 @@ def init(config: Optional[Config] = None) -> GlobalState:
 
             _state.autotuner = Autotuner(cfg)
 
+        _state.init_generation += 1
         _state.initialized = True
         atexit.register(_shutdown_at_exit)
         return _state
@@ -243,6 +251,7 @@ def shutdown():
                 pass
             _state.distributed_initialized_by_us = False
         _state.initialized = False
+        _state.sync_stall = None
         _state.config = None
         _state.topology = None
         _state.process_set_table = None

@@ -489,6 +489,12 @@ class EagerController:
     # ---- cycle loop ----
     def _loop(self):
         # Parity: BackgroundThreadLoop — run RunLoopOnce every cycle_time.
+        # This thread's dispatches execute an already-negotiated
+        # schedule with its own stall inspection — exempt them from the
+        # sync path's pre-dispatch rendezvous (comm/stall.py).
+        from ..comm import stall as sync_stall
+
+        sync_stall.bypass_thread()
         while not self._stop.is_set():
             t0 = time.monotonic()
             try:

@@ -1,0 +1,236 @@
+"""Sync-path stall watchdog (comm/stall.py).
+
+Parity: ``horovod/common/stall_inspector.cc`` — the reference warns
+after STALL_CHECK_TIME naming the tensors and missing ranks, and shuts
+down after STALL_SHUTDOWN_TIME.  Unit tests drive the inspector over a
+fake KV client; the integration tests launch 2 REAL processes where
+one rank skips (or diverges from) a collective — the exact deadlock
+SURVEY §5.2 calls this subsystem essential for — and assert the other
+rank aborts with a named diagnosis instead of hanging forever.
+"""
+
+import logging
+import os
+import threading
+import time
+
+import pytest
+
+import horovod_tpu
+from horovod_tpu.core.exceptions import HorovodInternalError
+from horovod_tpu.comm.stall import SyncStallInspector
+from horovod_tpu.runner import run
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(horovod_tpu.__file__))
+_ENV = {"PYTHONPATH": _REPO_ROOT + os.pathsep
+        + os.environ.get("PYTHONPATH", "")}
+
+
+class FakeKV:
+    """Dict-backed stand-in for the coordination-service client."""
+
+    def __init__(self):
+        self.d = {}
+        self.lock = threading.Lock()
+
+    def key_value_set(self, k, v):
+        with self.lock:
+            self.d[k] = v
+
+    def key_value_try_get(self, k):
+        with self.lock:
+            if k not in self.d:
+                raise KeyError(k)
+            return self.d[k]
+
+    def key_value_delete(self, k):
+        with self.lock:
+            self.d.pop(k, None)
+
+
+class TestInspectorUnit:
+    def test_completes_when_all_marks_present(self):
+        kv = FakeKV()
+        # peer (rank 1) already posted its mark for seq 0
+        kv.key_value_set("hvtstall/1/0/0/1", "allreduce:x")
+        insp = SyncStallInspector(kv, rank=0, warn_s=60, abort_s=0,
+                                  generation=1)
+        insp.rendezvous(0, [0, 1], "allreduce:x")  # returns, no raise
+        assert "hvtstall/1/0/0/0" in kv.d  # own mark posted
+
+    def test_abort_names_missing_ranks(self):
+        kv = FakeKV()
+        insp = SyncStallInspector(kv, rank=0, warn_s=0.05, abort_s=0.2,
+                                  generation=1)
+        t0 = time.monotonic()
+        with pytest.raises(HorovodInternalError) as ei:
+            insp.rendezvous(0, [0, 1, 2], "allreduce:y")
+        assert time.monotonic() - t0 < 5.0  # bounded, not a hang
+        msg = str(ei.value)
+        assert "allreduce:y" in msg
+        assert "[1, 2]" in msg  # the missing ranks, by name
+
+    def test_descriptor_mismatch_raises_immediately(self):
+        kv = FakeKV()
+        kv.key_value_set("hvtstall/1/0/0/1", "broadcast:z")
+        insp = SyncStallInspector(kv, rank=0, warn_s=60, abort_s=0,
+                                  generation=1)
+        t0 = time.monotonic()
+        with pytest.raises(HorovodInternalError, match="diverged"):
+            insp.rendezvous(0, [0, 1], "allreduce:z")
+        assert time.monotonic() - t0 < 1.0  # no deadline needed
+
+    def test_warn_then_recover(self, caplog):
+        kv = FakeKV()
+        insp = SyncStallInspector(kv, rank=0, warn_s=0.05, abort_s=0,
+                                  generation=1)
+
+        def late_peer():
+            time.sleep(0.3)
+            kv.key_value_set("hvtstall/1/0/0/1", "op")
+
+        t = threading.Thread(target=late_peer)
+        t.start()
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            insp.rendezvous(0, [0, 1], "op")
+        t.join()
+        stalls = [r for r in caplog.records
+                  if "stalled collective" in r.getMessage()]
+        assert stalls and "[1]" in stalls[0].getMessage()
+
+    def test_rolling_cleanup_keeps_kv_bounded(self):
+        kv = FakeKV()
+        insp = SyncStallInspector(kv, rank=0, warn_s=60, abort_s=0,
+                                  generation=1)
+        for seq in range(3):
+            kv.key_value_set(f"hvtstall/1/0/{seq}/1", "op")
+            insp.rendezvous(0, [0, 1], "op")
+        own = [k for k in kv.d if k.endswith("/0")]
+        # only the newest own mark survives (seq 2)
+        assert own == ["hvtstall/1/0/2/0"]
+
+    def test_generation_namespacing_ignores_stale_marks(self):
+        kv = FakeKV()
+        # a PREVIOUS session's mark with a different descriptor must
+        # not trip the mismatch check after re-init
+        kv.key_value_set("hvtstall/1/0/0/1", "old-op")
+        kv.key_value_set("hvtstall/2/0/0/1", "new-op")
+        insp = SyncStallInspector(kv, rank=0, warn_s=60, abort_s=0,
+                                  generation=2)
+        insp.rendezvous(0, [0, 1], "new-op")
+
+
+pytestmark_integration = pytest.mark.multiprocess
+
+
+@pytest.mark.multiprocess
+def test_skipped_collective_aborts_cleanly_2proc():
+    """Rank 1 skips a collective rank 0 enters: rank 0 must diagnose
+    and abort within the stall shutdown deadline — not hang."""
+
+    def body():
+        import time as _t
+
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvt
+        from horovod_tpu.core.exceptions import HorovodInternalError
+
+        hvt.init()
+        r = hvt.rank()
+        # one successful collective first: the watchdog must not
+        # perturb the healthy path
+        ok = float(hvt.allreduce(jnp.ones(()), op=hvt.Sum))
+        assert ok == 2.0
+        if r == 0:
+            t0 = _t.monotonic()
+            try:
+                hvt.allreduce(jnp.ones((4,)), op=hvt.Sum)
+            except HorovodInternalError as e:
+                waited = _t.monotonic() - t0
+                return ("aborted", waited, str(e))
+            return ("hung-or-succeeded", None, None)
+        _t.sleep(8)  # never calls the collective
+        return ("skipped", None, None)
+
+    results = run(
+        body, np=2, cpu_devices=1, env={
+            **_ENV,
+            "HVTPU_STALL_CHECK_TIME_SECONDS": "1",
+            "HVTPU_STALL_SHUTDOWN_TIME_SECONDS": "3",
+        }, start_timeout=300.0, timeout=600.0)
+    by_rank = dict(zip(("r0", "r1"), results))
+    status, waited, msg = results[0]
+    assert status == "aborted", by_rank
+    assert waited < 8.0
+    assert "stalled collective" in msg and "allreduce" in msg
+    assert "[1]" in msg  # names the absent rank
+    assert results[1][0] == "skipped"
+
+
+@pytest.mark.multiprocess
+def test_diverged_collectives_diagnosed_2proc():
+    """Ranks entering DIFFERENT collectives at the same point must get
+    the mismatch diagnosis on both sides, immediately."""
+
+    def body():
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvt
+        from horovod_tpu.core.exceptions import HorovodInternalError
+
+        hvt.init()
+        r = hvt.rank()
+        try:
+            if r == 0:
+                hvt.allreduce(jnp.ones((2,)), op=hvt.Sum)
+            else:
+                hvt.broadcast(jnp.ones((2,)), root_rank=0)
+        except HorovodInternalError as e:
+            return ("mismatch", str(e))
+        return ("no-error", None)
+
+    results = run(
+        body, np=2, cpu_devices=1, env={
+            **_ENV,
+            "HVTPU_STALL_CHECK_TIME_SECONDS": "1",
+            "HVTPU_STALL_SHUTDOWN_TIME_SECONDS": "10",
+        }, start_timeout=300.0, timeout=600.0)
+    # at least the slower-arriving rank sees the peer's conflicting
+    # mark; with both marks posted, typically both do
+    assert any(s == "mismatch" for s, _ in results), results
+    for s, msg in results:
+        if s == "mismatch":
+            assert "diverged" in msg
+
+
+@pytest.mark.multiprocess
+def test_watchdog_transparent_on_healthy_path_2proc():
+    """With stall checking at defaults, the full sync op matrix still
+    produces correct results (the rendezvous must be semantically
+    invisible)."""
+
+    def body():
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvt
+
+        hvt.init()
+        r = hvt.rank()
+        a = np.asarray(hvt.allreduce(jnp.full((3,), float(r + 1)),
+                                     op=hvt.Sum))
+        g = np.asarray(hvt.allgather(jnp.full((r + 1, 2), float(r))))
+        b = np.asarray(hvt.broadcast(jnp.full((2,), float(r * 7)),
+                                     root_rank=1))
+        rs = np.asarray(hvt.reducescatter(jnp.ones((4, 2)), op=hvt.Sum))
+        hvt.barrier()
+        return (a.tolist(), g.shape[0], b.tolist(), rs.tolist())
+
+    results = run(body, np=2, cpu_devices=1, env=_ENV,
+                  start_timeout=300.0)
+    for a, g0, b, rs in results:
+        assert a == [3.0, 3.0, 3.0]
+        assert g0 == 3
+        assert b == [7.0, 7.0]
+        assert rs == [[2.0, 2.0], [2.0, 2.0]]
