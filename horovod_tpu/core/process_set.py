@@ -115,6 +115,20 @@ class ProcessSet:
         )
 
 
+def participant_rank(process_set) -> int:
+    """This process's rank WITHIN the collective's span: its set-relative
+    index, or the global rank when no set is given (shared by frontends
+    needing per-rank offsets, e.g. the allgather gradient slice)."""
+    from . import state as core_state
+
+    st = core_state.require_init("process-set lookup")
+    if process_set is None:
+        return st.rank
+    if isinstance(process_set, int):
+        process_set = st.process_set_table.get(process_set)
+    return process_set.rank_in_set(st.rank)
+
+
 def participant_count(process_set) -> int:
     """Number of ranks a collective spans: the process set's size, or
     the world when none is given.  Shared by every frontend so the

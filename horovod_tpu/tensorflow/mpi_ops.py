@@ -46,7 +46,10 @@ def _engine_compression(compression):
     return EngineCompression.none
 
 
-from ..core.process_set import participant_count as _participant_count
+from ..core.process_set import (
+    participant_count as _participant_count,
+    participant_rank as _participant_rank,
+)
 
 
 def predivide_scaling(op, gradient_predivide_factor: float, process_set):
@@ -184,7 +187,35 @@ def allreduce(tensor, average=None, op=None, name=None,
             process_set=process_set,
         )
 
-    return _graph_op(impl, [tensor], tensor.dtype, tensor.shape)
+    # Gradient registration (parity: RegisterGradient('HorovodAllreduce')
+    # in horovod/tensorflow/mpi_ops.py): the gradient of an allreduce is
+    # an allreduce of the gradient with the SAME attributes, so
+    # tape.gradient through a bare collective is correct without
+    # DistributedGradientTape.
+    @tf.custom_gradient
+    def _op(x):
+        y = _graph_op(impl, [x], x.dtype, x.shape)
+
+        def grad(dy):
+            from ..comm.reduce_ops import ReduceOp, normalize_op
+
+            rop = normalize_op(op, average)
+            if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE,
+                           ReduceOp.ADASUM):
+                raise NotImplementedError(
+                    f"gradient of a {rop.name} allreduce is not "
+                    "defined (reference registers gradients for "
+                    "sum/average/adasum)")
+            return allreduce(
+                dy, average=average, op=op,
+                compression=compression,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                process_set=process_set)
+
+        return y, grad
+
+    return _op(tf.convert_to_tensor(tensor))
 
 
 @_no_autograph
@@ -214,7 +245,26 @@ def allgather(tensor, name=None, process_set=None):
 
     shape = tf.TensorShape([None]).concatenate(tensor.shape[1:]) \
         if tensor.shape.rank is not None and tensor.shape.rank > 0 else None
-    return _graph_op(impl, [tensor], tensor.dtype, shape)
+
+    # Parity: RegisterGradient('HorovodAllgather') — sum the upstream
+    # gradient across ranks, then slice out the rows this rank
+    # contributed (offsets from the negotiated per-rank dim-0 sizes).
+    @tf.custom_gradient
+    def _op(x):
+        y = _graph_op(impl, [x], x.dtype, shape)
+
+        def grad(dy):
+            summed = allreduce(dy, op=Sum, process_set=process_set)
+            my_rows = tf.shape(x)[0]
+            sizes = allgather(tf.reshape(my_rows, [1]),
+                              process_set=process_set)
+            r = _participant_rank(process_set)
+            offset = tf.reduce_sum(sizes[:r])
+            return summed[offset:offset + my_rows]
+
+        return y, grad
+
+    return _op(tf.convert_to_tensor(tensor))
 
 
 @_no_autograph
@@ -224,7 +274,22 @@ def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):
             x, root_rank=root_rank, process_set=process_set, name=name
         )
 
-    return _graph_op(impl, [tensor], tensor.dtype, tensor.shape)
+    # Parity: RegisterGradient('HorovodBroadcast') — gradients reduce
+    # to the root: every rank allreduce-sums, the root keeps the sum,
+    # non-roots get zeros (their input never reached the output).
+    @tf.custom_gradient
+    def _op(x):
+        y = _graph_op(impl, [x], x.dtype, x.shape)
+
+        def grad(dy):
+            summed = allreduce(dy, op=Sum, process_set=process_set)
+            if _hvt.rank() == root_rank:
+                return summed
+            return tf.zeros_like(summed)
+
+        return y, grad
+
+    return _op(tf.convert_to_tensor(tensor))
 
 
 @_no_autograph
@@ -238,34 +303,68 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
             )
 
         shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
-        return _graph_op(impl, [tensor], tensor.dtype, shape)
 
-    if tf.executing_eagerly():
-        out, rsplits = _hvt.alltoall(
-            _to_engine(tensor), _np(splits), process_set=process_set,
-            name=name,
+        # Parity: RegisterGradient('HorovodAlltoall') — the adjoint of
+        # an alltoall routes each gradient chunk back to its sender,
+        # which for equal splits is another equal alltoall.
+        @tf.custom_gradient
+        def _op(x):
+            y = _graph_op(impl, [x], x.dtype, shape)
+
+            def grad(dy):
+                return alltoall(dy, process_set=process_set)
+
+            return y, grad
+
+        return _op(tf.convert_to_tensor(tensor))
+
+    def _forward(x, s):
+        if tf.executing_eagerly():
+            o, rs = _hvt.alltoall(
+                _to_engine(x), _np(s), process_set=process_set,
+                name=name,
+            )
+            return (_from_engine(o, dtype=x.dtype),
+                    tf.convert_to_tensor(
+                        np.asarray(rs).astype(np.int32)))
+
+        want_np = tensor.dtype.as_numpy_dtype
+
+        def _pyfn(t, sp):
+            o, rs = _hvt.alltoall(t.numpy(), sp.numpy(),
+                                  process_set=process_set, name=name)
+            o = np.asarray(o)
+            # same Tout contract as _graph_op._np_out: restore the
+            # declared dtype (float64 computes at f32 wire precision
+            # with x64 off)
+            if o.dtype != np.dtype(want_np):
+                o = o.astype(want_np)
+            return (tf.convert_to_tensor(o),
+                    tf.convert_to_tensor(np.asarray(rs).astype(np.int32)))
+
+        o, rs = tf.py_function(
+            _pyfn, [x, s], Tout=[tensor.dtype, tf.int32],
         )
-        return (_from_engine(out, dtype=tensor.dtype),
-                tf.convert_to_tensor(np.asarray(rsplits)))
+        o.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+        return o, rs
 
-    want_np = tensor.dtype.as_numpy_dtype
+    # Parity: RegisterGradient('HorovodAlltoall') — route each gradient
+    # chunk back to its sender by replaying the exchange with the
+    # RECEIVED splits; the splits input itself gets no gradient.
+    @tf.custom_gradient
+    def _op(x, s):
+        out, rsplits = _forward(x, s)
 
-    def _pyfn(t, s):
-        o, rs = _hvt.alltoall(t.numpy(), s.numpy(),
-                              process_set=process_set, name=name)
-        o = np.asarray(o)
-        # same Tout contract as _graph_op._np_out: restore the declared
-        # dtype (float64 computes at f32 wire precision with x64 off)
-        if o.dtype != np.dtype(want_np):
-            o = o.astype(want_np)
-        return (tf.convert_to_tensor(o),
-                tf.convert_to_tensor(np.asarray(rs).astype(np.int32)))
+        def grad(dy, drsplits):
+            g, _ = alltoall(dy, splits=rsplits,
+                            process_set=process_set)
+            return g, None
 
-    out, rsplits = tf.py_function(
-        _pyfn, [tensor, splits], Tout=[tensor.dtype, tf.int32],
-    )
-    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
-    return out, rsplits
+        return (out, rsplits), grad
+
+    s = splits if tf.is_tensor(splits) else tf.convert_to_tensor(
+        np.asarray(splits).astype(np.int32))
+    return _op(tf.convert_to_tensor(tensor), s)
 
 
 @_no_autograph
@@ -277,7 +376,31 @@ def reducescatter(tensor, op=None, name=None, process_set=None):
 
     shape = tf.TensorShape([None]).concatenate(tensor.shape[1:]) \
         if tensor.shape.rank is not None and tensor.shape.rank > 0 else None
-    return _graph_op(impl, [tensor], tensor.dtype, shape)
+
+    # Parity: RegisterGradient('HorovodReducescatter') — the adjoint of
+    # reduce+scatter is gather(+identity): allgather the shard grads;
+    # an Average forward additionally averages the backward.
+    @tf.custom_gradient
+    def _op(x):
+        y = _graph_op(impl, [x], x.dtype, shape)
+
+        def grad(dy):
+            from ..comm.reduce_ops import ReduceOp, normalize_op
+
+            rop = normalize_op(op, None)
+            if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+                raise NotImplementedError(
+                    f"gradient of a {rop.name} reducescatter is not "
+                    "defined")
+            g = allgather(dy, process_set=process_set)
+            if rop == ReduceOp.AVERAGE:
+                g = g / tf.cast(_participant_count(process_set),
+                                g.dtype)
+            return g
+
+        return y, grad
+
+    return _op(tf.convert_to_tensor(tensor))
 
 
 @_no_autograph

@@ -56,6 +56,77 @@ def test_tf_tape_and_collectives_2proc():
         assert out["bvar"] == [100.0]
 
 
+def test_tf_bare_collective_gradients_2proc():
+    """Registered gradients (parity: RegisterGradient in
+    horovod/tensorflow/mpi_ops.py): tape.gradient THROUGH a bare
+    collective must equal the DistributedGradientTape result, and the
+    allgather/broadcast adjoints must follow the reference rules."""
+
+    def body():
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvd
+
+        hvd.init()
+        r = hvd.rank()
+        out = {}
+
+        # grad of allreduce == allreduce of grad: a replicated weight
+        # used through a bare averaged allreduce, with a RANK-LOCAL
+        # loss on top, must produce the same gradient as
+        # DistributedGradientTape over the equivalent local loss —
+        # the backward allreduce averages the rank-dependent upstream
+        # grads exactly like the tape wrapper averages local grads.
+        w = tf.Variable([[2.0]])  # replicated start
+        c = float(10 * (r + 1))  # rank-local coefficient
+        with tf.GradientTape() as tape:
+            red = hvd.allreduce(w, op=hvd.Average)
+            loss = tf.reduce_sum(red * c)
+        (g_bare,) = tape.gradient(loss, [w])
+        out["bare"] = g_bare.numpy().ravel().tolist()
+
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(w * c)
+        dtape = hvd.DistributedGradientTape(tape)
+        (g_dt,) = dtape.gradient(loss, [w])
+        out["dtape"] = g_dt.numpy().ravel().tolist()
+
+        # allgather grad: summed upstream grad, sliced to this rank's
+        # rows — rank r contributed r+1 rows
+        x = tf.Variable(tf.fill((r + 1, 2), 1.0))
+        with tf.GradientTape() as tape:
+            gathered = hvd.allgather(x)  # (3, 2)
+            coeff = tf.constant([[1.0], [2.0], [3.0]])
+            loss = tf.reduce_sum(gathered * coeff)
+        (g,) = tape.gradient(loss, [x])
+        out["gather_grad"] = g.numpy().tolist()
+
+        # broadcast grad: reduce-to-root — root sums all ranks' grads,
+        # non-roots get zeros
+        b = tf.Variable([float(r + 5)])
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(
+                hvd.broadcast(b, root_rank=0) * float(r + 1))
+        (g,) = tape.gradient(loss, [b])
+        out["bcast_grad"] = g.numpy().tolist()
+        return (r, out)
+
+    results = run(body, np=2, cpu_devices=1, env=_ENV)
+    for r, out in results:
+        # both paths average the per-rank coefficients: avg(10, 20)
+        assert out["bare"] == out["dtape"] == [15.0]
+        # upstream grads (the coeffs, identical on both ranks) are
+        # SUMMED across ranks — global loss = sum of per-rank losses —
+        # then sliced: rank 0 owned row 0 (coeff 1), rank 1 rows 1-2
+        if r == 0:
+            assert out["gather_grad"] == [[2.0, 2.0]]
+        else:
+            assert out["gather_grad"] == [[4.0, 4.0], [6.0, 6.0]]
+        # broadcast grad: sum of per-rank upstream coeffs (1+2)=3 at
+        # root, zero elsewhere
+        assert out["bcast_grad"] == ([3.0] if r == 0 else [0.0])
+
+
 def test_keras_fit_lockstep_2proc():
     def body():
         import numpy as np

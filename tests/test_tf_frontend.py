@@ -103,6 +103,84 @@ class TestTfOps:
         assert hvd_tf.size() == 1 and hvd_tf.rank() == 0
 
 
+class TestRegisteredGradients:
+    """tf.custom_gradient registration on the bare collectives
+    (parity: RegisterGradient('HorovodAllreduce'/'HorovodAllgather'/
+    'HorovodBroadcast'/...) in horovod/tensorflow/mpi_ops.py).  At
+    size 1 every rule degenerates to a checkable closed form; the
+    cross-rank behavior is covered in test_multiprocess_tf."""
+
+    def test_allreduce_grad_is_allreduce_of_grad(self, hvt):
+        x = tf.constant([1.0, 2.0, 3.0])
+        with tf.GradientTape() as t:
+            t.watch(x)
+            y = tf.reduce_sum(hvd_tf.allreduce(x * 2.0, op=hvd_tf.Sum))
+        np.testing.assert_allclose(
+            t.gradient(y, x).numpy(), [2.0, 2.0, 2.0])
+
+    def test_allreduce_grad_in_graph_mode(self, hvt):
+        x = tf.constant([1.0, 2.0])
+
+        @tf.function
+        def f(x):
+            with tf.GradientTape() as t:
+                t.watch(x)
+                y = tf.reduce_sum(
+                    hvd_tf.allreduce(x, op=hvd_tf.Average) * 4.0)
+            return t.gradient(y, x)
+
+        np.testing.assert_allclose(f(x).numpy(), [4.0, 4.0])
+
+    def test_allreduce_minmax_grad_rejected(self, hvt):
+        x = tf.constant([1.0])
+        with tf.GradientTape() as t:
+            t.watch(x)
+            y = hvd_tf.allreduce(x, op=hvd_tf.Min)
+        with pytest.raises(NotImplementedError, match="MIN"):
+            t.gradient(y, x)
+
+    def test_allgather_grad_slices_own_rows(self, hvt):
+        x = tf.constant([[1.0], [1.0]])
+        with tf.GradientTape() as t:
+            t.watch(x)
+            y = tf.reduce_sum(
+                hvd_tf.allgather(x) * tf.constant([[2.0], [5.0]]))
+        np.testing.assert_allclose(
+            t.gradient(y, x).numpy(), [[2.0], [5.0]])
+
+    def test_broadcast_grad_reduces_to_root(self, hvt):
+        x = tf.constant([1.0, 1.0])
+        with tf.GradientTape() as t:
+            t.watch(x)
+            y = tf.reduce_sum(hvd_tf.broadcast(x, root_rank=0) * 3.0)
+        np.testing.assert_allclose(t.gradient(y, x).numpy(), [3.0, 3.0])
+
+    def test_reducescatter_grad_is_allgather(self, hvt):
+        x = tf.constant([[1.0], [2.0]])
+        with tf.GradientTape() as t:
+            t.watch(x)
+            y = tf.reduce_sum(
+                hvd_tf.reducescatter(x, op=hvd_tf.Sum) * 7.0)
+        np.testing.assert_allclose(
+            t.gradient(y, x).numpy(), [[7.0], [7.0]])
+
+    def test_alltoall_grad_routes_back(self, hvt):
+        x = tf.constant([1.0, 2.0, 3.0])
+        with tf.GradientTape() as t:
+            t.watch(x)
+            out, _ = hvd_tf.alltoall(x, splits=[3])
+            y = tf.reduce_sum(out * 5.0)
+        np.testing.assert_allclose(
+            t.gradient(y, x).numpy(), [5.0, 5.0, 5.0])
+
+    def test_alltoall_equal_splits_grad(self, hvt):
+        x = tf.constant([1.0, 2.0])
+        with tf.GradientTape() as t:
+            t.watch(x)
+            y = tf.reduce_sum(hvd_tf.alltoall(x) * 2.0)
+        np.testing.assert_allclose(t.gradient(y, x).numpy(), [2.0, 2.0])
+
+
 class TestDistributedGradientTape:
     def test_gradients_pass_through(self, hvt):
         w = tf.Variable([[1.0], [2.0]])
