@@ -121,7 +121,10 @@ class HorovodModel(Params):
 
     def transform(self, df):
         """Append prediction columns; returns the same frame kind it
-        was given (pandas → pandas copy, dict → dict copy)."""
+        was given (pandas → pandas copy, dict → dict copy, pyspark →
+        pandas)."""
+        if hasattr(df, "toPandas") and not hasattr(df, "assign"):
+            df = df.toPandas()  # collect ONCE; to_columns reuses it
         features = data_mod.to_columns(df, list(self.getFeatureCols()))
         outputs = self._predict_columns(features)
         names = self._output_col_names()
@@ -133,6 +136,4 @@ class HorovodModel(Params):
             out = dict(df)
             out.update(zip(names, outputs))
             return out
-        if hasattr(df, "toPandas") and not hasattr(df, "assign"):
-            df = df.toPandas()
         return df.assign(**dict(zip(names, outputs)))

@@ -27,12 +27,16 @@ class Params:
     _param_defs: Dict[str, Any] = {}
 
     def __init__(self, **kwargs):
+        import copy
+
         # merge param tables down the MRO so Torch/Keras subclasses
-        # inherit the shared EstimatorParams names
+        # inherit the shared EstimatorParams names; deep-copied so a
+        # mutable default ([], {}) appended to on one instance cannot
+        # leak into the class table and every later instance
         defs: Dict[str, Any] = {}
         for klass in reversed(type(self).__mro__):
             defs.update(getattr(klass, "_param_defs", {}))
-        self._params = dict(defs)
+        self._params = copy.deepcopy(defs)
         unknown = set(kwargs) - set(defs)
         if unknown:
             raise ValueError(
@@ -97,7 +101,9 @@ class EstimatorParams(Params):
         "run_id": None,
         "train_steps_per_epoch": None,
         "validation_steps_per_epoch": None,
-        "transformation_fn": None,  # per-batch (features, labels) hook
+        # (features, labels) hook applied to each rank's shard at data
+        # load — one contract across the torch and keras trainers
+        "transformation_fn": None,
         "partitions_per_process": None,   # petastorm-era; ignored
         "train_reader_num_workers": None, # petastorm-era; ignored
         "val_reader_num_workers": None,   # petastorm-era; ignored

@@ -62,6 +62,11 @@ def _keras_trainer(spec: Dict[str, Any]):
     run_id = spec["run_id"]
     shard = load_shard(store.get_train_data_path(), TRAIN_NPZ,
                        hvd.rank(), hvd.size())
+    if len(next(iter(shard.values()))) == 0:
+        raise ValueError(
+            f"rank {hvd.rank()}'s training shard is empty "
+            f"({spec['n_train']} rows over {hvd.size()} ranks); "
+            "reduce num_proc or provide more data")
 
     feature_cols = p["feature_cols"]
     label_cols = p["label_cols"]
@@ -77,7 +82,11 @@ def _keras_trainer(spec: Dict[str, Any]):
 
     x, y = xy(shard)
     fit_kwargs: Dict[str, Any] = {}
-    if spec["n_val"]:
+    # validation engages only when EVERY rank's strided shard is
+    # non-empty (rows[r::size] nonempty iff r < n_val) — a per-rank
+    # skip would desync the metric-averaging collectives, and an empty
+    # shard would crash keras mid-fit while peers sit in a collective
+    if spec["n_val"] >= hvd.size():
         fit_kwargs["validation_data"] = xy(
             load_shard(store.get_val_data_path(), VAL_NPZ,
                        hvd.rank(), hvd.size()))

@@ -65,7 +65,10 @@ def _torch_trainer(spec: Dict[str, Any]):
     shard = load_shard(store.get_train_data_path(), TRAIN_NPZ,
                        hvd.rank(), hvd.size())
     val_shard = None
-    if spec["n_val"]:
+    # every rank must have val rows (rows[r::size] nonempty iff
+    # r < n_val) or none may evaluate: the per-epoch val_loss
+    # allreduce is collective
+    if spec["n_val"] >= hvd.size():
         val_shard = load_shard(store.get_val_data_path(), VAL_NPZ,
                                hvd.rank(), hvd.size())
 
@@ -78,6 +81,19 @@ def _torch_trainer(spec: Dict[str, Any]):
 
     features = tensors(feature_cols, shard)
     labels = tensors(label_cols, shard)
+    # transformation_fn applies to the rank's (features, labels) at
+    # data load — one contract shared with the keras trainer, so the
+    # same hook behaves identically under either estimator; training,
+    # per-epoch metrics and validation all see the transformed data
+    if transformation_fn is not None:
+        features, labels = transformation_fn(features, labels)
+    val_features = val_labels = None
+    if val_shard is not None:
+        val_features = tensors(feature_cols, val_shard)
+        val_labels = tensors(label_cols, val_shard)
+        if transformation_fn is not None:
+            val_features, val_labels = transformation_fn(
+                val_features, val_labels)
 
     # Horovod idiom: everyone starts from rank 0's state, gradients
     # are averaged in the wrapped optimizer.
@@ -116,8 +132,6 @@ def _torch_trainer(spec: Dict[str, Any]):
                 break
             fb = [f[idx] for f in features]
             lb = [y[idx] for y in labels]
-            if transformation_fn is not None:
-                fb, lb = transformation_fn(fb, lb)
             optimizer.zero_grad()
             _, loss = forward_loss(fb, lb)
             loss.backward()
@@ -142,12 +156,10 @@ def _torch_trainer(spec: Dict[str, Any]):
                 mv = hvd.allreduce(torch.as_tensor([float(m)]),
                                    name=f"metric_{name}")
                 history.setdefault(name, []).append(float(mv[0]))
-        if val_shard is not None:
+        if val_features is not None:
             model.eval()
             with torch.no_grad():
-                vf = tensors(feature_cols, val_shard)
-                vl = tensors(label_cols, val_shard)
-                _, vloss = forward_loss(vf, vl)
+                _, vloss = forward_loss(val_features, val_labels)
             vavg = hvd.allreduce(
                 torch.tensor([float(vloss)]), name="val_loss")
             history.setdefault("val_loss", []).append(float(vavg[0]))
