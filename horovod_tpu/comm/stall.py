@@ -87,6 +87,22 @@ class SyncStallInspector:
         except Exception:
             return None
 
+    def _marks(self, set_id: int, seq: int) -> Optional[Dict[int, str]]:
+        """All posted marks for (set, seq) in ONE RPC via the KV's
+        directory get — the happy path costs one roundtrip regardless
+        of P.  Returns None when the client has no usable dir-get
+        (test fakes, older clients), so the caller can fall back to
+        per-rank try_get; {} means 'working, nothing posted yet'."""
+        prefix = f"{_NS}/{self.gen}/{set_id}/{seq}/"
+        dir_get = getattr(self._kv, "key_value_dir_get", None)
+        if dir_get is None:
+            return None
+        try:
+            return {int(k.rsplit("/", 1)[-1]): v
+                    for k, v in dir_get(prefix)}
+        except Exception:
+            return None
+
     # -- the rendezvous -----------------------------------------------
     def rendezvous(self, set_id: int, member_ranks, desc: str):
         """Block until every member rank posts a mark for this set's
@@ -99,10 +115,19 @@ class SyncStallInspector:
         start = time.monotonic()
         next_warn = self.warn_s
         sleep = 0.0
+        use_dir = True
         while pending:
+            found = self._marks(set_id, seq) if use_dir else None
+            if found is None:
+                use_dir = False
+                found = {}
+                for r in pending:
+                    val = self._try_get(self._key(set_id, seq, r))
+                    if val is not None:
+                        found[r] = val
             still = []
             for r in pending:
-                val = self._try_get(self._key(set_id, seq, r))
+                val = found.get(r)
                 if val is None:
                     still.append(r)
                 elif val != desc:
@@ -132,8 +157,9 @@ class SyncStallInspector:
                     "waited %.1fs; ranks not at the rendezvous: %s",
                     desc, set_id, seq, elapsed, pending,
                 )
-            # back off from a hot spin to a 50ms poll
-            sleep = min(0.05, sleep + 0.002)
+            # back off from a near-spin (normal skew is sub-ms) to a
+            # 20ms poll for genuinely late peers
+            sleep = min(0.02, sleep * 2 if sleep else 0.0002)
             time.sleep(sleep)
 
         # rolling cleanup: every member has posted seq, so nobody can

@@ -86,6 +86,14 @@ def _keras_trainer(spec: Dict[str, Any]):
     # non-empty (rows[r::size] nonempty iff r < n_val) — a per-rank
     # skip would desync the metric-averaging collectives, and an empty
     # shard would crash keras mid-fit while peers sit in a collective
+    if 0 < spec["n_val"] < hvd.size() and hvd.rank() == 0:
+        import logging
+
+        logging.getLogger("horovod_tpu").warning(
+            "validation disabled: %d validation rows cannot cover %d "
+            "ranks (every rank needs >=1 row or the metric collectives "
+            "desync); grow the validation split or reduce num_proc",
+            spec["n_val"], hvd.size())
     if spec["n_val"] >= hvd.size():
         fit_kwargs["validation_data"] = xy(
             load_shard(store.get_val_data_path(), VAL_NPZ,

@@ -68,6 +68,14 @@ def _torch_trainer(spec: Dict[str, Any]):
     # every rank must have val rows (rows[r::size] nonempty iff
     # r < n_val) or none may evaluate: the per-epoch val_loss
     # allreduce is collective
+    if 0 < spec["n_val"] < hvd.size() and hvd.rank() == 0:
+        import logging
+
+        logging.getLogger("horovod_tpu").warning(
+            "validation disabled: %d validation rows cannot cover %d "
+            "ranks (every rank needs >=1 row or the val_loss allreduce "
+            "desyncs); grow the validation split or reduce num_proc",
+            spec["n_val"], hvd.size())
     if spec["n_val"] >= hvd.size():
         val_shard = load_shard(store.get_val_data_path(), VAL_NPZ,
                                hvd.rank(), hvd.size())

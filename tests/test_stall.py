@@ -27,7 +27,8 @@ _ENV = {"PYTHONPATH": _REPO_ROOT + os.pathsep
 
 
 class FakeKV:
-    """Dict-backed stand-in for the coordination-service client."""
+    """Dict-backed stand-in for the coordination-service client,
+    including the directory get the fast path uses."""
 
     def __init__(self):
         self.d = {}
@@ -43,14 +44,33 @@ class FakeKV:
                 raise KeyError(k)
             return self.d[k]
 
+    def key_value_dir_get(self, prefix):
+        with self.lock:
+            return [(k, v) for k, v in self.d.items()
+                    if k.startswith(prefix)]
+
     def key_value_delete(self, k):
         with self.lock:
             self.d.pop(k, None)
 
 
+class FakeKVNoDir(FakeKV):
+    """An older client without dir-get: exercises the per-rank
+    try_get fallback branch."""
+
+    key_value_dir_get = None
+
+
+@pytest.fixture(params=[FakeKV, FakeKVNoDir],
+                ids=["dir-get", "try-get-fallback"])
+def kv(request):
+    """Both client shapes: the one-RPC dir-get fast path and the
+    per-rank try_get fallback must behave identically."""
+    return request.param()
+
+
 class TestInspectorUnit:
-    def test_completes_when_all_marks_present(self):
-        kv = FakeKV()
+    def test_completes_when_all_marks_present(self, kv):
         # peer (rank 1) already posted its mark for seq 0
         kv.key_value_set("hvtstall/1/0/0/1", "allreduce:x")
         insp = SyncStallInspector(kv, rank=0, warn_s=60, abort_s=0,
@@ -58,8 +78,7 @@ class TestInspectorUnit:
         insp.rendezvous(0, [0, 1], "allreduce:x")  # returns, no raise
         assert "hvtstall/1/0/0/0" in kv.d  # own mark posted
 
-    def test_abort_names_missing_ranks(self):
-        kv = FakeKV()
+    def test_abort_names_missing_ranks(self, kv):
         insp = SyncStallInspector(kv, rank=0, warn_s=0.05, abort_s=0.2,
                                   generation=1)
         t0 = time.monotonic()
@@ -70,8 +89,7 @@ class TestInspectorUnit:
         assert "allreduce:y" in msg
         assert "[1, 2]" in msg  # the missing ranks, by name
 
-    def test_descriptor_mismatch_raises_immediately(self):
-        kv = FakeKV()
+    def test_descriptor_mismatch_raises_immediately(self, kv):
         kv.key_value_set("hvtstall/1/0/0/1", "broadcast:z")
         insp = SyncStallInspector(kv, rank=0, warn_s=60, abort_s=0,
                                   generation=1)
@@ -80,8 +98,7 @@ class TestInspectorUnit:
             insp.rendezvous(0, [0, 1], "allreduce:z")
         assert time.monotonic() - t0 < 1.0  # no deadline needed
 
-    def test_warn_then_recover(self, caplog):
-        kv = FakeKV()
+    def test_warn_then_recover(self, kv, caplog):
         insp = SyncStallInspector(kv, rank=0, warn_s=0.05, abort_s=0,
                                   generation=1)
 
@@ -98,8 +115,7 @@ class TestInspectorUnit:
                   if "stalled collective" in r.getMessage()]
         assert stalls and "[1]" in stalls[0].getMessage()
 
-    def test_rolling_cleanup_keeps_kv_bounded(self):
-        kv = FakeKV()
+    def test_rolling_cleanup_keeps_kv_bounded(self, kv):
         insp = SyncStallInspector(kv, rank=0, warn_s=60, abort_s=0,
                                   generation=1)
         for seq in range(3):
@@ -109,8 +125,7 @@ class TestInspectorUnit:
         # only the newest own mark survives (seq 2)
         assert own == ["hvtstall/1/0/2/0"]
 
-    def test_generation_namespacing_ignores_stale_marks(self):
-        kv = FakeKV()
+    def test_generation_namespacing_ignores_stale_marks(self, kv):
         # a PREVIOUS session's mark with a different descriptor must
         # not trip the mismatch check after re-init
         kv.key_value_set("hvtstall/1/0/0/1", "old-op")
