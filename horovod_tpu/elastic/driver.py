@@ -32,6 +32,7 @@ from ..runner.launch import (
     build_ssh_command,
     build_worker_env,
     find_free_port,
+    ssh_options_from_args,
     uniform_local_size,
 )
 from .discovery import HostDiscoveryScript, HostManager
@@ -127,7 +128,8 @@ class ElasticDriver:
                 cmd = list(self.command)
             else:
                 cmd = build_ssh_command(
-                    slot.hostname, self.command, env, cwd=os.getcwd()
+                    slot.hostname, self.command, env, cwd=os.getcwd(),
+                    **ssh_options_from_args(self.args),
                 )
             workers.append(
                 safe_shell_exec.WorkerProcess(

@@ -68,10 +68,33 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
         prog="hvtpurun",
         description="Launch a horovod_tpu job on N worker processes.",
     )
+    p.add_argument("-v", "--version", action="store_true",
+                   dest="show_version",
+                   help="print the horovod_tpu version and exit")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   help="print build capabilities (frameworks, "
+                        "collectives, native core) and exit")
     p.add_argument("-np", "--num-proc", type=int, dest="np", default=None,
                    help="number of worker processes (ranks)")
     p.add_argument("-H", "--hosts", dest="hosts", default=None,
                    help='host spec "h1:2,h2:2" (default: localhost:np)')
+    p.add_argument("-hostfile", "--hostfile", dest="hostfile",
+                   default=None,
+                   help="file of hosts, one per line: 'host slots=N' "
+                        "(reference format) or 'host:N'")
+    p.add_argument("-p", "--ssh-port", type=int, dest="ssh_port",
+                   default=None,
+                   help="ssh port for remote workers (parity: "
+                        "horovodrun -p)")
+    p.add_argument("-i", "--ssh-identity-file", dest="ssh_identity_file",
+                   default=None,
+                   help="ssh identity (private key) file for remote "
+                        "workers (parity: horovodrun -i)")
+    p.add_argument("-x", dest="env_passthrough", action="append",
+                   default=[], metavar="VAR[=VAL]",
+                   help="pass an environment variable to every worker "
+                        "(repeatable); VAR alone copies the launcher's "
+                        "value, VAR=VAL sets it explicitly")
     p.add_argument("--network-interface", dest="nic", default=None,
                    help="address workers use to reach the coordinator "
                         "(default: first host, or 127.0.0.1 if local)")
@@ -92,6 +115,19 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     p.add_argument("--fusion-threshold-mb", type=float, default=None)
     p.add_argument("--cycle-time-ms", type=float, default=None)
     p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--disable-cache", action="store_true",
+                   help="disable the response cache (parity: "
+                        "horovodrun --disable-cache; equals "
+                        "--cache-capacity 0)")
+    p.add_argument("--hierarchical-allreduce", action="store_true",
+                   help="force two-stage (intra-host, cross-host) "
+                        "allreduce on uniform layouts")
+    p.add_argument("--autotune-warmup-samples", type=int, default=None)
+    p.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    p.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                   default=None,
+                   help="max Bayesian-optimization samples (maps to "
+                        "HVTPU_AUTOTUNE_GP_SAMPLES)")
     p.add_argument("--timeline-filename", default=None)
     p.add_argument("--timeline-mark-cycles", action="store_true")
     p.add_argument("--autotune", action="store_true")
@@ -102,6 +138,9 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                    help="seconds before warning about a stalled collective")
     p.add_argument("--stall-shutdown-time", type=float, default=None,
                    help="seconds before aborting a stalled collective")
+    p.add_argument("--no-stall-check", action="store_true",
+                   help="disable stall detection entirely (parity: "
+                        "horovodrun --no-stall-check)")
     p.add_argument("--log-level", default=None,
                    choices=["trace", "debug", "info", "warning", "error",
                             "fatal"])
@@ -121,6 +160,15 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     args = p.parse_args(argv)
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
+    if args.show_version or args.check_build:
+        return args  # informational modes need no command/np
+    if args.hostfile:
+        if args.hosts:
+            p.error("--hosts and --hostfile are mutually exclusive")
+        try:
+            args.hosts = parse_hostfile(args.hostfile)
+        except (OSError, ValueError) as e:
+            p.error(f"--hostfile {args.hostfile}: {e}")
     if not args.host_discovery_script:
         if args.np is None:
             p.error("-np is required (unless --host-discovery-script)")
@@ -129,6 +177,31 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     if not args.command:
         p.error("no worker command given")
     return args
+
+
+def parse_hostfile(path: str) -> str:
+    """Hostfile → host-spec string.  Accepts the reference's format
+    ('hostname slots=N', horovod/runner/launch.py parse_host_files)
+    and the compact 'hostname:N'; blank lines and # comments skipped."""
+    specs = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if ":" in line and "slots" not in line:
+                specs.append(line)
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for tok in parts[1:]:
+                if tok.startswith("slots="):
+                    slots = int(tok[len("slots="):])
+            specs.append(f"{host}:{slots}")
+    if not specs:
+        raise ValueError(f"hostfile {path!r} contains no hosts")
+    return ",".join(specs)
 
 
 def uniform_local_size(slots: List[SlotInfo]) -> int:
@@ -189,6 +262,11 @@ def build_worker_env(
             "HVTPU_CPU_DEVICES": args.cpu_devices,
             "HVTPU_ELASTIC_TIMEOUT": args.elastic_timeout,
             "HVTPU_START_TIMEOUT": args.start_timeout,
+            "HVTPU_AUTOTUNE_WARMUP_SAMPLES": args.autotune_warmup_samples,
+            "HVTPU_AUTOTUNE_STEPS_PER_SAMPLE":
+                args.autotune_steps_per_sample,
+            "HVTPU_AUTOTUNE_GP_SAMPLES":
+                args.autotune_bayes_opt_max_samples,
         }
         for k, v in flag_env.items():
             if v is not None:
@@ -197,7 +275,37 @@ def build_worker_env(
             env["HVTPU_AUTOTUNE"] = "1"
         if args.timeline_mark_cycles:
             env["HVTPU_TIMELINE_MARK_CYCLES"] = "1"
+        if args.disable_cache:
+            env["HVTPU_CACHE_CAPACITY"] = "0"
+        if args.no_stall_check:
+            env["HVTPU_STALL_CHECK_DISABLE"] = "1"
+        if args.hierarchical_allreduce:
+            env["HVTPU_HIERARCHICAL_ALLREDUCE"] = "1"
+        # -x VAR[=VAL]: explicit per-worker env passthrough (parity:
+        # mpirun -x, which horovodrun users reach via --mpi-args; the
+        # ssh path forwards only the HVTPU_/JAX_/... namespace, so -x
+        # is how arbitrary app variables cross hosts)
+        for spec in args.env_passthrough:
+            if "=" in spec:
+                k, v = spec.split("=", 1)
+                env[k] = v
+            elif spec in base_env:
+                env[spec] = base_env[spec]
     return env
+
+
+def ssh_options_from_args(args: Optional[argparse.Namespace]) -> Dict:
+    """The launcher-flag subset build_ssh_command consumes — one
+    derivation shared by the static and elastic spawn paths so `-p`,
+    `-i`, and `-x` can never apply in one mode and not the other."""
+    if args is None:
+        return {}
+    return {
+        "ssh_port": args.ssh_port,
+        "ssh_identity_file": args.ssh_identity_file,
+        "extra_env_keys": [s.split("=", 1)[0]
+                           for s in args.env_passthrough],
+    }
 
 
 def build_ssh_command(
@@ -206,17 +314,21 @@ def build_ssh_command(
     env: Dict[str, str],
     cwd: Optional[str] = None,
     ssh_port: Optional[int] = None,
+    ssh_identity_file: Optional[str] = None,
+    extra_env_keys: Sequence[str] = (),
 ) -> List[str]:
     """Remote worker command line (parity: get_remote_command /
     get_ssh_command in horovod/runner/util/remote.py).  Only the
-    HVTPU_*/JAX_*/XLA_* env subset is forwarded, like the reference
+    HVTPU_*/JAX_*/XLA_* env subset is forwarded — plus any ``-x``
+    passthrough names in ``extra_env_keys`` — like the reference
     forwarding its own namespace with ``env`` on the remote shell.
     """
+    extra = set(extra_env_keys)
     exports = " ".join(
         f"{k}={shlex.quote(v)}"
         for k, v in sorted(env.items())
-        if k.startswith(("HVTPU_", "HOROVOD_", "JAX_", "XLA_", "TPU_",
-                         "PYTHONPATH"))
+        if (k.startswith(("HVTPU_", "HOROVOD_", "JAX_", "XLA_", "TPU_",
+                          "PYTHONPATH")) or k in extra)
         # never serialize the HMAC key itself into argv — it would be
         # world-readable via /proc/*/cmdline on both ends; the key
         # rides a 0600 file (HVTPU_SECRET_FILE) instead
@@ -240,6 +352,8 @@ def build_ssh_command(
                "-o", "StrictHostKeyChecking=no"]
         if ssh_port:
             ssh += ["-p", str(ssh_port)]
+        if ssh_identity_file:
+            ssh += ["-i", ssh_identity_file]
     return ssh + [hostname, inner]
 
 
@@ -262,6 +376,7 @@ def launch_workers(
     base_env = dict(base_env if base_env is not None else os.environ)
     stdout_lock = threading.Lock()
     uniform = uniform_local_size(slots)
+    ssh_opts = ssh_options_from_args(args)
     workers: List[safe_shell_exec.WorkerProcess] = []
     try:
         for slot in slots:
@@ -273,7 +388,8 @@ def launch_workers(
                 cmd = list(command)
             else:
                 cmd = build_ssh_command(
-                    slot.hostname, command, env, cwd=os.getcwd()
+                    slot.hostname, command, env, cwd=os.getcwd(),
+                    **ssh_opts,
                 )
             workers.append(
                 safe_shell_exec.WorkerProcess(
@@ -299,8 +415,53 @@ def launch_workers(
     )
 
 
+def _check_build() -> int:
+    """Parity: horovodrun -cb (check_build in the reference's
+    launch.py): print version + available capabilities and exit."""
+    from .. import version as _version
+
+    print(f"hvtpurun (horovod_tpu) v{_version.__version__}")
+    import horovod_tpu as hvt
+
+    def mark(flag):
+        return "[X]" if flag else "[ ]"
+
+    def probe(name):
+        try:
+            __import__(name)
+            return True
+        except ImportError:
+            return False
+
+    try:
+        from ..native import core as native_core
+        native = bool(native_core.available())
+    except Exception:
+        native = False
+    print("Available frameworks:")
+    print(f"    {mark(True)} JAX")
+    print(f"    {mark(probe('tensorflow'))} TensorFlow")
+    print(f"    {mark(probe('torch'))} PyTorch")
+    print(f"    {mark(probe('keras'))} Keras")
+    print("Available controllers:")
+    print(f"    {mark(native)} native C++ core")
+    print(f"    {mark(True)} Python twin")
+    print("Available tensor operations:")
+    print(f"    {mark(hvt.xla_built())} XLA collectives (ICI/DCN)")
+    print(f"    {mark(bool(hvt.nccl_built()))} NCCL")
+    print(f"    {mark(hvt.mpi_built())} MPI")
+    return 0
+
+
 def _run(args: argparse.Namespace) -> int:
     """Parity: horovod/runner/launch.py _run — static vs elastic split."""
+    if args.show_version:
+        from .. import version as _version
+
+        print(_version.__version__)
+        return 0
+    if args.check_build:
+        return _check_build()
     if args.host_discovery_script:
         from ..elastic.driver import run_elastic
 

@@ -241,6 +241,106 @@ class TestNicDiscovery:
         assert cmd[2] == "h1"
         assert "HVTPU_RANK=1" in cmd[3]
 
+    def test_ssh_port_and_identity_flags(self, monkeypatch):
+        from horovod_tpu.runner import launch
+
+        monkeypatch.delenv("HVTPU_SSH_COMMAND", raising=False)
+        cmd = launch.build_ssh_command(
+            "h1", ["python", "t.py"], {"HVTPU_RANK": "0"},
+            ssh_port=2222, ssh_identity_file="/k/id_ed25519")
+        ssh_prefix = cmd[:cmd.index("h1")]
+        assert "-p" in ssh_prefix and "2222" in ssh_prefix
+        assert "-i" in ssh_prefix and "/k/id_ed25519" in ssh_prefix
+
+    def test_env_passthrough_crosses_ssh(self, monkeypatch):
+        # -x vars must survive the ssh export filter (they are outside
+        # the HVTPU_/JAX_ namespace, which is the filter's default)
+        from horovod_tpu.runner import launch
+
+        monkeypatch.delenv("HVTPU_SSH_COMMAND", raising=False)
+        env = {"HVTPU_RANK": "0", "MY_APP_FLAG": "on", "OTHER": "x"}
+        cmd = launch.build_ssh_command(
+            "h1", ["python", "t.py"], env,
+            extra_env_keys=["MY_APP_FLAG"])
+        inner = cmd[-1]
+        assert "MY_APP_FLAG=on" in inner
+        assert "OTHER" not in inner
+
+
+class TestFlagPlumbing:
+    """New round-4 flags → worker env (parity: horovodrun parse_args)."""
+
+    def _env_for(self, argv):
+        from horovod_tpu.runner import launch
+        from horovod_tpu.runner.hosts import get_host_assignments, \
+            parse_host_spec
+
+        args = launch.parse_args(argv + ["--", "python", "x.py"])
+        slots = get_host_assignments(parse_host_spec("localhost:2"), 2)
+        return launch.build_worker_env(
+            {"INHERITED": "yes"}, slots[0], "127.0.0.1", 1234, args)
+
+    def test_disable_cache_maps_to_capacity_zero(self):
+        env = self._env_for(["-np", "2", "--disable-cache"])
+        assert env["HVTPU_CACHE_CAPACITY"] == "0"
+
+    def test_no_stall_check_maps_to_disable(self):
+        env = self._env_for(["-np", "2", "--no-stall-check"])
+        assert env["HVTPU_STALL_CHECK_DISABLE"] == "1"
+
+    def test_hierarchical_allreduce_flag(self):
+        env = self._env_for(["-np", "2", "--hierarchical-allreduce"])
+        assert env["HVTPU_HIERARCHICAL_ALLREDUCE"] == "1"
+
+    def test_env_passthrough_set_and_copy(self):
+        env = self._env_for(
+            ["-np", "2", "-x", "FOO=bar", "-x", "INHERITED"])
+        assert env["FOO"] == "bar"
+        assert env["INHERITED"] == "yes"
+
+    def test_autotune_knobs(self):
+        env = self._env_for(
+            ["-np", "2", "--autotune",
+             "--autotune-warmup-samples", "5",
+             "--autotune-bayes-opt-max-samples", "20"])
+        assert env["HVTPU_AUTOTUNE"] == "1"
+        assert env["HVTPU_AUTOTUNE_WARMUP_SAMPLES"] == "5"
+        assert env["HVTPU_AUTOTUNE_GP_SAMPLES"] == "20"
+
+    def test_hostfile_reference_format(self, tmp_path):
+        from horovod_tpu.runner import launch
+
+        hf = tmp_path / "hosts"
+        hf.write_text("# cluster\nnode1 slots=4\nnode2:2\n\n")
+        assert launch.parse_hostfile(str(hf)) == "node1:4,node2:2"
+        args = launch.parse_args(
+            ["-np", "6", "--hostfile", str(hf), "--", "python", "x.py"])
+        assert args.hosts == "node1:4,node2:2"
+
+    def test_hostfile_and_hosts_conflict(self, tmp_path):
+        import pytest as _pytest
+
+        from horovod_tpu.runner import launch
+
+        hf = tmp_path / "hosts"
+        hf.write_text("node1 slots=4\n")
+        with _pytest.raises(SystemExit):
+            launch.parse_args(["-np", "2", "--hostfile", str(hf),
+                               "-H", "a:2", "--", "python", "x.py"])
+
+    def test_check_build_runs(self, capsys):
+        from horovod_tpu.runner import launch
+
+        assert launch.main(["-cb"]) == 0
+        out = capsys.readouterr().out
+        assert "Available frameworks" in out and "JAX" in out
+
+    def test_version_runs(self, capsys):
+        from horovod_tpu.runner import launch
+
+        assert launch.main(["--version"]) == 0
+        assert capsys.readouterr().out.strip()
+
 
 class TestSignedFunctionChannel:
     """HMAC signing of run()'s pickle channel (parity:
