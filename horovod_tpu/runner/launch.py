@@ -189,10 +189,14 @@ def parse_hostfile(path: str) -> str:
             line = line.split("#", 1)[0].strip()
             if not line:
                 continue
-            if ":" in line and "slots" not in line:
-                specs.append(line)
-                continue
             parts = line.split()
+            if "slots=" not in line and ":" in parts[0]:
+                if len(parts) > 1:
+                    raise ValueError(
+                        f"malformed hostfile line {line!r}: compact "
+                        "'host:N' lines take one entry per line")
+                specs.append(parts[0])
+                continue
             host = parts[0]
             slots = 1
             for tok in parts[1:]:
@@ -291,6 +295,12 @@ def build_worker_env(
                 env[k] = v
             elif spec in base_env:
                 env[spec] = base_env[spec]
+            else:
+                # mpirun parity: -x of an unset variable warns instead
+                # of silently launching workers without it
+                print(f"hvtpurun: warning: -x {spec}: variable not "
+                      "found in the launcher environment",
+                      file=sys.stderr)
     return env
 
 

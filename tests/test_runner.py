@@ -310,6 +310,11 @@ class TestFlagPlumbing:
     def test_hostfile_reference_format(self, tmp_path):
         from horovod_tpu.runner import launch
 
+        # a hostname CONTAINING 'slots' must still parse compactly
+        hf2 = tmp_path / "hosts2"
+        hf2.write_text("gpu-slots-01:8\nbare-host\n")
+        assert launch.parse_hostfile(str(hf2)) == \
+            "gpu-slots-01:8,bare-host:1"
         hf = tmp_path / "hosts"
         hf.write_text("# cluster\nnode1 slots=4\nnode2:2\n\n")
         assert launch.parse_hostfile(str(hf)) == "node1:4,node2:2"
