@@ -25,13 +25,15 @@ local math, so the same user program runs unmodified from a laptop to a
 pod — collectives over local devices belong to the SPMD layer instead.
 
 Pod shape (P > 1, D > 1 local devices): the eager data plane stays
-process-granularity — rank = process.  ``allreduce`` AND ``broadcast``
-shard payloads of at least ``_MULTIDEV_MIN_BYTES`` across ALL D local
-devices (``_multidev_mesh``: D parallel lanes, each moving 1/D of the
-payload — same numerics, D× the link bandwidth;
+process-granularity — rank = process.  Every bulk collective —
+``allreduce``, ``broadcast``, ``allgather``, ``reducescatter``
+(Sum/Average), ``alltoall`` — shards payloads of at least
+``_MULTIDEV_MIN_BYTES`` across ALL D local devices
+(``_multidev_mesh``: D parallel lanes, each moving 1/D of the payload
+— same numerics, D× the link bandwidth;
 ``HVTPU_EAGER_MULTIDEVICE=0`` disables, snapshotted at init).  Smaller
-payloads and the other eager ops ride the process's FIRST local
-device (``Topology.proc_mesh``); either way the remaining devices are
+payloads ride the process's FIRST local device
+(``Topology.proc_mesh``); either way the remaining devices are
 primarily the jit/SPMD path's compute surface (``world_mesh`` spans
 all P×D devices).  ``init()`` logs the layout at INFO so a D>1
 profile of an eager-only program reads as designed behavior.
@@ -136,26 +138,31 @@ def _multidev_mesh_or_none(ps):
     return mesh
 
 
+def _lane_layout(mesh: Mesh, inner: int):
+    """Shared lane-stacking bookkeeping: (p_count, d_count, chunk,
+    local_row) for this process, with ``chunk`` the ceil-div lane slice
+    of ``inner`` elements.  One implementation so the flat and
+    row-structured stackers can never disagree on membership or
+    padding."""
+    d_count = mesh.devices.shape[1]
+    chunk = -(-inner // d_count)
+    pid = jax.process_index()
+    for r, row in enumerate(mesh.devices):
+        if row[0].process_index == pid:
+            return mesh.devices.shape[0], d_count, chunk, r
+    raise RuntimeError("process not a member of the multidev mesh")
+
+
 def _stack_global_multidev(x, mesh: Mesh):
     """Global (P, D, chunk) f-contiguous array: shard (p, d) is process
     p's d-th slice of its flattened (padded) tensor, resident on that
     process's d-th device.  Returns (stacked, flat_size)."""
-    d_count = mesh.devices.shape[1]
     flat = x.reshape(-1)
     size = flat.shape[0]
-    chunk = -(-size // d_count)
+    p_count, d_count, chunk, local_row = _lane_layout(mesh, size)
     pad = chunk * d_count - size
     if pad:
         flat = jnp.pad(flat, (0, pad))
-    p_count = mesh.devices.shape[0]
-    pid = jax.process_index()
-    local_row = None
-    for r, row in enumerate(mesh.devices):
-        if row[0].process_index == pid:
-            local_row = r
-            break
-    if local_row is None:
-        raise RuntimeError("process not a member of the multidev mesh")
     sharding = NamedSharding(mesh, P(PROC_AXIS, LDEV_AXIS))
     locals_ = [
         jax.device_put(
@@ -168,6 +175,33 @@ def _stack_global_multidev(x, mesh: Mesh):
         (p_count, d_count, chunk), sharding, locals_
     )
     return stacked, size
+
+
+def _stack_global_multidev_rows(x, rows: int, mesh: Mesh):
+    """Row-structured lane stacking: ``x`` reshaped to (rows, inner)
+    with each row's inner bytes split across the D local devices →
+    global (P, rows, D, chunk) array sharded (PROC, None, LDEV, None);
+    shard (p, d) holds process p's lane-d slice of every row.  Used by
+    the lane reducescatter (rows = destination ranks) and alltoall
+    (rows = destination chunks).  Returns (stacked, inner_size)."""
+    flat = x.reshape(rows, -1)
+    inner = flat.shape[1]
+    p_count, d_count, chunk, local_row = _lane_layout(mesh, inner)
+    pad = chunk * d_count - inner
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    sharding = NamedSharding(mesh, P(PROC_AXIS, None, LDEV_AXIS))
+    locals_ = [
+        jax.device_put(
+            flat[:, d * chunk:(d + 1) * chunk][None, :, None, :],
+            mesh.devices[local_row][d],
+        )
+        for d in range(d_count)
+    ]
+    stacked = jax.make_array_from_single_device_arrays(
+        (p_count, rows, d_count, chunk), sharding, locals_
+    )
+    return stacked, inner
 
 
 def _hierarchical_mesh_or_none(st, ps, p: int):
@@ -342,6 +376,86 @@ def _jitted(kind: str, mesh: Mesh, static: Tuple):
                 mesh=mesh,
                 in_specs=(P(PROC_AXIS),),
                 out_specs=P(),
+                check_vma=False,
+            )(stacked)
+
+        return jax.jit(fn)
+
+    if kind == "allgather_multidev":
+        # lane-parallel allgather: lane d gathers every process's d-th
+        # payload slice over the proc links (1/D of the bytes per
+        # lane), then the lanes exchange locally so every device — and
+        # thus the process — holds the full (P, payload) result.
+        def fn(stacked):
+            def body(shard):
+                x = shard[0, 0]                      # (chunk,)
+                per_lane = lax.all_gather(
+                    x, PROC_AXIS, tiled=False)       # (P, chunk)
+                lanes = lax.all_gather(
+                    per_lane, LDEV_AXIS, tiled=False)  # (D, P, chunk)
+                return jnp.transpose(lanes, (1, 0, 2)).reshape(
+                    lanes.shape[1], -1)              # (P, D*chunk)
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(PROC_AXIS, LDEV_AXIS),),
+                out_specs=P(),
+                check_vma=False,
+            )(stacked)
+
+        return jax.jit(fn)
+
+    if kind == "reducescatter_multidev":
+        # lane-parallel reduce-scatter: lane d psum-scatters its 1/D
+        # slice of every destination chunk over the proc links, then
+        # the lanes reassemble this process's reduced rows locally.
+        (rop,) = static
+
+        def fn(stacked):
+            def body(shard):
+                x = shard[0, :, 0]                   # (P_dest, chunk)
+                red = lax.psum_scatter(
+                    x, PROC_AXIS, scatter_dimension=0, tiled=True,
+                )                                    # (1, chunk)
+                if rop == ReduceOp.AVERAGE:
+                    red = red / lax.axis_size(PROC_AXIS)
+                mine = lax.all_gather(
+                    red[0], LDEV_AXIS, tiled=True)   # (D*chunk,)
+                return mine[None]                    # (1, D*chunk)
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(PROC_AXIS, None, LDEV_AXIS),),
+                out_specs=P(PROC_AXIS),
+                check_vma=False,
+            )(stacked)
+
+        return jax.jit(fn)
+
+    if kind == "alltoall_multidev":
+        # lane-parallel alltoall: lane d exchanges its 1/D slice of
+        # every destination chunk, then the lanes reassemble the
+        # received-from-each-source payload locally.
+        def fn(stacked):
+            def body(shard):
+                x = shard[0, :, 0]                   # (P_dst, chunk)
+                ex = lax.all_to_all(
+                    x, PROC_AXIS, split_axis=0, concat_axis=0,
+                    tiled=True,
+                )                                    # (P_src, chunk)
+                lanes = lax.all_gather(
+                    ex, LDEV_AXIS, tiled=False)      # (D, P_src, chunk)
+                out = jnp.transpose(lanes, (1, 0, 2)).reshape(
+                    lanes.shape[1], -1)              # (P_src, D*chunk)
+                return out[None]
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(PROC_AXIS, None, LDEV_AXIS),),
+                out_specs=P(PROC_AXIS),
                 check_vma=False,
             )(stacked)
 
@@ -607,8 +721,17 @@ def allgather(tensor, *, process_set=None):
         if x.shape[0] == maxd
         else jnp.pad(x, [(0, maxd - x.shape[0])] + [(0, 0)] * (x.ndim - 1))
     )
-    stacked = _stack_global(padded, mesh)
-    gathered = _fetch(_jitted("allgather", mesh, ())(stacked))
+    # padded.nbytes is negotiated (maxd), so the lane routing guard is
+    # rank-consistent even with ragged per-rank dim0
+    md = (None if padded.nbytes < _MULTIDEV_MIN_BYTES
+          else _multidev_mesh_or_none(ps))
+    if md is not None:
+        stacked, flat_size = _stack_global_multidev(padded, md)
+        out = _fetch(_jitted("allgather_multidev", md, ())(stacked))
+        gathered = out[:, :flat_size].reshape((p,) + padded.shape)
+    else:
+        stacked = _stack_global(padded, mesh)
+        gathered = _fetch(_jitted("allgather", mesh, ())(stacked))
     # gathered: (P, maxd, ...); trim each rank's block to its size.
     if all(int(s) == maxd for s in sizes):
         return gathered.reshape((p * maxd,) + gathered.shape[2:])
@@ -693,9 +816,18 @@ def alltoall(tensor, splits=None, *, process_set=None):
         for o, s in zip(offsets, splits)
     ]
     send = jnp.stack(chunks)  # (P, max_chunk, ...)
-    stacked = _stack_global(send, mesh)
-    # local shard of the (P, P, max_chunk, ...) output: (1, P, max_chunk, ...)
-    out = _fetch(_jitted("alltoall", mesh, ())(stacked))[0]
+    # send.nbytes derives from the negotiated max_chunk: rank-consistent
+    md = (None if send.nbytes < _MULTIDEV_MIN_BYTES
+          else _multidev_mesh_or_none(ps))
+    if md is not None:
+        stacked, inner = _stack_global_multidev_rows(send, p, md)
+        got = _fetch(_jitted("alltoall_multidev", md, ())(stacked))[0]
+        out = got[:, :inner].reshape((p, max_chunk) + x.shape[1:])
+    else:
+        stacked = _stack_global(send, mesh)
+        # local shard of the (P, P, max_chunk, ...) output:
+        # (1, P, max_chunk, ...)
+        out = _fetch(_jitted("alltoall", mesh, ())(stacked))[0]
     parts = [out[r, : int(recv_splits[r])] for r in range(p)]
     result = jnp.concatenate(parts, axis=0)
     return (result, jnp.asarray(recv_splits)) if return_splits else result
@@ -719,6 +851,20 @@ def reducescatter(tensor, *, op=None, process_set=None):
         st, ps,
         f"reducescatter:{tuple(x.shape)}:{x.dtype}:{rop.name}")
     if x.shape[0] % p == 0:
+        # lane path: Sum/Average only (psum_scatter is a sum wire) and
+        # float Average only (int AVERAGE has floor-div semantics the
+        # flat kernel implements)
+        md = (None if (x.nbytes < _MULTIDEV_MIN_BYTES
+                       or rop not in (ReduceOp.SUM, ReduceOp.AVERAGE)
+                       or (rop == ReduceOp.AVERAGE
+                           and jnp.issubdtype(x.dtype, jnp.integer)))
+              else _multidev_mesh_or_none(ps))
+        if md is not None:
+            q = x.shape[0] // p
+            stacked, inner = _stack_global_multidev_rows(x, p, md)
+            out = _fetch(
+                _jitted("reducescatter_multidev", md, (rop,))(stacked))
+            return out[0][:inner].reshape((q,) + x.shape[1:])
         mesh = ps.proc_mesh()
         stacked = _stack_global(x, mesh)
         out = _fetch(_jitted("reducescatter", mesh, (rop,))(stacked))[0]

@@ -190,7 +190,9 @@ def parse_hostfile(path: str) -> str:
             if not line:
                 continue
             parts = line.split()
-            if "slots=" not in line and ":" in parts[0]:
+            if ":" in parts[0]:
+                # compact 'host:N' — one entry per line, no mixing
+                # with slots= (a 'node1:4 slots=8' line is ambiguous)
                 if len(parts) > 1:
                     raise ValueError(
                         f"malformed hostfile line {line!r}: compact "

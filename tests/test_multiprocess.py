@@ -829,9 +829,8 @@ def test_eager_multidevice_lanes_2proc_x_4dev():
     """Multi-lane eager allreduce at the pod shape: each process's
     payload is sharded across its 4 local devices (4 parallel
     reduction lanes) with numerics identical to the process-level
-    contract, across ops/dtypes/odd sizes; HVTPU_EAGER_MULTIDEVICE=0
-    falls back to the single-transport-device path with equal
-    results."""
+    contract, across ops/dtypes/odd sizes (the
+    HVTPU_EAGER_MULTIDEVICE=0 fallback is the sibling optout test)."""
     import numpy as np
 
     def body():
@@ -905,8 +904,90 @@ def test_eager_multidevice_lanes_2proc_x_4dev():
         assert out["sum_after_flip_ok"] is True
         assert out["lanes_after_flip"] is True
 
-    # uniform opt-out (launcher-distributed env): single-transport
-    # fallback with identical numbers
+
+def test_eager_multilane_gather_scatter_alltoall_2proc_x_4dev():
+    """Round-4: the lane path extended beyond allreduce/broadcast —
+    allgather (incl. ragged), reducescatter (Sum + Average), and
+    variable-split alltoall move big payloads over all 4 local lanes
+    with results IDENTICAL to the small-payload (single-transport)
+    path."""
+    import numpy as np
+
+    def body():
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvt
+
+        hvt.init()
+        r = hvt.rank()
+        assert hvt.size() == 2 and jax.local_device_count() == 4
+        out = {}
+
+        # big ragged allgather: rank r contributes (r+1)*9000 rows of 3
+        big = (jnp.arange((r + 1) * 9000 * 3, dtype=jnp.float32)
+               .reshape(-1, 3) + 1e6 * r)
+        g = np.asarray(hvt.allgather(big))
+        expect = np.concatenate([
+            np.arange(9000 * 3, dtype=np.float32).reshape(-1, 3),
+            np.arange(2 * 9000 * 3, dtype=np.float32).reshape(-1, 3)
+            + 1e6,
+        ])
+        out["gather_ok"] = bool(np.array_equal(g, expect))
+
+        # big even reducescatter, Sum and Average, odd inner size
+        x = (jnp.arange(40_000 * 3, dtype=jnp.float32)
+             .reshape(-1, 3) * (r + 1))
+        rs = np.asarray(hvt.reducescatter(x, op=hvt.Sum))
+        full = (np.arange(40_000 * 3, dtype=np.float32)
+                .reshape(-1, 3) * 3.0)
+        out["rs_sum_ok"] = bool(np.allclose(
+            rs, full[r * 20_000:(r + 1) * 20_000]))
+        rsa = np.asarray(hvt.reducescatter(x, op=hvt.Average))
+        out["rs_avg_ok"] = bool(np.allclose(
+            rsa, full[r * 20_000:(r + 1) * 20_000] / 2.0))
+
+        # big variable-split alltoall
+        splits = [30_000, 10_000] if r == 0 else [5_000, 25_000]
+        t = (jnp.arange(sum(splits) * 2, dtype=jnp.float32)
+             .reshape(-1, 2) + 1e6 * r)
+        recv, rsplits = hvt.alltoall(t, splits=splits)
+        recv = np.asarray(recv)
+        # build expectations from both ranks' send buffers
+        t0 = (np.arange(40_000 * 2, dtype=np.float32).reshape(-1, 2))
+        t1 = (np.arange(30_000 * 2, dtype=np.float32).reshape(-1, 2)
+              + 1e6)
+        if r == 0:
+            want = np.concatenate([t0[:30_000], t1[:5_000]])
+            want_splits = [30_000, 5_000]
+        else:
+            want = np.concatenate([t0[30_000:40_000], t1[5_000:30_000]])
+            want_splits = [10_000, 25_000]
+        out["a2a_ok"] = bool(np.array_equal(recv, want))
+        out["a2a_splits"] = np.asarray(rsplits).tolist() == want_splits
+
+        # identical numerics when the payload is SMALL (flat path):
+        # same ops, sizes below the 64KB lane threshold
+        g2 = np.asarray(hvt.allgather(
+            jnp.full((r + 1, 2), float(r))))
+        out["small_gather_ok"] = bool(np.array_equal(
+            g2, np.asarray([[0, 0], [1, 1], [1, 1]], np.float32)))
+        return (r, out)
+
+    results = _run(body, np=2, cpu_devices=4)
+    for _, out in sorted(results):
+        assert out["gather_ok"] is True
+        assert out["rs_sum_ok"] is True
+        assert out["rs_avg_ok"] is True
+        assert out["a2a_ok"] is True
+        assert out["a2a_splits"] is True
+        assert out["small_gather_ok"] is True
+
+
+def test_eager_multidevice_optout_2proc_x_4dev():
+    """HVTPU_EAGER_MULTIDEVICE=0 (launcher-distributed env):
+    single-transport fallback with identical numbers."""
     def body_single():
         import jax.numpy as jnp
         import numpy as np
