@@ -47,6 +47,8 @@ class State:
         self._reset_callbacks: List[Callable[[], None]] = []
         self._host_messages = _HostUpdateFlag.instance()
         self._synced = False
+        self._commit_count = 0
+        self._durable_every = 1
 
     def register_reset_callbacks(self, callbacks):
         """Parity: State.register_reset_callbacks — called after a world
@@ -59,10 +61,33 @@ class State:
         for cb in self._reset_callbacks:
             cb()
 
+    def set_commit_policy(self, every_n_commits: int = 1):
+        """Throttle the DURABLE half of ``commit()`` to every Nth call.
+
+        The in-memory snapshot (rollback target for
+        ``HorovodInternalError`` recovery) still happens on every
+        commit; only the disk write — which at pod scale writes every
+        rank's shards (``ShardedJaxState``) — is skipped between
+        multiples.  Trade-off: a crash-and-RELAUNCH resumes from the
+        last *durable* commit, up to N-1 commits back.  The decision is
+        a deterministic function of the commit count, hence identical
+        on every rank — a wall-clock policy would desync the collective
+        sharded write.  Call ``save()`` directly for an unconditional
+        durable snapshot (e.g. right before a planned exit).
+        """
+        if every_n_commits < 1:
+            raise ValueError("every_n_commits must be >= 1")
+        self._durable_every = every_n_commits
+
     def commit(self):
-        """Snapshot state (memory + durable dir) then check for host
-        updates (parity: State.commit = save + check_host_updates)."""
-        self.save()
+        """Snapshot state (memory, and the durable dir per the commit
+        policy) then check for host updates (parity: State.commit =
+        save + check_host_updates)."""
+        self._commit_count += 1
+        if self._commit_count % self._durable_every == 0:
+            self.save()
+        else:
+            self.save_to_memory()
         self.check_host_updates()
 
     def check_host_updates(self):
@@ -73,6 +98,11 @@ class State:
             raise HostsUpdatedInterrupt(skip_sync=False)
 
     # -- overridable payload hooks --
+    def save_to_memory(self):
+        """In-memory-only snapshot (rollback target).  Subclasses
+        without a cheaper memory path inherit the full save."""
+        self.save()
+
     def save(self):
         raise NotImplementedError
 

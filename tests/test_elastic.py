@@ -71,6 +71,45 @@ class TestObjectState:
         assert fresh.epoch == 1
         assert float(fresh.params["w"][2]) == 2.0
 
+    def test_commit_policy_throttles_durable_only(self, hvt, tmp_path,
+                                                  monkeypatch):
+        """set_commit_policy(every_n_commits=3): the durable file
+        advances only on multiples, the in-memory rollback target on
+        EVERY commit."""
+        import pickle
+
+        monkeypatch.setenv("HVTPU_ELASTIC_STATE_DIR", str(tmp_path))
+        state = elastic.ObjectState(epoch=0)
+        state.set_commit_policy(every_n_commits=3)
+        path = tmp_path / "state_commit.pkl"
+
+        state.epoch = 1
+        state.commit()   # count 1: memory only
+        assert not path.exists()
+        # rollback still lands on the newest (memory) commit
+        state.epoch = 99
+        state.restore()
+        assert state.epoch == 1
+        state.epoch = 2
+        state.commit()   # count 2: memory only
+        assert not path.exists()
+        state.epoch = 3
+        state.commit()   # count 3: durable
+        assert pickle.loads(path.read_bytes())["epoch"] == 3
+        state.epoch = 4
+        state.commit()   # count 4: memory only — disk stays at 3
+        assert pickle.loads(path.read_bytes())["epoch"] == 3
+        # explicit save() is the unconditional escape hatch
+        state.save()
+        assert pickle.loads(path.read_bytes())["epoch"] == 4
+
+    def test_commit_policy_validates(self, hvt):
+        state = elastic.ObjectState(epoch=0)
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            state.set_commit_policy(every_n_commits=0)
+
     def test_host_update_flag_raises_at_commit(self, hvt):
         from horovod_tpu.elastic.state import _HostUpdateFlag
 
