@@ -75,16 +75,35 @@ class State:
         sharded write.  Call ``save()`` directly for an unconditional
         durable snapshot (e.g. right before a planned exit).
         """
-        if every_n_commits < 1:
-            raise ValueError("every_n_commits must be >= 1")
+        if not isinstance(every_n_commits, int) \
+                or isinstance(every_n_commits, bool) \
+                or every_n_commits < 1:
+            raise ValueError(
+                f"every_n_commits must be an int >= 1, got "
+                f"{every_n_commits!r}")
         self._durable_every = every_n_commits
+
+    # True when save() is a COLLECTIVE (every rank participates, e.g.
+    # the sharded checkpointer) — such saves may only run at
+    # rank-deterministic points, so the pending-resize promotion below
+    # must not apply.
+    _DURABLE_IS_COLLECTIVE = False
 
     def commit(self):
         """Snapshot state (memory, and the durable dir per the commit
         policy) then check for host updates (parity: State.commit =
         save + check_host_updates)."""
         self._commit_count += 1
-        if self._commit_count % self._durable_every == 0:
+        durable = self._commit_count % self._durable_every == 0
+        if not durable and self._host_messages.flag \
+                and not self._DURABLE_IS_COLLECTIVE:
+            # a membership change is about to interrupt this commit —
+            # promote to a durable save so the PLANNED resize path
+            # loses nothing (rank-local writes only: the signal is not
+            # rank-synchronous, so a collective save here could pair
+            # with a peer that saw the flag one commit later)
+            durable = True
+        if durable:
             self.save()
         else:
             self.save_to_memory()
@@ -260,6 +279,10 @@ class ShardedJaxState(JaxState):
     """
 
     _KEEP_COMMITS = 2
+    # every process writes its shards: the durable save is collective,
+    # so the commit policy may not promote it at a pending resize (the
+    # SIGUSR1 flag is not rank-synchronous)
+    _DURABLE_IS_COLLECTIVE = True
 
     def _sharded_dir(self) -> Optional[str]:
         d = _state_dir()

@@ -105,10 +105,31 @@ class TestObjectState:
 
     def test_commit_policy_validates(self, hvt):
         state = elastic.ObjectState(epoch=0)
-        import pytest as _pytest
+        for bad in (0, 2.5, True):
+            with pytest.raises(ValueError):
+                state.set_commit_policy(every_n_commits=bad)
 
-        with _pytest.raises(ValueError):
-            state.set_commit_policy(every_n_commits=0)
+    def test_pending_resize_promotes_durable_commit(self, hvt, tmp_path,
+                                                    monkeypatch):
+        """A PLANNED resize must not lose throttled commits: with the
+        host-update flag pending, the next commit() writes durably
+        before raising HostsUpdatedInterrupt (rank-local states)."""
+        import pickle
+
+        from horovod_tpu.elastic.state import _HostUpdateFlag
+
+        monkeypatch.setenv("HVTPU_ELASTIC_STATE_DIR", str(tmp_path))
+        state = elastic.ObjectState(epoch=0)
+        state.set_commit_policy(every_n_commits=10)
+        path = tmp_path / "state_commit.pkl"
+        state.epoch = 1
+        state.commit()
+        assert not path.exists()  # throttled
+        state.epoch = 2
+        _HostUpdateFlag.instance().set()
+        with pytest.raises(elastic.HostsUpdatedInterrupt):
+            state.commit()
+        assert pickle.loads(path.read_bytes())["epoch"] == 2
 
     def test_host_update_flag_raises_at_commit(self, hvt):
         from horovod_tpu.elastic.state import _HostUpdateFlag
