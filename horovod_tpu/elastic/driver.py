@@ -71,11 +71,20 @@ class ElasticDriver:
         self.interval = discovery_interval
         self.elastic_timeout = elastic_timeout
         self.args = args
-        self.state_dir = state_dir or tempfile.mkdtemp(
+        # durable-commit location: explicit arg > caller's env (a user
+        # pointing commits at a persistent/shared filesystem) > fresh
+        # temp dir owned — and cleaned up on success — by this driver
+        env_dir = os.environ.get("HVTPU_ELASTIC_STATE_DIR")
+        self.state_dir = state_dir or env_dir or tempfile.mkdtemp(
             prefix="hvtpu_elastic_"
         )
+        self._owns_state_dir = state_dir is None and env_dir is None
         self.verbose = verbose
         self._crash_counts: Dict[str, int] = {}
+        # world size of the last-launched incarnation; after a clean
+        # run() this is the FINAL world (result collection filters
+        # stale rank files from larger earlier incarnations with it)
+        self.final_world_size: Optional[int] = None
 
     def _log(self, msg: str):
         if self.verbose:
@@ -169,9 +178,14 @@ class ElasticDriver:
             self._log(
                 f"launching {np_now} workers on {spec} (port {port})"
             )
+            self.final_world_size = np_now
             workers = self._spawn(slots, port)
             outcome = self._supervise(workers, slots)
             if outcome == "done":
+                if self._owns_state_dir:
+                    import shutil
+
+                    shutil.rmtree(self.state_dir, ignore_errors=True)
                 return 0
             if outcome == "failed":
                 return 1
@@ -246,9 +260,12 @@ class ElasticDriver:
         return "restart"
 
 
-def run_elastic(args: argparse.Namespace) -> int:
-    """Entry from ``hvtpurun --host-discovery-script ...`` (parity:
-    launch.py _run_elastic)."""
+def run_elastic_driver(args: argparse.Namespace
+                       ) -> "tuple[int, ElasticDriver]":
+    """Build + run the elastic driver, returning (exit_code, driver) —
+    callers needing post-run facts (final world size for result
+    collection) use this; the CLI wrapper below keeps the int
+    contract."""
     discovery = HostDiscoveryScript(args.host_discovery_script)
     driver = ElasticDriver(
         command=args.command,
@@ -263,4 +280,10 @@ def run_elastic(args: argparse.Namespace) -> int:
         args=args,
         verbose=args.verbose,
     )
-    return driver.run()
+    return driver.run(), driver
+
+
+def run_elastic(args: argparse.Namespace) -> int:
+    """Entry from ``hvtpurun --host-discovery-script ...`` (parity:
+    launch.py _run_elastic)."""
+    return run_elastic_driver(args)[0]

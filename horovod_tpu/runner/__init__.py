@@ -181,3 +181,111 @@ def run(
         if code != 0:
             raise RunError(-1, f"launcher observed exit code {code}")
         return [payloads[r][1] for r in range(np)]
+
+
+def run_elastic(
+    fn: Callable,
+    args: tuple = (),
+    kwargs: Optional[Dict[str, Any]] = None,
+    num_proc: int = 2,
+    min_np: Optional[int] = None,
+    max_np: Optional[int] = None,
+    cpu_devices: Optional[int] = 1,
+    host_discovery_script: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+    start_timeout: Optional[float] = None,
+    verbose: bool = False,
+) -> List[Any]:
+    """Run ``fn`` under the ELASTIC driver and return per-rank results
+    of the final world, ordered by rank.
+
+    Parity: ``horovod.spark.run_elastic`` (horovod/spark/__init__.py)
+    / the elastic half of ``horovodrun`` — ``fn`` is expected to follow
+    the elastic contract (build a ``hvd.elastic.State``, decorate the
+    loop with ``@hvd.elastic.run``); membership changes restart it from
+    the last commit.  Without ``host_discovery_script`` a static
+    ``localhost:num_proc`` discovery is generated (the reference's
+    local-mode CI shape); with one, the world resizes live as its
+    output changes.
+    """
+    from . import launch as launch_mod
+    from . import secret
+    from ..elastic.driver import run_elastic_driver
+
+    job_key = secret.make_secret_key()
+    with tempfile.TemporaryDirectory(prefix="hvtpurun_el_") as tmp:
+        fn_path = os.path.join(tmp, "fn.pkl")
+        out_dir = os.path.join(tmp, "results")
+        os.makedirs(out_dir)
+        _dump_fn(fn, args, kwargs, fn_path, job_key)
+        if host_discovery_script is None:
+            host_discovery_script = os.path.join(tmp, "discover.sh")
+            with open(host_discovery_script, "w") as f:
+                f.write(f"#!/bin/sh\necho localhost:{num_proc}\n")
+            os.chmod(host_discovery_script, 0o755)
+        argv = ["--host-discovery-script", host_discovery_script,
+                "-np", str(num_proc)]
+        if min_np is not None:
+            argv += ["--min-np", str(min_np)]
+        if max_np is not None:
+            argv += ["--max-np", str(max_np)]
+        if cpu_devices is not None:
+            argv += ["--cpu-devices", str(cpu_devices)]
+        if start_timeout is not None:
+            argv += ["--start-timeout", str(start_timeout)]
+        if verbose:
+            argv += ["--verbose"]
+        argv += ["--", sys.executable, "-m",
+                 "horovod_tpu.runner.run_task", fn_path, out_dir]
+        ns = launch_mod.parse_args(argv)
+        key_path = os.path.join(tmp, "job.key")
+        secret.write_key_file(job_key, key_path)
+        # the elastic driver builds worker env from the launcher's
+        # process env; scope the additions to this call
+        added = {secret.ENV_KEY_FILE: key_path, **(env or {})}
+        # the key must travel by file, never env value (the ssh path
+        # serializes env into argv) — and the caller's own value must
+        # come back afterwards, so it joins the save/restore set
+        saved = {k: os.environ.get(k)
+                 for k in (*added, secret.ENV_KEY)}
+        os.environ.update(added)
+        os.environ.pop(secret.ENV_KEY, None)
+        try:
+            code, driver = run_elastic_driver(ns)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        if code != 0:
+            raise RunError(-1, f"elastic driver exit code {code}")
+        # collect the FINAL world's results only: a shrink leaves
+        # higher-rank files from earlier incarnations behind, and a
+        # recovered crash leaves an ok=False file — both stale
+        final_np = driver.final_world_size or 0
+        results: Dict[int, Any] = {}
+        for name in sorted(os.listdir(out_dir)):
+            if not (name.startswith("rank_") and name.endswith(".pkl")):
+                continue
+            r = int(name[len("rank_"):-len(".pkl")])
+            if r >= final_np:
+                continue
+            try:
+                with open(os.path.join(out_dir, name), "rb") as f:
+                    blob = secret.verify(job_key, f.read())
+            except secret.SignatureError as e:
+                raise RunError(
+                    r, f"result file failed signature verification "
+                       f"({e}); the blob was not unpickled.")
+            ok, payload = pickle.loads(blob)
+            if not ok:
+                raise RunError(r, payload)
+            results[r] = payload
+        missing = [r for r in range(final_np) if r not in results]
+        if missing:
+            raise RunError(
+                missing[0],
+                f"no result file for rank(s) {missing} of the final "
+                f"{final_np}-rank world")
+        return [results[r] for r in sorted(results)]

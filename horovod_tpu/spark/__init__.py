@@ -65,10 +65,28 @@ def run(
     )
 
 
-def run_elastic(*args, **kwargs):
-    raise NotImplementedError(
-        "horovod_tpu.spark.run_elastic: elastic jobs are driven by "
-        "hvtpurun --host-discovery-script (see horovod_tpu.elastic); "
-        "a Spark-executor elastic backend is out of scope "
-        "(SURVEY.md §7.3)."
+def run_elastic(
+    fn: Callable,
+    args: tuple = (),
+    kwargs: Optional[Dict[str, Any]] = None,
+    num_proc: Optional[int] = None,
+    min_np: Optional[int] = None,
+    max_np: Optional[int] = None,
+    env: Optional[Dict[str, str]] = None,
+    start_timeout: Optional[float] = None,
+    verbose: int = 0,
+    cpu_devices: Optional[int] = 1,
+) -> List[Any]:
+    """Run ``fn`` under the elastic driver (parity:
+    ``horovod.spark.run_elastic``): ``fn`` follows the elastic contract
+    (``hvd.elastic.State`` + ``@hvd.elastic.run``), and membership
+    changes restart it from the last commit.  Local-mode execution —
+    Spark-executor *placement* stays out of scope (SURVEY.md §7.3),
+    exactly as with :func:`run`."""
+    from .. import runner
+
+    return runner.run_elastic(
+        fn, args=args, kwargs=kwargs, num_proc=num_proc or 2,
+        min_np=min_np, max_np=max_np, cpu_devices=cpu_devices,
+        env=env, start_timeout=start_timeout, verbose=bool(verbose),
     )
