@@ -26,15 +26,21 @@ class TestSparkLocalMode:
         results = spark.run(_make_rank_size(), num_proc=2)
         assert results == [(0, 2), (1, 2)]
 
-    def test_estimators_refuse_clearly(self):
+    def test_estimator_surface_is_real(self):
+        """Round-4: the Estimator stubs became the real surface
+        (tests/test_spark_estimator.py carries the behavior; this
+        pins the reference import paths + param validation)."""
         import horovod_tpu.spark as spark
+        from horovod_tpu.spark.keras import KerasEstimator as KE
+        from horovod_tpu.spark.torch import TorchEstimator as TE
 
-        with pytest.raises(NotImplementedError, match="out of scope"):
-            spark.TorchEstimator()
-        with pytest.raises(NotImplementedError, match="out of scope"):
-            spark.KerasEstimator()
-        with pytest.raises(NotImplementedError, match="hvtpurun"):
-            spark.run_elastic(lambda: None)
+        assert spark.TorchEstimator is TE  # horovod.spark.torch parity
+        assert spark.KerasEstimator is KE  # horovod.spark.keras parity
+        est = spark.TorchEstimator(epochs=2)
+        assert est.getEpochs() == 2
+        with pytest.raises(ValueError, match="model param"):
+            est.fit({"f": [1.0]})
+        assert callable(spark.run_elastic)
 
 
 class TestRayLocalMode:
