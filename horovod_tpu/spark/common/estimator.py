@@ -22,6 +22,25 @@ from .backend import Backend, LocalBackend
 from .params import EstimatorParams, Params
 
 
+def resolve_compression(hvd_frontend, value):
+    """Estimator ``compression`` param → frontend compressor class.
+    Accepts the reference's style (a compressor object/class, e.g.
+    ``hvd.Compression.fp16``) or a name string; a typo gets a clear
+    error naming the options.  Shared by the torch and keras
+    trainers."""
+    if value is None:
+        return hvd_frontend.Compression.none
+    if isinstance(value, str):
+        comp = getattr(hvd_frontend.Compression, value, None)
+        if comp is None or value.startswith("_"):
+            options = [a for a in dir(hvd_frontend.Compression)
+                       if not a.startswith("_") and a != "from_name"]
+            raise ValueError(
+                f"unknown compression {value!r}; options: {options}")
+        return comp
+    return value
+
+
 class HorovodEstimator(EstimatorParams):
     """fit(df) → trained HorovodModel, over Store + Backend."""
 

@@ -22,7 +22,11 @@ import os
 from typing import Any, Dict, List
 
 from ..common.data import TRAIN_NPZ, VAL_NPZ, load_shard
-from ..common.estimator import HorovodEstimator, HorovodModel
+from ..common.estimator import (
+    HorovodEstimator,
+    HorovodModel,
+    resolve_compression,
+)
 
 CHECKPOINT_FILE = "checkpoint.npz"
 MODEL_JSON_FILE = "model.json"
@@ -53,7 +57,9 @@ def _keras_trainer(spec: Dict[str, Any]):
     loss, metrics, user_callbacks, transformation_fn = \
         cloudpickle.loads(spec["train_blob"])
     model.compile(
-        optimizer=hvd.DistributedOptimizer(optimizer),
+        optimizer=hvd.DistributedOptimizer(
+            optimizer,
+            compression=resolve_compression(hvd, p.get("compression"))),
         loss=loss, metrics=metrics or None,
         weighted_metrics=None,
     )
@@ -67,6 +73,13 @@ def _keras_trainer(spec: Dict[str, Any]):
             f"rank {hvd.rank()}'s training shard is empty "
             f"({spec['n_train']} rows over {hvd.size()} ranks); "
             "reduce num_proc or provide more data")
+    # rank-CONSISTENT batch count: strided shards differ by up to one
+    # row, which can flip ceil(rows/batch) on one rank — and every
+    # training batch fires collective gradient allreduces, so unequal
+    # counts deadlock the epoch. Trim to the global minimum (drops at
+    # most one row per rank per epoch).
+    min_rows = max(1, spec["n_train"] // hvd.size())
+    shard = {c: v[:min_rows] for c, v in shard.items()}
 
     feature_cols = p["feature_cols"]
     label_cols = p["label_cols"]

@@ -23,20 +23,31 @@ import os
 from typing import Any, Dict, List
 
 from ..common.data import TRAIN_NPZ, VAL_NPZ, load_shard
-from ..common.estimator import HorovodEstimator, HorovodModel
+from ..common.estimator import (
+    HorovodEstimator,
+    HorovodModel,
+    resolve_compression,
+)
 
 CHECKPOINT_FILE = "checkpoint.pt"
 
 
-def _batches(n: int, batch_size: int, rng):
+def _epoch_batches(n: int, batch_size: int, n_batches: int, rng):
+    """Exactly ``n_batches`` index batches from this rank's ``n`` rows,
+    wrapping the (shuffled) permutation when n < n_batches*batch_size.
+
+    The batch COUNT must be identical on every rank — each batch (or
+    each ``backward_passes_per_step`` group) issues collective gradient
+    allreduces, and strided shards differ by up to one row, which can
+    otherwise flip ceil(n/batch) on one rank and deadlock the epoch.
+    The count is therefore derived from the GLOBAL row count upstream,
+    and wrapping keeps a short shard contributing full batches."""
     import numpy as np
 
     perm = rng.permutation(n) if rng is not None else np.arange(n)
-    # tail included: a shard smaller than batch_size must still train
-    # (drop_last=False semantics) — otherwise small frames over many
-    # ranks would silently run zero steps per epoch
-    for lo in range(0, n, batch_size):
-        yield perm[lo:lo + batch_size]
+    idxs = np.resize(perm, n_batches * batch_size)
+    for s in range(n_batches):
+        yield idxs[s * batch_size:(s + 1) * batch_size]
 
 
 def _torch_trainer(spec: Dict[str, Any]):
@@ -107,8 +118,13 @@ def _torch_trainer(spec: Dict[str, Any]):
     # are averaged in the wrapped optimizer.
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
     hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    # bps derived ONCE: the optimizer's aggregation period and the
+    # loop's step()/zero_grad() cadence must never diverge
+    bps = p.get("backward_passes_per_step") or 1
     optimizer = hvd.DistributedOptimizer(
-        optimizer, named_parameters=model.named_parameters())
+        optimizer, named_parameters=model.named_parameters(),
+        compression=resolve_compression(hvd, p.get("compression")),
+        backward_passes_per_step=bps)
 
     def forward_loss(feat_batch, label_batch):
         outputs = model(*feat_batch)
@@ -125,7 +141,29 @@ def _torch_trainer(spec: Dict[str, Any]):
             f"rank {hvd.rank()}'s training shard is empty "
             f"({spec['n_train']} rows over {hvd.size()} ranks); "
             "reduce num_proc or provide more data")
-    steps_cap = p.get("train_steps_per_epoch")
+    # rank-CONSISTENT batch count from the global row count (see
+    # _epoch_batches): every rank has at least n_train//size rows
+    min_rows = max(1, spec["n_train"] // hvd.size())
+    n_batches = -(-min_rows // batch_size)  # ceil
+    if p.get("train_steps_per_epoch") is not None:
+        n_batches = min(n_batches, p["train_steps_per_epoch"])
+    # whole aggregation groups only: step() fires after exactly bps
+    # backward passes; a cap below one group is a config error, not a
+    # silent overrun of the user's explicit limit
+    if n_batches < bps:
+        raise ValueError(
+            f"train_steps_per_epoch/row budget gives {n_batches} "
+            f"batch(es) per epoch, fewer than "
+            f"backward_passes_per_step={bps}: no optimizer step could "
+            "ever fire")
+    if n_batches % bps and hvd.rank() == 0:
+        import logging
+
+        logging.getLogger("horovod_tpu").warning(
+            "batches per epoch rounded %d -> %d to form whole "
+            "backward_passes_per_step=%d groups",
+            n_batches, n_batches // bps * bps, bps)
+    n_batches = n_batches // bps * bps
     history: Dict[str, List[float]] = {"loss": []}
     ckpt_dir = store.get_checkpoint_path(run_id)
 
@@ -135,15 +173,16 @@ def _torch_trainer(spec: Dict[str, Any]):
             (0 if seed is None else seed) * 1000 + epoch + hvd.rank())
             if p.get("shuffle", True) else None)
         epoch_loss, steps = 0.0, 0
-        for idx in _batches(n, batch_size, rng):
-            if steps_cap is not None and steps >= steps_cap:
-                break
+        optimizer.zero_grad()
+        for s, idx in enumerate(
+                _epoch_batches(n, batch_size, n_batches, rng)):
             fb = [f[idx] for f in features]
             lb = [y[idx] for y in labels]
-            optimizer.zero_grad()
             _, loss = forward_loss(fb, lb)
             loss.backward()
-            optimizer.step()
+            if (s + 1) % bps == 0:
+                optimizer.step()
+                optimizer.zero_grad()
             epoch_loss += float(loss.detach())
             steps += 1
         # epoch metrics are averaged over ranks, like the reference's
@@ -200,6 +239,7 @@ class TorchEstimator(HorovodEstimator):
     _param_defs = {
         "optimizer": None,
         "input_shapes": None,   # accepted for source compat
+        "backward_passes_per_step": 1,
     }
 
     def _check_params(self):

@@ -188,6 +188,94 @@ class TestTorchEstimator:
         assert hist["loss"][-1] < hist["loss"][0]
         assert all(v > 0 for v in hist["loss"])  # steps actually ran
 
+    def test_compression_param_object_and_typo(self):
+        """compression accepts the reference's object style and names a
+        clear error for typos (shared resolve_compression)."""
+        import horovod_tpu.torch as hvd_torch
+
+        from horovod_tpu.spark.common.estimator import \
+            resolve_compression
+
+        assert resolve_compression(hvd_torch, None) \
+            is hvd_torch.Compression.none
+        assert resolve_compression(hvd_torch, "fp16") \
+            is hvd_torch.Compression.fp16
+        assert resolve_compression(
+            hvd_torch, hvd_torch.Compression.fp16) \
+            is hvd_torch.Compression.fp16
+        with pytest.raises(ValueError, match="options"):
+            resolve_compression(hvd_torch, "fp32")
+
+    def test_keras_uneven_shards_train_in_lockstep(self, tmp_path):
+        """65 rows over 2 ranks: without the min-rows trim, rank 0
+        runs one more gradient-allreduce batch than rank 1 and the
+        epoch deadlocks."""
+        import keras
+
+        from horovod_tpu.spark import KerasEstimator
+
+        df, x, y = _classification_frame(n=65)
+        model = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        est = KerasEstimator(
+            model=model, optimizer=keras.optimizers.SGD(0.2),
+            loss="sparse_categorical_crossentropy",
+            feature_cols=["features"], label_cols=["label"],
+            batch_size=32, epochs=2, num_proc=2, verbose=0,
+            random_seed=7, store=LocalStore(str(tmp_path)))
+        km = est.fit(df)
+        assert len(km.getHistory()["loss"]) == 2
+
+    def test_steps_cap_below_bps_raises(self, tmp_path):
+        import torch
+        import torch.nn as nn
+        import torch.nn.functional as F
+
+        from horovod_tpu.runner import RunError
+        from horovod_tpu.spark import TorchEstimator
+
+        df, x, y = _regression_frame(n=128)
+        model = nn.Sequential(nn.Linear(4, 1))
+        est = TorchEstimator(
+            model=model,
+            optimizer=torch.optim.SGD(model.parameters(), lr=0.1),
+            loss=F.mse_loss,
+            feature_cols=["features"], label_cols=["label"],
+            batch_size=16, epochs=1, num_proc=2, verbose=0,
+            train_steps_per_epoch=1, backward_passes_per_step=2,
+            store=LocalStore(str(tmp_path)))
+        with pytest.raises(RunError, match="no optimizer step"):
+            est.fit(df)
+
+    def test_uneven_shards_bps_and_compression(self, tmp_path):
+        """127 rows over 2 ranks (64/63-row shards would flip the
+        per-rank batch count and deadlock without the rank-consistent
+        step derivation) + backward_passes_per_step=2 local
+        aggregation + fp16 wire compression, all through the estimator
+        params (reference TorchEstimator knobs)."""
+        import torch
+        import torch.nn as nn
+        import torch.nn.functional as F
+
+        from horovod_tpu.spark import TorchEstimator
+
+        df, x, y = _regression_frame(n=127)
+        model = nn.Sequential(nn.Linear(4, 1))
+        est = TorchEstimator(
+            model=model,
+            optimizer=torch.optim.SGD(model.parameters(), lr=0.1),
+            loss=F.mse_loss,
+            feature_cols=["features"], label_cols=["label"],
+            batch_size=16, epochs=4, num_proc=2, verbose=0,
+            backward_passes_per_step=2, compression="fp16",
+            random_seed=7, store=LocalStore(str(tmp_path)))
+        tm = est.fit(df)
+        hist = tm.getHistory()
+        assert len(hist["loss"]) == 4
+        assert hist["loss"][-1] < hist["loss"][0]
+
     def test_missing_params_raise(self, tmp_path):
         import torch.nn as nn
 
