@@ -124,14 +124,51 @@ def _engine_compression(compression):
 
 # ---------------------------------------------------------------------------
 # synchronous ops
+#
+# Gradient registration (parity: the HorovodAllreduce/HorovodAllgather/
+# HorovodBroadcast/HorovodAlltoall torch.autograd.Function wrappers in
+# horovod/torch/mpi_ops.py): when the input requires grad, the op
+# routes through an autograd.Function whose backward implements the
+# reference adjoint — grad of allreduce is an allreduce, allgather's
+# grad sums and slices this rank's rows, broadcast's reduces to the
+# root, alltoall's routes chunks back, reducescatter's allgathers.
 # ---------------------------------------------------------------------------
 
-def allreduce(tensor: torch.Tensor, average=None, name=None,
-              compression=Compression.none, op=None,
-              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-              process_set=None) -> torch.Tensor:
-    """Averaged (by default) allreduce returning a NEW tensor (parity:
-    hvd.allreduce in horovod/torch/mpi_ops.py)."""
+
+def _check_grad_op(op, average):
+    from ..comm.reduce_ops import ReduceOp, normalize_op
+
+    rop = normalize_op(op, average)
+    if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM):
+        raise NotImplementedError(
+            f"gradient of a {rop.name} allreduce is not defined "
+            "(reference registers gradients for sum/average/adasum)")
+
+
+class _AllreduceFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, average, name, compression, op,
+                prescale_factor, postscale_factor, process_set):
+        ctx.meta = (average, compression, op, prescale_factor,
+                    postscale_factor, process_set)
+        return _allreduce_impl(tensor, average, name, compression, op,
+                               prescale_factor, postscale_factor,
+                               process_set)
+
+    @staticmethod
+    def backward(ctx, grad):
+        (average, compression, op, prescale_factor, postscale_factor,
+         process_set) = ctx.meta
+        _check_grad_op(op, average)
+        g = allreduce(grad, average=average, compression=compression,
+                      op=op, prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor,
+                      process_set=process_set)
+        return (g,) + (None,) * 7
+
+
+def _allreduce_impl(tensor, average, name, compression, op,
+                    prescale_factor, postscale_factor, process_set):
     out = _hvt.allreduce(
         _to_jax(tensor), op=op, average=average,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
@@ -139,6 +176,22 @@ def allreduce(tensor: torch.Tensor, average=None, name=None,
         process_set=process_set, name=name,
     )
     return _from_jax(out, like=tensor).reshape(tensor.shape)
+
+
+def allreduce(tensor: torch.Tensor, average=None, name=None,
+              compression=Compression.none, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set=None) -> torch.Tensor:
+    """Averaged (by default) allreduce returning a NEW tensor (parity:
+    hvd.allreduce in horovod/torch/mpi_ops.py); differentiable — the
+    backward pass allreduces the gradient with the same attributes."""
+    if torch.is_grad_enabled() and tensor.requires_grad:
+        return _AllreduceFunction.apply(
+            tensor, average, name, compression, op, prescale_factor,
+            postscale_factor, process_set)
+    return _allreduce_impl(tensor, average, name, compression, op,
+                           prescale_factor, postscale_factor,
+                           process_set)
 
 
 def allreduce_(tensor: torch.Tensor, average=None, name=None,
@@ -218,19 +271,71 @@ def grouped_reducescatter_async(tensors: List[torch.Tensor], op=None,
     return handles
 
 
+class _AllgatherFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name, process_set):
+        ctx.meta = (tensor.shape[0], process_set)
+        return _allgather_impl(tensor, name, process_set)
+
+    @staticmethod
+    def backward(ctx, grad):
+        from ..core.process_set import participant_rank
+
+        my_rows, process_set = ctx.meta
+        summed = allreduce(grad, op=_hvt.Sum, process_set=process_set)
+        sizes = allgather(torch.tensor([my_rows]),
+                          process_set=process_set)
+        r = participant_rank(process_set)
+        offset = int(sizes[:r].sum())
+        return summed[offset:offset + my_rows], None, None
+
+
+def _allgather_impl(tensor, name, process_set):
+    out = _hvt.allgather(_to_jax(tensor), process_set=process_set,
+                         name=name)
+    return _from_jax(out, like=tensor)
+
+
 def allgather(tensor: torch.Tensor, name=None, process_set=None
               ) -> torch.Tensor:
     """Concatenate along dim 0 across ranks (ragged dim-0 supported;
-    parity: hvd.allgather / allgather size negotiation)."""
-    out = _hvt.allgather(_to_jax(tensor), process_set=process_set, name=name)
-    return _from_jax(out, like=tensor)
+    parity: hvd.allgather / allgather size negotiation);
+    differentiable — the backward sums upstream grads across ranks and
+    slices out this rank's rows."""
+    if torch.is_grad_enabled() and tensor.requires_grad:
+        return _AllgatherFunction.apply(tensor, name, process_set)
+    return _allgather_impl(tensor, name, process_set)
+
+
+class _BroadcastFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name, process_set):
+        ctx.meta = (root_rank, process_set)
+        return _broadcast_impl(tensor, root_rank, name, process_set)
+
+    @staticmethod
+    def backward(ctx, grad):
+        root_rank, process_set = ctx.meta
+        summed = allreduce(grad, op=_hvt.Sum, process_set=process_set)
+        if _hvt.rank() != root_rank:
+            summed = torch.zeros_like(summed)
+        return summed, None, None, None
+
+
+def _broadcast_impl(tensor, root_rank, name, process_set):
+    out = _hvt.broadcast(_to_jax(tensor), root_rank=root_rank,
+                         process_set=process_set, name=name)
+    return _from_jax(out, like=tensor).reshape(tensor.shape)
 
 
 def broadcast(tensor: torch.Tensor, root_rank: int = 0, name=None,
               process_set=None) -> torch.Tensor:
-    out = _hvt.broadcast(_to_jax(tensor), root_rank=root_rank,
-                         process_set=process_set, name=name)
-    return _from_jax(out, like=tensor).reshape(tensor.shape)
+    """Differentiable broadcast — gradients reduce to the root (zeros
+    elsewhere), the reference's HorovodBroadcast adjoint."""
+    if torch.is_grad_enabled() and tensor.requires_grad:
+        return _BroadcastFunction.apply(tensor, root_rank, name,
+                                        process_set)
+    return _broadcast_impl(tensor, root_rank, name, process_set)
 
 
 def broadcast_(tensor: torch.Tensor, root_rank: int = 0, name=None,
@@ -239,14 +344,40 @@ def broadcast_(tensor: torch.Tensor, root_rank: int = 0, name=None,
     return tensor
 
 
-def alltoall(tensor: torch.Tensor, splits: Optional[torch.Tensor] = None,
-             name=None, process_set=None):
-    """Scatter dim-0 slices to every rank, gather received (parity:
-    hvd.alltoall; returns (output, received_splits) like the reference
-    when splits is given)."""
-    splits_np = None if splits is None else _to_np(splits)
-    out = _hvt.alltoall(_to_jax(tensor), splits_np, process_set=process_set,
-                        name=name)
+class _AlltoallFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, splits_np, name, process_set):
+        return_single = splits_np is None
+        if return_single:
+            # the adjoint must replay with the RECEIVED per-sender
+            # counts — ranks may contribute different row counts even
+            # with equal splits — so route through the explicit-splits
+            # engine path, which negotiates and returns them
+            from ..core.process_set import participant_count
+
+            p = participant_count(process_set)
+            if tensor.shape[0] % p:
+                raise ValueError(
+                    f"alltoall dim0 {tensor.shape[0]} not divisible "
+                    f"by size {p}")
+            splits_np = np.full((p,), tensor.shape[0] // p, np.int32)
+        data, rsplits = _alltoall_impl(tensor, splits_np, name,
+                                       process_set)
+        ctx.meta = (rsplits, process_set, return_single)
+        if return_single:
+            return data
+        return data, rsplits
+
+    @staticmethod
+    def backward(ctx, grad, *grad_splits):
+        rsplits, process_set, _single = ctx.meta
+        g, _ = alltoall(grad, splits=rsplits, process_set=process_set)
+        return g, None, None, None
+
+
+def _alltoall_impl(tensor, splits_np, name, process_set):
+    out = _hvt.alltoall(_to_jax(tensor), splits_np,
+                        process_set=process_set, name=name)
     if isinstance(out, tuple):
         data, rsplits = out
         return (_from_jax(data, like=tensor),
@@ -254,11 +385,61 @@ def alltoall(tensor: torch.Tensor, splits: Optional[torch.Tensor] = None,
     return _from_jax(out, like=tensor)
 
 
+def alltoall(tensor: torch.Tensor, splits: Optional[torch.Tensor] = None,
+             name=None, process_set=None):
+    """Scatter dim-0 slices to every rank, gather received (parity:
+    hvd.alltoall; returns (output, received_splits) like the reference
+    when splits is given); differentiable — the backward replays the
+    exchange with the received splits."""
+    if splits is None:
+        splits_np = None
+    elif torch.is_tensor(splits):
+        splits_np = _to_np(splits)
+    else:
+        splits_np = np.asarray(splits, np.int32)
+    if torch.is_grad_enabled() and tensor.requires_grad:
+        return _AlltoallFunction.apply(tensor, splits_np, name,
+                                       process_set)
+    return _alltoall_impl(tensor, splits_np, name, process_set)
+
+
+class _ReducescatterFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, op, name, process_set):
+        ctx.meta = (op, process_set)
+        return _reducescatter_impl(tensor, op, name, process_set)
+
+    @staticmethod
+    def backward(ctx, grad):
+        from ..core.process_set import participant_count
+        from ..comm.reduce_ops import ReduceOp, normalize_op
+
+        op, process_set = ctx.meta
+        rop = normalize_op(op, None)
+        if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            raise NotImplementedError(
+                f"gradient of a {rop.name} reducescatter is not "
+                "defined")
+        g = allgather(grad, process_set=process_set)
+        if rop == ReduceOp.AVERAGE:
+            g = g / participant_count(process_set)
+        return g, None, None, None
+
+
+def _reducescatter_impl(tensor, op, name, process_set):
+    out = _hvt.reducescatter(_to_jax(tensor), op=op,
+                             process_set=process_set, name=name)
+    return _from_jax(out, like=tensor)
+
+
 def reducescatter(tensor: torch.Tensor, op=None, name=None,
                   process_set=None) -> torch.Tensor:
-    out = _hvt.reducescatter(_to_jax(tensor), op=op, process_set=process_set,
-                             name=name)
-    return _from_jax(out, like=tensor)
+    """Differentiable reducescatter — the adjoint allgathers the shard
+    gradients (averaged backward for an Average forward)."""
+    if torch.is_grad_enabled() and tensor.requires_grad:
+        return _ReducescatterFunction.apply(tensor, op, name,
+                                            process_set)
+    return _reducescatter_impl(tensor, op, name, process_set)
 
 
 def barrier(process_set=None):

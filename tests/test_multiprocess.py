@@ -191,6 +191,64 @@ def test_process_sets_and_fusion_4proc():
         assert ps_gather == ([0.0, 2.0] if r % 2 == 0 else [1.0, 3.0])
 
 
+def test_torch_bare_collective_gradients_2proc():
+    """autograd through BARE torch collectives across ranks (parity:
+    the torch.autograd.Function registrations): grad of an averaged
+    allreduce averages the rank-local upstream grads; allgather's
+    grad sums-and-slices; broadcast's reduces to the root."""
+
+    def body():
+        import torch
+
+        import horovod_tpu.torch as hvd
+
+        hvd.init()
+        r = hvd.rank()
+        out = {}
+
+        # replicated weight through a bare averaged allreduce with a
+        # rank-local coefficient: grad = avg over ranks of the coeff
+        w = torch.tensor([[2.0]], requires_grad=True)
+        c = float(10 * (r + 1))
+        (hvd.allreduce(w, op=hvd.Average) * c).sum().backward()
+        out["bare"] = w.grad.ravel().tolist()
+
+        # allgather grad: summed coeffs, sliced to this rank's rows
+        x = torch.ones((r + 1, 2), requires_grad=True)
+        coeff = torch.tensor([[1.0], [2.0], [3.0]])
+        (hvd.allgather(x) * coeff).sum().backward()
+        out["gather_grad"] = x.grad.tolist()
+
+        # broadcast grad: reduce-to-root
+        b = torch.tensor([float(r + 5)], requires_grad=True)
+        (hvd.broadcast(b, root_rank=0) * float(r + 1)).sum().backward()
+        out["bcast_grad"] = b.grad.tolist()
+
+        # no-splits alltoall with DIFFERENT per-rank row counts: the
+        # adjoint must route each grad row back via the RECEIVED
+        # counts (rank0 sends 2 rows to each peer, rank1 sends 1)
+        t = torch.arange(float((2 - r) * 2), requires_grad=True)
+        recv = hvd.alltoall(t)
+        (recv * float(r + 1)).sum().backward()
+        out["a2a_grad"] = t.grad.tolist()
+        return (r, out)
+
+    results = _run(body, np=2)
+    for r, out in results:
+        assert out["bare"] == [15.0]  # avg(10, 20)
+        if r == 0:
+            assert out["gather_grad"] == [[2.0, 2.0]]
+        else:
+            assert out["gather_grad"] == [[4.0, 4.0], [6.0, 6.0]]
+        assert out["bcast_grad"] == ([3.0] if r == 0 else [0.0])
+        # rank0's rows 0-1 were received by rank0 (coeff 1), rows 2-3
+        # by rank1 (coeff 2); rank1's row 0 by rank0, row 1 by rank1
+        if r == 0:
+            assert out["a2a_grad"] == [1.0, 1.0, 2.0, 2.0]
+        else:
+            assert out["a2a_grad"] == [1.0, 2.0]
+
+
 def test_torch_optimizer_2proc():
     """The torch frontend end-to-end across processes: broadcast
     parameters, DistributedOptimizer averaging gradients."""

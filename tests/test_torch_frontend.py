@@ -135,6 +135,56 @@ class TestOps:
         hvd.broadcast_parameters(model.state_dict(), root_rank=0)
 
 
+class TestRegisteredGradients:
+    """torch.autograd.Function adjoints on the bare collectives
+    (parity: the HorovodAllreduce/... Function wrappers in
+    horovod/torch/mpi_ops.py).  Size-1 closed forms; cross-rank
+    behavior mirrors the TF suite's multiprocess coverage."""
+
+    def test_allreduce_grad_is_allreduce_of_grad(self, hvt):
+        x = torch.tensor([1.0, 2.0, 3.0], requires_grad=True)
+        hvd.allreduce(x * 2.0, op=hvd.Sum).sum().backward()
+        assert x.grad.tolist() == [2.0, 2.0, 2.0]
+
+    def test_allreduce_minmax_grad_rejected(self, hvt):
+        x = torch.tensor([1.0], requires_grad=True)
+        y = hvd.allreduce(x, op=hvd.Min)
+        with pytest.raises(NotImplementedError, match="MIN"):
+            y.backward()
+
+    def test_allgather_grad_slices_own_rows(self, hvt):
+        x = torch.ones((2, 1), requires_grad=True)
+        (hvd.allgather(x)
+         * torch.tensor([[2.0], [5.0]])).sum().backward()
+        assert x.grad.ravel().tolist() == [2.0, 5.0]
+
+    def test_broadcast_grad_reduces_to_root(self, hvt):
+        x = torch.ones(2, requires_grad=True)
+        (hvd.broadcast(x, root_rank=0) * 3.0).sum().backward()
+        assert x.grad.tolist() == [3.0, 3.0]
+
+    def test_reducescatter_grad_is_allgather(self, hvt):
+        x = torch.ones((2, 1), requires_grad=True)
+        (hvd.reducescatter(x, op=hvd.Sum) * 7.0).sum().backward()
+        assert x.grad.ravel().tolist() == [7.0, 7.0]
+
+    def test_alltoall_grad_routes_back(self, hvt):
+        x = torch.arange(3.0, requires_grad=True)
+        out, _ = hvd.alltoall(x, splits=[3])
+        (out * 5.0).sum().backward()
+        assert x.grad.tolist() == [5.0, 5.0, 5.0]
+        x = torch.arange(2.0, requires_grad=True)
+        (hvd.alltoall(x) * 2.0).sum().backward()
+        assert x.grad.tolist() == [2.0, 2.0]
+
+    def test_no_grad_path_unchanged(self, hvt):
+        # detached inputs keep the plain zero-overhead route and
+        # produce grad-free outputs
+        x = torch.ones(3)
+        out = hvd.allreduce(x, op=hvd.Sum)
+        assert not out.requires_grad
+
+
 class TestDistributedOptimizer:
     def _model_and_data(self):
         torch.manual_seed(0)
