@@ -222,13 +222,38 @@ def allreduce(tensor, average=None, op=None, name=None,
 def grouped_allreduce(tensors: List, average=None, op=None,
                       compression=Compression.none, process_set=None):
     if tf.executing_eagerly():
-        outs = _hvt.grouped_allreduce(
-            [_to_engine(t) for t in tensors], op=op, average=average,
-            compression=_engine_compression(compression),
-            process_set=process_set,
-        )
-        return [_from_engine(o, dtype=t.dtype)
-                for t, o in zip(tensors, outs)]
+        def impl(*xs):
+            outs = _hvt.grouped_allreduce(
+                [_to_engine(x) for x in xs], op=op, average=average,
+                compression=_engine_compression(compression),
+                process_set=process_set,
+            )
+            return tuple(_from_engine(o, dtype=x.dtype)
+                         for x, o in zip(xs, outs))
+
+        # Parity: RegisterGradient('HorovodGroupedAllreduce') — the
+        # group's gradient is a grouped allreduce of the gradients
+        # with the same attributes.
+        @tf.custom_gradient
+        def _op(*xs):
+            ys = impl(*xs)
+
+            def grad(*dys):
+                from ..comm.reduce_ops import ReduceOp, normalize_op
+
+                rop = normalize_op(op, average)
+                if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE,
+                               ReduceOp.ADASUM):
+                    raise NotImplementedError(
+                        f"gradient of a {rop.name} grouped_allreduce "
+                        "is not defined")
+                return tuple(grouped_allreduce(
+                    list(dys), average=average, op=op,
+                    compression=compression, process_set=process_set))
+
+            return ys, grad
+
+        return list(_op(*[tf.convert_to_tensor(t) for t in tensors]))
     return [
         allreduce(t, average=average, op=op, compression=compression,
                   process_set=process_set)

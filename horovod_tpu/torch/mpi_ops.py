@@ -208,9 +208,38 @@ def allreduce_(tensor: torch.Tensor, average=None, name=None,
     return tensor
 
 
-def grouped_allreduce(tensors: List[torch.Tensor], average=None, name=None,
-                      compression=Compression.none, op=None,
-                      process_set=None) -> List[torch.Tensor]:
+class _GroupedAllreduceFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, grad_mask, average, name, compression, op,
+                process_set, *tensors):
+        # grad_mask rides in from the wrapper: forward receives
+        # DETACHED tensors, so requires_grad must be captured outside
+        ctx.meta = (grad_mask, average, compression, op, process_set)
+        outs = _grouped_allreduce_impl(
+            list(tensors), average, name, compression, op, process_set)
+        non_diff = [o for o, m in zip(outs, grad_mask) if not m]
+        if non_diff:
+            # outputs of grad-free inputs must stay grad-free (a
+            # mixed list would otherwise poison e.g. .numpy() on them)
+            ctx.mark_non_differentiable(*non_diff)
+        return tuple(outs)
+
+    @staticmethod
+    def backward(ctx, *grads):
+        grad_mask, average, compression, op, process_set = ctx.meta
+        _check_grad_op(op, average)
+        idx = [i for i, m in enumerate(grad_mask) if m]
+        gs = grouped_allreduce([grads[i] for i in idx],
+                               average=average, compression=compression,
+                               op=op, process_set=process_set)
+        out: List[Optional[torch.Tensor]] = [None] * len(grads)
+        for j, i in enumerate(idx):
+            out[i] = gs[j]
+        return (None,) * 6 + tuple(out)
+
+
+def _grouped_allreduce_impl(tensors, average, name, compression, op,
+                            process_set):
     outs = _hvt.grouped_allreduce(
         [_to_jax(t) for t in tensors], op=op, average=average,
         compression=_engine_compression(compression),
@@ -220,6 +249,21 @@ def grouped_allreduce(tensors: List[torch.Tensor], average=None, name=None,
         _from_jax(o, like=t).reshape(t.shape)
         for o, t in zip(outs, tensors)
     ]
+
+
+def grouped_allreduce(tensors: List[torch.Tensor], average=None, name=None,
+                      compression=Compression.none, op=None,
+                      process_set=None) -> List[torch.Tensor]:
+    """Fused multi-tensor allreduce (parity: hvd.grouped_allreduce);
+    differentiable — the backward grouped-allreduces the gradients
+    with the same attributes."""
+    if torch.is_grad_enabled() and any(t.requires_grad for t in tensors):
+        mask = tuple(t.requires_grad for t in tensors)
+        return list(_GroupedAllreduceFunction.apply(
+            mask, average, name, compression, op, process_set,
+            *tensors))
+    return _grouped_allreduce_impl(tensors, average, name, compression,
+                                   op, process_set)
 
 
 def grouped_allreduce_(tensors: List[torch.Tensor], **kw) -> List[torch.Tensor]:

@@ -173,6 +173,17 @@ class TestRegisteredGradients:
         np.testing.assert_allclose(
             t.gradient(y, x).numpy(), [5.0, 5.0, 5.0])
 
+    def test_grouped_allreduce_grad(self, hvt):
+        xs = [tf.constant([1.0, 1.0]), tf.constant([1.0, 1.0, 1.0])]
+        with tf.GradientTape() as t:
+            t.watch(xs)
+            outs = hvd_tf.grouped_allreduce(xs, op=hvd_tf.Sum)
+            y = tf.reduce_sum(outs[0] * 2.0) + tf.reduce_sum(
+                outs[1] * 3.0)
+        g0, g1 = t.gradient(y, xs)
+        np.testing.assert_allclose(g0.numpy(), [2.0, 2.0])
+        np.testing.assert_allclose(g1.numpy(), [3.0, 3.0, 3.0])
+
     def test_alltoall_equal_splits_grad(self, hvt):
         x = tf.constant([1.0, 2.0])
         with tf.GradientTape() as t:

@@ -177,6 +177,27 @@ class TestRegisteredGradients:
         (hvd.alltoall(x) * 2.0).sum().backward()
         assert x.grad.tolist() == [2.0, 2.0]
 
+    def test_grouped_allreduce_grad(self, hvt):
+        xs = [torch.ones(2, requires_grad=True),
+              torch.ones(3, requires_grad=True)]
+        outs = hvd.grouped_allreduce(xs, op=hvd.Sum)
+        (outs[0] * 2.0).sum().add((outs[1] * 3.0).sum()).backward()
+        assert xs[0].grad.tolist() == [2.0, 2.0]
+        assert xs[1].grad.tolist() == [3.0, 3.0, 3.0]
+
+    def test_grouped_allreduce_mixed_grad_list(self, hvt):
+        # a grad-free tensor in the group must come back grad-free
+        # (e.g. .numpy() on it keeps working) while its peer still
+        # backprops
+        p = torch.ones(2, requires_grad=True)
+        d = torch.ones(2)
+        outs = hvd.grouped_allreduce([p, d], op=hvd.Sum)
+        assert not outs[1].requires_grad
+        outs[1].numpy()  # must not raise
+        (outs[0] * 4.0).sum().backward()
+        assert p.grad.tolist() == [4.0, 4.0]
+        assert d.grad is None
+
     def test_no_grad_path_unchanged(self, hvt):
         # detached inputs keep the plain zero-overhead route and
         # produce grad-free outputs
