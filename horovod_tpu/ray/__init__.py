@@ -83,10 +83,53 @@ class RayExecutor:
         self._started = False
 
 
-class ElasticRayExecutor:  # pragma: no cover - stub surface
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "ElasticRayExecutor: elastic jobs are driven by hvtpurun "
-            "--host-discovery-script (see horovod_tpu.elastic); Ray "
-            "placement-group elasticity is out of scope (SURVEY.md §7.3)."
+class ElasticRayExecutor:
+    """Elastic executor with the reference's lifecycle shape (parity:
+    ``horovod.ray.ElasticRayExecutor``): ``start()`` then ``run(fn)``
+    where ``fn`` follows the elastic contract (``hvd.elastic.State`` +
+    ``@hvd.elastic.run``).  Local-mode: ranks are launched under the
+    elastic DRIVER (restart-based reconfiguration, durable commits),
+    not Ray actors — placement-group scheduling stays out of scope
+    (SURVEY.md §7.3).  A ``host_discovery_script`` makes the world
+    resize live, the reference's Ray-autoscaler discovery analog."""
+
+    def __init__(self, settings=None, *,
+                 min_workers: Optional[int] = None,
+                 max_workers: Optional[int] = None,
+                 num_workers: Optional[int] = None,
+                 cpu_devices: Optional[int] = 1,
+                 env_vars: Optional[Dict[str, str]] = None,
+                 host_discovery_script: Optional[str] = None,
+                 override_discovery: bool = True,  # source compat
+                 use_gpu: bool = False, cpus_per_worker: int = 1,
+                 gpus_per_worker: Optional[int] = None):
+        self.num_workers = num_workers or max_workers or 2
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.cpu_devices = cpu_devices
+        self.env_vars = env_vars
+        self.host_discovery_script = host_discovery_script
+        self._started = False
+
+    def start(self):
+        self._started = True
+
+    def run(self, fn: Callable, args: tuple = (),
+            kwargs: Optional[Dict[str, Any]] = None) -> List[Any]:
+        """Run ``fn`` under the elastic driver; per-rank results of the
+        final world, ordered by rank."""
+        if not self._started:
+            raise RuntimeError(
+                "ElasticRayExecutor.start() must be called first")
+        from .. import runner
+
+        return runner.run_elastic(
+            fn, args=args, kwargs=kwargs,
+            num_proc=self.num_workers,
+            min_np=self.min_workers, max_np=self.max_workers,
+            cpu_devices=self.cpu_devices, env=self.env_vars,
+            host_discovery_script=self.host_discovery_script,
         )
+
+    def shutdown(self):
+        self._started = False

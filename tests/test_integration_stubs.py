@@ -67,8 +67,35 @@ class TestRayLocalMode:
         with pytest.raises(RuntimeError):
             ex.run(fn)
 
-    def test_elastic_refuses_clearly(self):
+    def test_elastic_ray_executor_runs(self):
+        """Round-4: ElasticRayExecutor became real (lifecycle over the
+        elastic driver; fn follows the elastic contract)."""
         import horovod_tpu.ray as ray_mod
 
-        with pytest.raises(NotImplementedError, match="hvtpurun"):
-            ray_mod.ElasticRayExecutor()
+        def body():
+            import jax.numpy as jnp
+
+            import horovod_tpu as hvt
+            import horovod_tpu.elastic as elastic
+
+            hvt.init()
+            state = elastic.ObjectState(epoch=0)
+
+            @elastic.run
+            def train(state):
+                while state.epoch < 2:
+                    hvt.allreduce(jnp.ones(2), op=hvt.Sum)
+                    state.epoch += 1
+                    state.commit()
+                return hvt.rank()
+
+            r = train(state)
+            hvt.shutdown()
+            return (r, state.epoch)
+
+        ex = ray_mod.ElasticRayExecutor(num_workers=2, min_workers=1)
+        with pytest.raises(RuntimeError, match="start"):
+            ex.run(body)
+        ex.start()
+        assert ex.run(body) == [(0, 2), (1, 2)]
+        ex.shutdown()
