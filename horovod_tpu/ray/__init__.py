@@ -103,13 +103,43 @@ class ElasticRayExecutor:
                  override_discovery: bool = True,  # source compat
                  use_gpu: bool = False, cpus_per_worker: int = 1,
                  gpus_per_worker: Optional[int] = None):
-        self.num_workers = num_workers or max_workers or 2
+        # reference source compat: ElasticRayExecutor carries its
+        # elastic bounds in a settings object (create_settings(min_np,
+        # max_np, ...)); honor those rather than silently dropping them
+        if settings is not None:
+            min_workers = min_workers or getattr(
+                settings, "min_np", None) or getattr(
+                settings, "min_workers", None)
+            max_workers = max_workers or getattr(
+                settings, "max_np", None) or getattr(
+                settings, "max_workers", None)
+            host_discovery_script = host_discovery_script or getattr(
+                settings, "discovery_script", None)
+        self.num_workers = num_workers or max_workers or min_workers or 2
         self.min_workers = min_workers
         self.max_workers = max_workers
+        if min_workers is not None and min_workers > self.num_workers:
+            raise ValueError(
+                f"min_workers={min_workers} exceeds the world size "
+                f"{self.num_workers} (num_workers/max_workers): the "
+                "static local discovery could never satisfy it")
         self.cpu_devices = cpu_devices
         self.env_vars = env_vars
         self.host_discovery_script = host_discovery_script
         self._started = False
+
+    @staticmethod
+    def create_settings(min_np: Optional[int] = None,
+                        max_np: Optional[int] = None,
+                        discovery_script: Optional[str] = None,
+                        **_ignored):
+        """Reference-shaped settings factory (parity:
+        ElasticRayExecutor.create_settings): a plain namespace the
+        constructor reads its elastic bounds from."""
+        from types import SimpleNamespace
+
+        return SimpleNamespace(min_np=min_np, max_np=max_np,
+                               discovery_script=discovery_script)
 
     def start(self):
         self._started = True

@@ -93,9 +93,18 @@ class TestRayLocalMode:
             hvt.shutdown()
             return (r, state.epoch)
 
-        ex = ray_mod.ElasticRayExecutor(num_workers=2, min_workers=1)
+        # reference settings-object style carries the elastic bounds
+        s = ray_mod.ElasticRayExecutor.create_settings(min_np=1,
+                                                       max_np=2)
+        ex = ray_mod.ElasticRayExecutor(s)
+        assert ex.min_workers == 1 and ex.num_workers == 2
         with pytest.raises(RuntimeError, match="start"):
             ex.run(body)
         ex.start()
         assert ex.run(body) == [(0, 2), (1, 2)]
         ex.shutdown()
+        # an unsatisfiable min must fail fast, not hang to the elastic
+        # timeout (min alone is fine — it sets the world size)
+        assert ray_mod.ElasticRayExecutor(min_workers=4).num_workers == 4
+        with pytest.raises(ValueError, match="min_workers"):
+            ray_mod.ElasticRayExecutor(num_workers=2, min_workers=4)
