@@ -1,0 +1,72 @@
+"""Spark KerasEstimator example — the horovod_tpu port surface of the
+reference's examples/spark/keras estimators: DataFrame in, trained
+model out, transform to predictions.  Pandas frames here (pyspark
+works when installed); ranks are real worker processes.
+
+Run:  python examples/spark_keras_estimator.py
+"""
+
+import argparse
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from horovod_tpu.spark import KerasEstimator, LocalStore
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-proc", type=int, default=2)
+    p.add_argument("--train-size", type=int, default=2048)
+    args = p.parse_args()
+
+    import keras
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(args.train_size, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = (x @ w).argmax(axis=1)
+    df = pd.DataFrame({"features": list(x), "label": y})
+
+    model = keras.Sequential([
+        keras.layers.Input((784,)),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+
+    with tempfile.TemporaryDirectory() as store_dir:
+        est = KerasEstimator(
+            model=model,
+            optimizer=keras.optimizers.SGD(learning_rate=0.1),
+            loss="sparse_categorical_crossentropy",
+            metrics=["accuracy"],
+            feature_cols=["features"],
+            label_cols=["label"],
+            validation=0.1,
+            batch_size=args.batch_size,
+            epochs=args.epochs,
+            num_proc=args.num_proc,
+            store=LocalStore(store_dir),
+            random_seed=42,
+            verbose=0,
+        )
+        trained = est.fit(df)
+        hist = trained.getHistory()
+        print(f"loss history: {[round(v, 4) for v in hist['loss']]}")
+        print(f"val_accuracy: "
+              f"{[round(v, 4) for v in hist['val_accuracy']]}")
+
+        out = trained.transform(df)
+        pred = np.stack(out["label__output"].to_numpy()).argmax(axis=1)
+        acc = float((pred == y).mean())
+        print(f"train accuracy after transform: {acc:.3f}")
+        assert hist["loss"][-1] < hist["loss"][0]
+        assert acc > 0.6
+        print(f"estimator OK ({args.num_proc} ranks)")
+
+
+if __name__ == "__main__":
+    main()
