@@ -268,25 +268,28 @@ def grouped_allreduce(tensors, *, op=None, average=None,
 
 def allgather(tensor, *, process_set=None, name: Optional[str] = None):
     _state.require_init("allgather")
-    return _eager.allgather(tensor, process_set=process_set)
+    return _eager.allgather(tensor, process_set=process_set, name=name)
 
 
 def broadcast(tensor, root_rank: int = 0, *, process_set=None,
               name: Optional[str] = None):
     _state.require_init("broadcast")
-    return _eager.broadcast(tensor, root_rank=root_rank, process_set=process_set)
+    return _eager.broadcast(tensor, root_rank=root_rank,
+                           process_set=process_set, name=name)
 
 
 def alltoall(tensor, splits=None, *, process_set=None,
              name: Optional[str] = None):
     _state.require_init("alltoall")
-    return _eager.alltoall(tensor, splits, process_set=process_set)
+    return _eager.alltoall(tensor, splits, process_set=process_set,
+                           name=name)
 
 
 def reducescatter(tensor, *, op=None, process_set=None,
                   name: Optional[str] = None):
     _state.require_init("reducescatter")
-    return _eager.reducescatter(tensor, op=op, process_set=process_set)
+    return _eager.reducescatter(tensor, op=op, process_set=process_set,
+                                name=name)
 
 
 def barrier(*, process_set=None):

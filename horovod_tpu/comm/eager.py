@@ -594,8 +594,13 @@ def allreduce(
     if timeline is not None:
         timeline.begin(tname, "ICI_ALLREDUCE")
     try:
-        stall.check(
-            st, ps, f"allreduce:{tuple(x.shape)}:{x.dtype}:{rop.name}")
+        # the descriptor carries the tensor NAME (not just op/shape/
+        # dtype): two ranks entering different same-shaped collectives
+        # must still be diagnosed as diverged (reference MessageTable
+        # keys on tensor names)
+        sdesc = stall.check(
+            st, ps,
+            f"allreduce:{tname}:{tuple(x.shape)}:{x.dtype}:{rop.name}")
         if p == 1:
             out = x * jnp.asarray(prescale_factor, x.dtype)
             # averaging / sum over one participant is identity
@@ -638,17 +643,24 @@ def allreduce(
                 stacked = _stack_global(x, hier)
                 fn = _jitted("allreduce_hier", hier, (rop, compression))
             out = _fetch(
-                fn(
-                    stacked,
-                    jnp.asarray(prescale_factor, jnp.float32),
-                    jnp.asarray(postscale_factor, jnp.float32),
-                )
+                stall.dispatch(
+                    st, ps, fn, (
+                        stacked,
+                        jnp.asarray(prescale_factor, jnp.float32),
+                        jnp.asarray(postscale_factor, jnp.float32),
+                    ))
             )
             if postprocess is not None:
                 out = postprocess(out)
+        # Amortized-watchdog mode completes the op before returning
+        # (reference sync-op semantics: hvd.allreduce returns a ready
+        # tensor); strict/disabled modes keep JAX's async dispatch.
+        out = stall.finish(st, ps, out, sdesc)
         if timeline is not None:
-            # Timeline mode trades async dispatch for accurate spans
-            # (the reference's timeline also serializes op completion).
+            # Accurate spans need completed ops in every mode (the
+            # reference's timeline also serializes op completion).
+            # After the interruptible finish: block_until_ready parks
+            # inside XLA, which must never precede the stall wait.
             jax.block_until_ready(out)
         return out
     finally:
@@ -656,12 +668,22 @@ def allreduce(
             timeline.end(tname)
 
 
-def _exchange_dim0_sizes(dim0: int, mesh: Mesh) -> np.ndarray:
+def _exchange_dim0_sizes(dim0: int, mesh: Mesh, st=None,
+                         ps=None) -> np.ndarray:
     """The allgather size-negotiation step (parity: the size table logic
-    in horovod/common/ops/collective_operations.cc AllgatherOp)."""
+    in horovod/common/ops/collective_operations.cc AllgatherOp).
+
+    The np.asarray conversion would park inside XLA's uninterruptible
+    wait if a peer never joined; routing through ``stall.finish`` first
+    keeps the negotiation abortable under the amortized watchdog."""
     stacked = _stack_global(jnp.asarray(dim0, jnp.int32), mesh)
     fn = _jitted("allgather", mesh, ())
-    return np.asarray(_fetch(fn(stacked)))
+    if st is not None and ps is not None:
+        out = _fetch(stall.dispatch(st, ps, fn, (stacked,)))
+        out = stall.finish(st, ps, out)
+    else:
+        out = _fetch(fn(stacked))
+    return np.asarray(out)
 
 
 def grouped_allreduce(
@@ -700,7 +722,7 @@ def grouped_allreduce(
     return unpack_flat(red, specs)
 
 
-def allgather(tensor, *, process_set=None):
+def allgather(tensor, *, process_set=None, name: Optional[str] = None):
     """Concatenate per-rank tensors along dim 0; ranks may differ in dim 0
     (sizes are negotiated first, like the reference's allgather).
     """
@@ -712,9 +734,10 @@ def allgather(tensor, *, process_set=None):
         return x
     # dim0 excluded from the descriptor: per-rank sizes are legitimate
     # for allgather and negotiated right below
-    stall.check(
-        st, ps, f"allgather:{tuple(x.shape[1:])}:{x.dtype}")
-    sizes = _exchange_dim0_sizes(x.shape[0], mesh)
+    tname = name or f"allgather.{x.shape[1:]}.{x.dtype}"
+    sdesc = stall.check(
+        st, ps, f"allgather:{tname}:{tuple(x.shape[1:])}:{x.dtype}")
+    sizes = _exchange_dim0_sizes(x.shape[0], mesh, st, ps)
     maxd = int(sizes.max())
     padded = (
         x
@@ -727,19 +750,24 @@ def allgather(tensor, *, process_set=None):
           else _multidev_mesh_or_none(ps))
     if md is not None:
         stacked, flat_size = _stack_global_multidev(padded, md)
-        out = _fetch(_jitted("allgather_multidev", md, ())(stacked))
+        out = _fetch(stall.dispatch(
+            st, ps, _jitted("allgather_multidev", md, ()), (stacked,)))
         gathered = out[:, :flat_size].reshape((p,) + padded.shape)
     else:
         stacked = _stack_global(padded, mesh)
-        gathered = _fetch(_jitted("allgather", mesh, ())(stacked))
+        gathered = _fetch(stall.dispatch(
+            st, ps, _jitted("allgather", mesh, ()), (stacked,)))
     # gathered: (P, maxd, ...); trim each rank's block to its size.
     if all(int(s) == maxd for s in sizes):
-        return gathered.reshape((p * maxd,) + gathered.shape[2:])
-    parts = [gathered[r, : int(sizes[r])] for r in range(p)]
-    return jnp.concatenate(parts, axis=0)
+        out = gathered.reshape((p * maxd,) + gathered.shape[2:])
+    else:
+        parts = [gathered[r, : int(sizes[r])] for r in range(p)]
+        out = jnp.concatenate(parts, axis=0)
+    return stall.finish(st, ps, out, sdesc)
 
 
-def broadcast(tensor, *, root_rank: int = 0, process_set=None):
+def broadcast(tensor, *, root_rank: int = 0, process_set=None,
+              name: Optional[str] = None):
     st, ps = _resolve_process_set(process_set)
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
@@ -753,23 +781,27 @@ def broadcast(tensor, *, root_rank: int = 0, process_set=None):
             f"root_rank {root_rank} is not a member of process set "
             f"{ps.process_set_id} (ranks {ps.ranks})"
         )
-    stall.check(
+    tname = name or f"broadcast.{x.shape}.{x.dtype}"
+    sdesc = stall.check(
         st, ps,
-        f"broadcast:{tuple(x.shape)}:{x.dtype}:root{root_rank}")
+        f"broadcast:{tname}:{tuple(x.shape)}:{x.dtype}:root{root_rank}")
     md = (None if x.nbytes < _MULTIDEV_MIN_BYTES
           else _multidev_mesh_or_none(ps))
     if md is not None:
         stacked, flat_size = _stack_global_multidev(x, md)
-        out = _fetch(
-            _jitted("broadcast_multidev", md, (root_in_set,))(stacked)
-        )
-        return out[:flat_size].reshape(x.shape)
+        out = _fetch(stall.dispatch(
+            st, ps, _jitted("broadcast_multidev", md, (root_in_set,)),
+            (stacked,)))
+        return stall.finish(st, ps, out[:flat_size].reshape(x.shape),
+                            sdesc)
     stacked = _stack_global(x, mesh)
-    out = _jitted("broadcast", mesh, (root_in_set,))(stacked)
-    return _fetch(out)
+    out = stall.dispatch(
+        st, ps, _jitted("broadcast", mesh, (root_in_set,)), (stacked,))
+    return stall.finish(st, ps, _fetch(out), sdesc)
 
 
-def alltoall(tensor, splits=None, *, process_set=None):
+def alltoall(tensor, splits=None, *, process_set=None,
+             name: Optional[str] = None):
     """Distribute dim-0 slices to every rank.
 
     Returns the received tensor when ``splits`` is None (equal splits),
@@ -797,8 +829,9 @@ def alltoall(tensor, splits=None, *, process_set=None):
         raise ValueError("splits must be a (size,) vector summing to dim0")
     if p == 1:
         return (x, jnp.asarray(splits)) if return_splits else x
-    stall.check(
-        st, ps, f"alltoall:{tuple(x.shape[1:])}:{x.dtype}")
+    tname = name or f"alltoall.{x.shape[1:]}.{x.dtype}"
+    sdesc = stall.check(
+        st, ps, f"alltoall:{tname}:{tuple(x.shape[1:])}:{x.dtype}")
 
     # Negotiate the split matrix: row r = rank r's send splits.
     split_matrix = np.asarray(
@@ -821,19 +854,22 @@ def alltoall(tensor, splits=None, *, process_set=None):
           else _multidev_mesh_or_none(ps))
     if md is not None:
         stacked, inner = _stack_global_multidev_rows(send, p, md)
-        got = _fetch(_jitted("alltoall_multidev", md, ())(stacked))[0]
+        got = _fetch(stall.dispatch(
+            st, ps, _jitted("alltoall_multidev", md, ()), (stacked,)))[0]
         out = got[:, :inner].reshape((p, max_chunk) + x.shape[1:])
     else:
         stacked = _stack_global(send, mesh)
         # local shard of the (P, P, max_chunk, ...) output:
         # (1, P, max_chunk, ...)
-        out = _fetch(_jitted("alltoall", mesh, ())(stacked))[0]
+        out = _fetch(stall.dispatch(
+            st, ps, _jitted("alltoall", mesh, ()), (stacked,)))[0]
     parts = [out[r, : int(recv_splits[r])] for r in range(p)]
-    result = jnp.concatenate(parts, axis=0)
+    result = stall.finish(st, ps, jnp.concatenate(parts, axis=0), sdesc)
     return (result, jnp.asarray(recv_splits)) if return_splits else result
 
 
-def reducescatter(tensor, *, op=None, process_set=None):
+def reducescatter(tensor, *, op=None, process_set=None,
+                  name: Optional[str] = None):
     """Reduce across ranks, return this rank's dim-0 shard.
 
     Divisible dim 0 uses a true ``psum_scatter`` (each rank receives only
@@ -847,9 +883,10 @@ def reducescatter(tensor, *, op=None, process_set=None):
     p = ps.size
     if p == 1:
         return x
-    stall.check(
+    tname = name or f"reducescatter.{x.shape}.{x.dtype}"
+    sdesc = stall.check(
         st, ps,
-        f"reducescatter:{tuple(x.shape)}:{x.dtype}:{rop.name}")
+        f"reducescatter:{tname}:{tuple(x.shape)}:{x.dtype}:{rop.name}")
     if x.shape[0] % p == 0:
         # lane path: Sum/Average only (psum_scatter is a sum wire) and
         # float Average only (int AVERAGE has floor-div semantics the
@@ -862,13 +899,16 @@ def reducescatter(tensor, *, op=None, process_set=None):
         if md is not None:
             q = x.shape[0] // p
             stacked, inner = _stack_global_multidev_rows(x, p, md)
-            out = _fetch(
-                _jitted("reducescatter_multidev", md, (rop,))(stacked))
-            return out[0][:inner].reshape((q,) + x.shape[1:])
+            out = _fetch(stall.dispatch(
+                st, ps, _jitted("reducescatter_multidev", md, (rop,)),
+                (stacked,)))
+            return stall.finish(
+                st, ps, out[0][:inner].reshape((q,) + x.shape[1:]), sdesc)
         mesh = ps.proc_mesh()
         stacked = _stack_global(x, mesh)
-        out = _fetch(_jitted("reducescatter", mesh, (rop,))(stacked))[0]
-        return out
+        out = _fetch(stall.dispatch(
+            st, ps, _jitted("reducescatter", mesh, (rop,)), (stacked,)))[0]
+        return stall.finish(st, ps, out, sdesc)
     reduced = allreduce(x, op=rop, process_set=ps)
     r = ps.rank_in_set(st.rank)
     base, extra = divmod(x.shape[0], p)

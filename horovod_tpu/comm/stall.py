@@ -12,61 +12,150 @@ its own inspector inside the eager mini-controller
 blocks forever when a rank diverges — the classic Horovod deadlock
 this subsystem exists to catch (SURVEY §5.2 calls it essential).
 
-TPU-native design: an XLA collective cannot be interrupted once
-entered, so detection must happen **before** dispatch.  Every sync
-collective performs a cheap KV rendezvous over the JAX coordination
-service (the store that already hosts init and the async controller's
-transport): post ``stall/<gen>/<set>/<seq>/<rank> = op-descriptor``,
-then await the other member ranks' marks for the same sequence number.
-Arrival order per (process set) is rank-consistent by the SPMD
-contract, so the sequence number needs no negotiation.  Outcomes:
+Two modes (``HVTPU_STALL_CHECK_MODE``):
 
-- all marks arrive (normal case: one try_get per peer) → dispatch;
-- a peer's mark carries a DIFFERENT descriptor → the ranks have
-  diverged onto different collectives — raise immediately, naming
-  both ops (the reference logs this as a mismatched-tensor error);
-- past ``stall_check_time_seconds`` → warn, naming the op, the wait,
-  and exactly which ranks are absent (repeats each interval);
-- past ``stall_shutdown_time_seconds`` (when > 0) → raise
-  ``HorovodInternalError`` instead of hanging — which the elastic
-  ``run`` decorator already catches as a recoverable failure, so a
-  stalled elastic job rolls back and re-rendezvouses like the
-  reference's shutdown-on-stall path.
+**amortized** (default) — the reference's own cost model: its
+inspector piggybacks the coordinator's existing cycle, adding ~zero
+per-op traffic.  Here every sync collective does LOCAL bookkeeping
+only (a per-process-set sequence counter, a bounded ring of recent op
+descriptors, an in-flight marker); a background heartbeat thread
+publishes the snapshot to the coordination KV every
+``stall_heartbeat_seconds`` (default 0.5) and reads the peers':
+
+- a peer's ring holding a DIFFERENT descriptor at a shared sequence
+  number → the ranks diverged onto different collectives → fail,
+  naming both ops and the op index;
+- this rank in-flight past ``stall_check_time_seconds`` while member
+  ranks' counters never reached the op → warn, naming exactly which
+  ranks are absent (repeats each interval);
+- past ``stall_shutdown_time_seconds`` (when > 0) → fail.
+
+"Fail" latches a diagnosis that the data plane raises as
+``HorovodInternalError`` wherever it can do so cleanly: the next op's
+pre-dispatch check, or the interruptible completion wait
+(``wait_ready`` polls ``jax.Array.is_ready`` instead of parking inside
+an uninterruptible XLA wait, so even a rank already blocked on a
+doomed collective aborts with the diagnosis).  The elastic ``run``
+decorator catches that error as a recoverable failure, so a stalled
+elastic job rolls back and re-rendezvouses like the reference's
+shutdown-on-stall path.  Detection latency is one heartbeat; the
+healthy-path cost is ~1 µs/op and two KV RPCs per heartbeat — vs one
+KV write plus a polled read PER OP for strict mode, which doubled
+small-op latency (BENCH_SCALING.json coordination_vs_P, round 4).
+
+**strict** — the round-4 pre-dispatch rendezvous: post
+``stall/<gen>/<set>/<seq>/<rank> = op-descriptor``, await every member
+rank's mark before dispatching, diagnose mismatches immediately.  No
+collective is ever dispatched unless all members confirmed the same
+descriptor — useful when debugging a desync that corrupts instead of
+deadlocks — at the price of a KV round-trip per op.
 
 The async controller's cycle thread executes its (already negotiated)
 responses through the same ``comm/eager`` functions; it registers
-itself via ``bypass_thread()`` so those dispatches skip the
-rendezvous.  Nested internal collectives (barrier's allreduce,
-reducescatter's uneven-path allreduce) rendezvous on their own — the
-nesting is part of the op's implementation, hence identical on every
-rank, so the extra checks stay consistent and only refine diagnostics.
+itself via ``bypass_thread()`` so those dispatches skip the watchdog.
+Nested internal collectives (allgather's size negotiation, alltoall's
+split exchange) are part of the op's implementation, hence identical
+on every rank, so their extra bookkeeping stays consistent and only
+refines diagnostics.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import queue
 import threading
 import time
-from typing import Dict, Optional
+from collections import deque
+from typing import Dict, List, Optional
 
-from ..core import state as core_state
 from ..core.exceptions import HorovodInternalError
 
 logger = logging.getLogger("horovod_tpu")
 
-_NS = "hvtstall"
+_NS = "hvtstall"      # strict-mode per-op rendezvous marks
+_HB = "hvtstallhb"    # amortized-mode heartbeat snapshots
 _tls = threading.local()
+
+_RING = 256           # per-set descriptor history kept locally
+_POST = 48            # ring tail published in each heartbeat
+
+# Latched when the watchdog abandons a PENDING collective (wait_ready
+# raised while the op never completed): the XLA runtime then holds an
+# execution thread parked inside the dead collective, so a normal
+# interpreter teardown (client destructor, jax.distributed shutdown
+# barrier) would hang.  Exit paths consult this to hard-exit instead
+# — the reference's stall shutdown likewise aborts the process.
+_poisoned = False
+_poison_gen = -1
+
+
+def _latch_poison(insp) -> None:
+    """Latch only for the INSTALLED inspector: a standalone instance
+    (unit tests, tooling) abandoning a fake wait must not hijack the
+    whole interpreter's exit path."""
+    global _poisoned, _poison_gen
+    try:
+        from ..core import state as _core_state
+
+        if _core_state.global_state().sync_stall is not insp:
+            return
+    except Exception:
+        return
+    _poisoned = True
+    _poison_gen = max(_poison_gen, insp.gen)
+
+
+def poisoned() -> bool:
+    """True when a stall/mismatch abort left a stuck collective behind
+    and process teardown must not wait on the XLA runtime."""
+    return _poisoned
+
+
+def poison_exit_status() -> int:
+    """Exit status for the hard-exit path: 0 when the process
+    re-initialized into a NEWER generation after the poisoning (the
+    wedged execution belongs to a previous session — e.g. elastic
+    recovery rolled back and the job went on to finish), 1 when the
+    stall abort is the terminal event."""
+    try:
+        from ..core import state as _core_state
+
+        if _core_state.global_state().init_generation > _poison_gen:
+            return 0
+    except Exception:
+        pass
+    return 1
+
+
+def _mismatch_msg(set_id, seq, rank, mine, peer, theirs) -> str:
+    return (
+        f"collective mismatch at process set {set_id} op #{seq}: this "
+        f"rank ({rank}) entered [{mine}] but rank {peer} entered "
+        f"[{theirs}]. Ranks have diverged onto different collectives; "
+        "this would deadlock or corrupt the wire."
+    )
+
+
+def _stall_abort_msg(desc, set_id, seq, elapsed, abort_s, pending) -> str:
+    return (
+        f"stalled collective [{desc}] (process set {set_id}, op "
+        f"#{seq}): waited {elapsed:.1f}s > stall shutdown time "
+        f"{abort_s:.1f}s; ranks not at the rendezvous: {pending}. One "
+        "or more ranks skipped this collective or died before "
+        "reaching it."
+    )
 
 
 def bypass_thread():
     """Mark the CURRENT thread's eager collectives as exempt from the
-    sync rendezvous (used by the async controller's cycle thread, whose
+    sync watchdog (used by the async controller's cycle thread, whose
     op order is already negotiated and stall-inspected)."""
     _tls.bypass = True
 
 
 class SyncStallInspector:
-    """Per-process rendezvous bookkeeping over the coordination KV."""
+    """Strict mode: per-op rendezvous over the coordination KV."""
 
     def __init__(self, client, rank: int, warn_s: float, abort_s: float,
                  generation: int = 0):
@@ -132,24 +221,16 @@ class SyncStallInspector:
                     still.append(r)
                 elif val != desc:
                     raise HorovodInternalError(
-                        f"collective mismatch at process set {set_id} "
-                        f"op #{seq}: this rank ({self.rank}) is entering "
-                        f"[{desc}] but rank {r} posted [{val}]. Ranks "
-                        "have diverged onto different collectives; this "
-                        "would deadlock or corrupt the wire."
-                    )
+                        _mismatch_msg(set_id, seq, self.rank, desc,
+                                      r, val))
             pending = still
             if not pending:
                 break
             elapsed = time.monotonic() - start
             if self.abort_s > 0 and elapsed > self.abort_s:
                 raise HorovodInternalError(
-                    f"stalled collective [{desc}] (process set {set_id}, "
-                    f"op #{seq}): waited {elapsed:.1f}s > stall shutdown "
-                    f"time {self.abort_s:.1f}s; ranks not at the "
-                    f"rendezvous: {pending}. One or more ranks skipped "
-                    "this collective or died before reaching it."
-                )
+                    _stall_abort_msg(desc, set_id, seq, elapsed,
+                                     self.abort_s, pending))
             if self.warn_s > 0 and elapsed > next_warn:
                 next_warn += self.warn_s
                 logger.warning(
@@ -174,35 +255,467 @@ class SyncStallInspector:
                 pass
 
 
-def check(st, ps, desc: str) -> None:
-    """The eager ops' pre-dispatch hook: rendezvous with the other
-    member ranks (the XLA collective entered next is uninterruptible),
-    or no-op when stall checking cannot or should not engage (single
-    member, controller thread, disabled, no coordination client)."""
-    if ps.size <= 1 or getattr(_tls, "bypass", False):
-        return
-    cfg = st.config
-    if cfg is None or cfg.stall_check_disable:
-        return
-    inspector = st.sync_stall
-    if inspector is None:
-        try:
-            from jax._src import distributed as _jd
+class _SetTrack:
+    """Per-process-set local bookkeeping (amortized mode)."""
 
-            client = _jd.global_state.client
+    __slots__ = ("seq", "ring", "inflight", "t0", "members", "next_warn")
+
+    def __init__(self):
+        self.seq = 0                      # ops STARTED on this set
+        # (seq, descriptor, start time) history
+        self.ring = deque(maxlen=_RING)
+        self.inflight: Optional[str] = None
+        self.t0 = 0.0
+        self.members: tuple = ()
+        self.next_warn = 0.0
+
+
+class AmortizedStallInspector:
+    """Amortized mode: local bookkeeping + background heartbeat.
+
+    See the module docstring for the protocol.  All state shared with
+    the heartbeat thread lives behind ``_lock``; the data-plane hooks
+    (``pre_op``/``wait_ready``) never perform KV RPCs.
+    """
+
+    def __init__(self, client, rank: int, warn_s: float, abort_s: float,
+                 heartbeat_s: float = 0.5, generation: int = 0,
+                 stale_s: Optional[float] = None):
+        self._kv = client
+        self.rank = rank
+        self.warn_s = warn_s
+        self.abort_s = abort_s
+        self.heartbeat_s = max(heartbeat_s, 0.02)
+        # a peer whose beat number stops advancing for this long is
+        # treated as dead/stalled even if its last snapshot showed it
+        # caught up — it may have died MID-collective, after posting
+        self.stale_s = (max(5 * self.heartbeat_s, 2.0)
+                        if stale_s is None else stale_s)
+        # rank -> (last beat number, when it last changed); touched
+        # only from the heartbeat thread
+        self._peer_seen: Dict[int, tuple] = {}
+        self.gen = generation
+        self._lock = threading.Lock()
+        self._tracks: Dict[str, _SetTrack] = {}
+        self.failure: Optional[str] = None
+        self._beat = 0
+        self._stopped = threading.Event()
+        # Collective dispatch executor: some backends execute the
+        # compiled program synchronously ON the dispatching thread
+        # (CPU/Gloo runs the wire exchange inline), which would park
+        # the main thread uninterruptibly inside a dead collective.
+        # Dispatching from this helper thread keeps the main thread
+        # free to observe the failure latch and raise.
+        self._exec_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._exec_thread: Optional[threading.Thread] = None
+        self._thread = threading.Thread(
+            target=self._beat_loop, name="hvt-stall-heartbeat", daemon=True)
+        self._thread.start()
+
+    # -- data-plane hooks (hot path: no RPCs) --------------------------
+    def pre_op(self, set_id, members, desc: str) -> None:
+        """Record the op start; raise a latched failure cleanly before
+        dispatching another doomed collective."""
+        with self._lock:
+            if self.failure:
+                raise HorovodInternalError(self.failure)
+            tr = self._tracks.get(str(set_id))
+            if tr is None:
+                tr = self._tracks[str(set_id)] = _SetTrack()
+            tr.members = tuple(members)
+            now = time.monotonic()
+            tr.ring.append((tr.seq, desc, now))
+            tr.inflight = desc
+            tr.t0 = now
+            tr.next_warn = self.warn_s
+            tr.seq += 1
+        return desc
+
+    def dispatch(self, set_id, fn, args):
+        """Run ``fn(*args)`` (a compiled collective) on the executor
+        thread; wait interruptibly so a latched failure aborts this
+        rank even when the backend executes synchronously on the
+        dispatching thread.  On abort the executor stays parked inside
+        the dead collective — the process is poisoned (see
+        ``poisoned()``) and exit paths hard-exit."""
+        with self._lock:
+            if self.failure:
+                raise HorovodInternalError(self.failure)
+        if self._exec_thread is None or not self._exec_thread.is_alive():
+            self._exec_thread = threading.Thread(
+                target=self._exec_loop, name="hvt-stall-dispatch",
+                daemon=True)
+            self._exec_thread.start()
+        box = [threading.Event(), None, None]  # done, value, error
+        self._exec_q.put((box, fn, args))
+        while not box[0].wait(0.05):
+            if self.failure:
+                _latch_poison(self)
+                self._clear_inflight(set_id)
+                # the executor is wedged inside the dead collective;
+                # leave it (daemon) and surface the diagnosis
+                self._exec_thread = None
+                raise HorovodInternalError(self.failure)
+        if box[2] is not None:
+            raise box[2]
+        return box[1]
+
+    def _exec_loop(self) -> None:
+        while True:
+            item = self._exec_q.get()
+            if item is None:
+                return
+            box, fn, args = item
+            try:
+                box[1] = fn(*args)
+            except BaseException as e:  # surfaced on the caller thread
+                box[2] = e
+            finally:
+                box[0].set()
+
+    def wait_ready(self, set_id, out, desc: Optional[str] = None) -> None:
+        """Interruptible completion wait: poll ``is_ready`` so the
+        heartbeat's failure latch can abort a rank that would otherwise
+        park forever inside XLA's blocking wait.  Completes in one
+        check on the healthy path once the result lands.  ``desc``
+        names the op being waited on (for re-arming after a nested
+        negotiation collective cleared the in-flight marker)."""
+        is_ready = getattr(out, "is_ready", None)
+        with self._lock:
+            tr = self._tracks.get(str(set_id))
+            if tr is not None and tr.inflight is None and tr.ring:
+                # a nested negotiation collective (alltoall's split
+                # exchange rides a full allgather) cleared the marker;
+                # re-arm it — under the OUTER op's name and original
+                # start time, so a stall here is diagnosed as the op
+                # the user called, with its true age
+                entry = None
+                if desc is not None:
+                    for e in reversed(tr.ring):
+                        if e[1] == desc:
+                            entry = e
+                            break
+                if entry is None:
+                    entry = tr.ring[-1]
+                tr.inflight = entry[1]
+                tr.t0 = entry[2]
+                tr.next_warn = self.warn_s
+        sleep = 0.0
+        waited = 0.0
+        while is_ready is not None and not is_ready():
+            if self.failure:
+                _latch_poison(self)
+                self._clear_inflight(set_id)
+                raise HorovodInternalError(self.failure)
+            # back off from a near-spin (small ops land in <1 ms)
+            # to a 0.5 ms poll, then to 5 ms once the op has clearly
+            # left the small-op regime — bounds both the overshoot
+            # (sub-1% of the op at every scale) and the poll rate
+            waited += sleep
+            cap = 5e-4 if waited < 0.02 else 5e-3
+            sleep = min(cap, sleep * 2 if sleep else 5e-5)
+            time.sleep(sleep)
+        self._clear_inflight(set_id)
+        if self.failure:
+            # the collective completed but the job is already failed
+            # (e.g. a peer diverged on another set) — surface it now
+            raise HorovodInternalError(self.failure)
+
+    def _clear_inflight(self, set_id) -> None:
+        with self._lock:
+            tr = self._tracks.get(str(set_id))
+            if tr is not None:
+                tr.inflight = None
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._exec_thread is not None and self._exec_thread.is_alive():
+            self._exec_q.put(None)
+            self._exec_thread.join(timeout=2.0)
+        self._thread.join(timeout=2.0)
+        for b in (self._beat - 1, self._beat - 2):
+            if b >= 0:
+                try:
+                    self._kv.key_value_delete(
+                        f"{_HB}/{self.gen}/{self.rank}/{b}")
+                except Exception:
+                    pass
+
+    # -- heartbeat -----------------------------------------------------
+    def _beat_loop(self) -> None:
+        while not self._stopped.wait(self.heartbeat_s):
+            try:
+                self._beat_once()
+            except Exception:
+                # the watchdog must never take the job down on its own
+                logger.debug("stall heartbeat error", exc_info=True)
+
+    def _beat_once(self) -> None:
+        with self._lock:
+            now = time.monotonic()
+            sets = {
+                sid: {
+                    "seq": tr.seq,
+                    "ring": [[s, d] for s, d, _t in list(tr.ring)[-_POST:]],
+                    "inflight": tr.inflight,
+                    "age": (now - tr.t0) if tr.inflight else 0.0,
+                }
+                for sid, tr in self._tracks.items()
+            }
+            payload = json.dumps({"fail": self.failure, "sets": sets})
+        key = f"{_HB}/{self.gen}/{self.rank}/{self._beat}"
+        self._kv.key_value_set(key, payload)
+        if self._beat >= 2:
+            # rolling cleanup: each rank deletes only its own old beats
+            try:
+                self._kv.key_value_delete(
+                    f"{_HB}/{self.gen}/{self.rank}/{self._beat - 2}")
+            except Exception:
+                pass
+        self._beat += 1
+        try:
+            entries = self._kv.key_value_dir_get(f"{_HB}/{self.gen}/")
         except Exception:
-            client = None
-        if client is None:
-            st.sync_stall = False
             return
-        inspector = SyncStallInspector(
+        latest: Dict[int, tuple] = {}
+        for k, v in entries:
+            parts = k.rsplit("/", 2)
+            if len(parts) < 3:
+                continue
+            try:
+                r, b = int(parts[-2]), int(parts[-1])
+            except ValueError:
+                continue
+            if r == self.rank:
+                continue
+            if r not in latest or b > latest[r][0]:
+                latest[r] = (b, v)
+        now = time.monotonic()
+        for r, (b, _v) in latest.items():
+            prev = self._peer_seen.get(r)
+            if prev is None or b != prev[0]:
+                self._peer_seen[r] = (b, now)
+        stale = {r for r, (_b, t) in self._peer_seen.items()
+                 if now - t > self.stale_s}
+        peers: Dict[int, dict] = {}
+        for r, (_b, v) in latest.items():
+            try:
+                peers[r] = json.loads(v)
+            except Exception:
+                pass
+        self._evaluate(peers, stale)
+
+    def _evaluate(self, peers: Dict[int, dict],
+                  stale: Optional[set] = None) -> None:
+        stale = stale or set()
+        now = time.monotonic()
+        fail: Optional[str] = None
+        warns: List[tuple] = []
+        with self._lock:
+            if self.failure:
+                return
+            # a peer that already latched a failure takes the whole job
+            # down (reference shutdown-on-stall semantics): surface its
+            # diagnosis instead of hanging on our side
+            for r, snap in peers.items():
+                pf = snap.get("fail")
+                if pf:
+                    fail = f"rank {r} aborted the job: {pf}"
+                    break
+            for sid, tr in self._tracks.items():
+                if fail:
+                    break
+                mine = {s: d for s, d, _t in tr.ring}
+                for r, snap in peers.items():
+                    pset = snap.get("sets", {}).get(sid)
+                    if not pset:
+                        continue
+                    # divergence: a shared sequence number whose
+                    # descriptor differs — the rings are seq-ordered,
+                    # so the first hit is the earliest visible one
+                    for s_d in pset.get("ring", []):
+                        s, d = s_d[0], s_d[1]
+                        md = mine.get(s)
+                        if md is not None and md != d:
+                            fail = _mismatch_msg(
+                                sid, s, self.rank, md, r, d)
+                            break
+                    if fail:
+                        break
+                if fail:
+                    break
+                # stall: we are in-flight past the deadline and some
+                # member's counter never reached this op
+                if tr.inflight and tr.members:
+                    age = now - tr.t0
+                    want_abort = self.abort_s > 0 and age > self.abort_s
+                    want_warn = self.warn_s > 0 and age > tr.next_warn
+                    if not (want_abort or want_warn):
+                        continue
+                    behind = []
+                    for r in tr.members:
+                        if r == self.rank:
+                            continue
+                        snap = peers.get(r)
+                        pseq = 0
+                        if snap is not None:
+                            pseq = snap.get("sets", {}).get(
+                                sid, {}).get("seq", 0)
+                        # a stale peer counts as absent even when its
+                        # last snapshot showed it caught up: it may
+                        # have died mid-collective, after posting
+                        if pseq < tr.seq or r in stale:
+                            behind.append(r)
+                    if not behind:
+                        # everyone dispatched it: a slow collective,
+                        # not a stall
+                        continue
+                    if want_abort:
+                        fail = _stall_abort_msg(
+                            tr.inflight, sid, tr.seq - 1, age,
+                            self.abort_s, behind)
+                    elif want_warn:
+                        tr.next_warn = age + self.warn_s
+                        warns.append(
+                            (tr.inflight, sid, tr.seq - 1, age, behind))
+            if fail:
+                self.failure = fail
+        for desc, sid, op, age, behind in warns:
+            logger.warning(
+                "stalled collective [%s] (process set %s, op #%d): "
+                "waited %.1fs; ranks not at the rendezvous: %s",
+                desc, sid, op, age, behind,
+            )
+
+
+def _make_inspector(st, cfg):
+    """Create the configured inspector, or latch ``False`` (disabled)
+    when no coordination client exists in this process."""
+    try:
+        from jax._src import distributed as _jd
+
+        client = _jd.global_state.client
+    except Exception:
+        client = None
+    if client is None:
+        st.sync_stall = False
+        logger.warning(
+            "stall watchdog disabled: no coordination-service client in "
+            "this process, so sync collectives cannot be stall-checked "
+            "and a diverged rank will hang instead of aborting with a "
+            "diagnosis. (Set HVTPU_STALL_CHECK_DISABLE=1 or launch with "
+            "--no-stall-check to silence this if intentional.)")
+        return None
+    mode = str(getattr(cfg, "stall_check_mode", "amortized")).lower()
+    if mode not in ("amortized", "strict"):
+        raise ValueError(
+            f"stall_check_mode (HVTPU_STALL_CHECK_MODE) must be "
+            f"'amortized' or 'strict', got {cfg.stall_check_mode!r}")
+    if (mode == "strict"
+            or getattr(client, "key_value_dir_get", None) is None):
+        # amortized detection needs the directory get to read peers'
+        # heartbeats in one RPC; without it, fall back to strict
+        insp = SyncStallInspector(
             client, st.rank,
             warn_s=cfg.stall_check_time_seconds,
             abort_s=cfg.stall_shutdown_time_seconds,
             generation=st.init_generation,
         )
-        st.sync_stall = inspector
-    elif inspector is False:
+    else:
+        insp = AmortizedStallInspector(
+            client, st.rank,
+            warn_s=cfg.stall_check_time_seconds,
+            abort_s=cfg.stall_shutdown_time_seconds,
+            heartbeat_s=getattr(cfg, "stall_heartbeat_seconds", 0.5),
+            generation=st.init_generation,
+        )
+    st.sync_stall = insp
+    return insp
+
+
+def check(st, ps, desc: str) -> None:
+    """The eager ops' pre-dispatch hook: record the op (amortized) or
+    rendezvous with the other member ranks (strict), or no-op when
+    stall checking cannot or should not engage (single member,
+    controller thread, disabled, no coordination client).  Returns
+    the descriptor when an op was recorded (pass it to ``finish``),
+    else None."""
+    if ps.size <= 1 or getattr(_tls, "bypass", False):
+        return None
+    cfg = st.config
+    if cfg is None or cfg.stall_check_disable:
         return
-    members = ps.ranks if ps.ranks is not None else range(st.size)
-    inspector.rendezvous(ps.process_set_id, list(members), desc)
+    insp = st.sync_stall
+    if insp is None:
+        insp = _make_inspector(st, cfg)
+        if insp is None:
+            return
+    elif insp is False:
+        return
+    members = list(ps.ranks) if ps.ranks is not None else list(
+        range(st.size))
+    if isinstance(insp, AmortizedStallInspector):
+        return insp.pre_op(ps.process_set_id, members, desc)
+    insp.rendezvous(ps.process_set_id, members, desc)
+    return None
+
+
+def dispatch(st, ps, fn, args):
+    """The eager ops' execution hook (amortized mode).
+
+    A COLD executable's first execution can run inline on the
+    dispatching thread (observed on the CPU/Gloo backend), which would
+    park the main thread uninterruptibly inside a dead collective — so
+    cold calls run on the inspector's executor thread.  Once a call
+    returns while its result is still pending, the executable has
+    PROVEN its dispatch is asynchronous; subsequent calls skip the
+    executor (and its thread-handoff cost, a scheduler quantum per op
+    on core-contended hosts) because ``wait_ready`` already keeps the
+    main thread interruptible.  Direct call for strict/disabled modes
+    and the controller's bypass thread."""
+    insp = st.sync_stall
+    if (not isinstance(insp, AmortizedStallInspector)
+            or ps.size <= 1 or getattr(_tls, "bypass", False)):
+        return fn(*args)
+    if getattr(fn, "_hvt_async_proven", False):
+        if insp.failure:
+            raise HorovodInternalError(insp.failure)
+        return fn(*args)
+    out = insp.dispatch(ps.process_set_id, fn, args)
+    try:
+        if not out.is_ready():
+            # returned before the wire exchange finished: dispatch is
+            # asynchronous for this executable
+            fn._hvt_async_proven = True
+    except Exception:
+        pass
+    return out
+
+
+def finish(st, ps, out, desc: Optional[str] = None):
+    """The eager ops' post-dispatch hook (amortized mode only): wait
+    for the collective's result interruptibly so a stall or mismatch
+    detected by the heartbeat aborts with ``HorovodInternalError``
+    instead of parking inside an uninterruptible XLA wait.  ``desc``
+    (the value ``check`` returned) names the op for re-arm diagnosis.
+    Returns ``out`` unchanged; a no-op for strict/disabled modes
+    (strict already rendezvoused pre-dispatch) and for the
+    controller's bypass thread."""
+    insp = st.sync_stall
+    if (not isinstance(insp, AmortizedStallInspector)
+            or ps.size <= 1 or getattr(_tls, "bypass", False)):
+        return out
+    insp.wait_ready(ps.process_set_id, out, desc)
+    return out
+
+
+def stop(st) -> None:
+    """Shut down the inspector's background thread (called from
+    ``core.state.shutdown``)."""
+    insp = st.sync_stall
+    if isinstance(insp, AmortizedStallInspector):
+        try:
+            insp.stop()
+        except Exception:
+            pass
+    st.sync_stall = None

@@ -86,6 +86,12 @@ class Config:
     stall_check_disable: bool = False
     stall_check_time_seconds: float = 60.0
     stall_shutdown_time_seconds: float = 0.0  # 0 = never abort
+    # "amortized" (default: local bookkeeping + background heartbeat,
+    # ~zero per-op cost, detection within one heartbeat) | "strict"
+    # (pre-dispatch KV rendezvous per op: nothing dispatches until all
+    # members confirm the same descriptor, at one KV round-trip per op)
+    stall_check_mode: str = "amortized"
+    stall_heartbeat_seconds: float = 0.5
 
     # --- autotune ---
     autotune: bool = False
@@ -152,6 +158,10 @@ class Config:
             stall_check_time_seconds=_env_float("STALL_CHECK_TIME_SECONDS", 60.0),
             stall_shutdown_time_seconds=_env_float(
                 "STALL_SHUTDOWN_TIME_SECONDS", 0.0
+            ),
+            stall_check_mode=_env_str("STALL_CHECK_MODE", "amortized"),
+            stall_heartbeat_seconds=_env_float(
+                "STALL_HEARTBEAT_SECONDS", 0.5
             ),
             autotune=_env_bool("AUTOTUNE", False),
             autotune_log=_env_str("AUTOTUNE_LOG"),

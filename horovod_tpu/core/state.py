@@ -246,12 +246,32 @@ def shutdown():
         _state.autotuner = None
         if _state.distributed_initialized_by_us:
             try:
-                jax.distributed.shutdown()
+                from ..comm.stall import poisoned as _stall_poisoned
+
+                if _stall_poisoned():
+                    # The shutdown barrier rides the coordination gRPC
+                    # channel (independent of the wedged XLA
+                    # execution), and joining it lets still-healthy
+                    # peers finish before the leader goes away — but a
+                    # peer that DIED mid-collective never arrives, so
+                    # bound the wait instead of parking for the full
+                    # coordination timeout.
+                    _t = threading.Thread(
+                        target=jax.distributed.shutdown, daemon=True)
+                    _t.start()
+                    _t.join(timeout=15.0)
+                else:
+                    jax.distributed.shutdown()
             except Exception:
                 pass
             _state.distributed_initialized_by_us = False
         _state.initialized = False
-        _state.sync_stall = None
+        try:
+            from ..comm import stall as _stall
+
+            _stall.stop(_state)
+        except Exception:
+            _state.sync_stall = None
         _state.config = None
         _state.topology = None
         _state.process_set_table = None
@@ -264,6 +284,24 @@ def _shutdown_at_exit():
     try:
         shutdown()
     except Exception:
+        pass
+    try:
+        from ..comm.stall import poison_exit_status, poisoned
+
+        if poisoned():
+            # Interpreter teardown would park on the stuck collective
+            # (XLA client destructor joins pending executions).  All
+            # atexit work is done by now — hard-exit like the
+            # reference's stall shutdown does.  Status 0 if the
+            # process re-initialized past the poisoned generation
+            # (elastic recovery succeeded), 1 otherwise.
+            import os as _os
+            import sys as _sys
+
+            _sys.stdout.flush()
+            _sys.stderr.flush()
+            _os._exit(poison_exit_status())
+    except ImportError:
         pass
 
 

@@ -54,6 +54,29 @@ def main(fn_path: str, out_dir: str) -> int:
     with open(tmp, "wb") as f:
         f.write(signed)
     os.replace(tmp, result_path)
+    try:
+        from ..comm.stall import poisoned
+
+        if poisoned():
+            # The stall watchdog abandoned a pending collective: the
+            # XLA runtime holds a thread parked inside it, so normal
+            # interpreter teardown would hang at the client
+            # destructor.  Run the orderly shutdown first (its
+            # coordination barrier lets still-healthy peers finish
+            # before the leader goes away), then hard-exit past the
+            # destructor with the honest status — the result file is
+            # already durably delivered.
+            from ..core import state as _core_state
+
+            try:
+                _core_state.shutdown()
+            except Exception:
+                pass
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(code)
+    except ImportError:
+        pass
     return code
 
 

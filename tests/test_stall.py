@@ -18,7 +18,10 @@ import pytest
 
 import horovod_tpu
 from horovod_tpu.core.exceptions import HorovodInternalError
-from horovod_tpu.comm.stall import SyncStallInspector
+from horovod_tpu.comm.stall import (
+    AmortizedStallInspector,
+    SyncStallInspector,
+)
 from horovod_tpu.runner import run
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(horovod_tpu.__file__))
@@ -135,6 +138,197 @@ class TestInspectorUnit:
         insp.rendezvous(0, [0, 1], "new-op")
 
 
+class _NeverReady:
+    """Stands in for a jax.Array whose collective never completes."""
+
+    def is_ready(self):
+        return False
+
+
+class _Ready:
+    def is_ready(self):
+        return True
+
+
+class TestAmortizedInspectorUnit:
+    """The default mode: local bookkeeping + background heartbeat.
+    Per-op cost must be RPC-free; detection happens within a beat."""
+
+    def _make(self, kv, rank, warn_s=0.05, abort_s=0.0, hb=0.03):
+        return AmortizedStallInspector(
+            kv, rank, warn_s=warn_s, abort_s=abort_s,
+            heartbeat_s=hb, generation=1)
+
+    def test_healthy_path_stays_clean(self):
+        kv = FakeKV()
+        a, b = self._make(kv, 0), self._make(kv, 1)
+        try:
+            for i in range(5):
+                a.pre_op(0, [0, 1], f"allreduce:t{i}")
+                a.wait_ready(0, _Ready())
+                b.pre_op(0, [0, 1], f"allreduce:t{i}")
+                b.wait_ready(0, _Ready())
+            time.sleep(0.2)  # several beats
+            assert a.failure is None and b.failure is None
+        finally:
+            a.stop(); b.stop()
+
+    def test_pre_op_is_rpc_free(self):
+        """The hot path must not touch the KV: 10k ops through a KV
+        whose set/get explode must neither fail nor take RPC time."""
+
+        class ExplodingKV(FakeKV):
+            def key_value_set(self, k, v):
+                raise AssertionError("hot path hit the KV")
+
+            key_value_dir_get = property(
+                lambda self: (_ for _ in ()).throw(AssertionError))
+
+        insp = AmortizedStallInspector(
+            ExplodingKV(), 0, warn_s=60, abort_s=0,
+            heartbeat_s=30.0, generation=1)  # beat never fires
+        try:
+            t0 = time.monotonic()
+            for i in range(10_000):
+                insp.pre_op(0, [0, 1], "allreduce:x")
+                insp.wait_ready(0, _Ready())
+            dt = time.monotonic() - t0
+            # ~1 µs/op bookkeeping; 50 ms budget leaves 100x headroom
+            assert dt < 0.5, f"hot path too slow: {dt:.3f}s / 10k ops"
+        finally:
+            insp.stop()
+
+    def test_mismatch_diagnosed_within_a_beat(self):
+        kv = FakeKV()
+        a, b = self._make(kv, 0), self._make(kv, 1)
+        try:
+            a.pre_op(0, [0, 1], "allreduce:grad:(2,):float32")
+            b.pre_op(0, [0, 1], "broadcast:weights:(2,):float32")
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline and not (
+                    a.failure and b.failure):
+                time.sleep(0.02)
+            for insp, mine, theirs in (
+                    (a, "allreduce:grad", "broadcast:weights"),
+                    (b, "broadcast:weights", "allreduce:grad")):
+                msg = insp.failure or ""
+                assert "diverged" in msg
+                # BOTH tensor names appear in the diagnosis
+                assert mine in msg and theirs in msg
+        finally:
+            a.stop(); b.stop()
+
+    def test_stall_abort_names_missing_ranks(self):
+        kv = FakeKV()
+        a = self._make(kv, 0, warn_s=0.05, abort_s=0.25)
+        b = self._make(kv, 1, warn_s=0.05, abort_s=0.25)  # posts beats,
+        try:                                              # runs no ops
+            a.pre_op(0, [0, 1], "allreduce:loss:(4,):float32")
+            with pytest.raises(HorovodInternalError) as ei:
+                a.wait_ready(0, _NeverReady())
+            msg = str(ei.value)
+            assert "stalled collective" in msg
+            assert "allreduce:loss" in msg
+            assert "[1]" in msg  # the absent rank, by name
+        finally:
+            a.stop(); b.stop()
+
+    def test_wait_ready_raises_after_peer_failure(self):
+        """A rank blocked in a healthy-looking wait must still abort
+        when a PEER latches a failure (shutdown-on-stall semantics)."""
+        kv = FakeKV()
+        a = self._make(kv, 0, hb=0.03)
+        b = self._make(kv, 1, hb=0.03)
+        try:
+            with a._lock:
+                a.failure = "synthetic failure on rank 0"
+            with pytest.raises(HorovodInternalError, match="rank 0"):
+                deadline = time.monotonic() + 2.0
+                while time.monotonic() < deadline:
+                    b.pre_op(0, [0, 1], "allreduce:x")
+                    b.wait_ready(0, _Ready())
+                    time.sleep(0.02)
+                pytest.fail("peer failure never propagated")
+        finally:
+            a.stop(); b.stop()
+
+    def test_dead_peer_mid_collective_detected_via_staleness(self):
+        """A peer that posts a caught-up heartbeat and THEN dies (mid
+        wire-exchange) must still be diagnosed: its beat number stops
+        advancing, so staleness marks it absent even though its last
+        snapshot showed seq parity."""
+        kv = FakeKV()
+        a = AmortizedStallInspector(
+            kv, 0, warn_s=0.1, abort_s=0.6, heartbeat_s=0.03,
+            generation=1, stale_s=0.2)
+        b = AmortizedStallInspector(
+            kv, 1, warn_s=0.1, abort_s=0.6, heartbeat_s=0.03,
+            generation=1, stale_s=0.2)
+        try:
+            # both ranks dispatch the same op (seq parity)...
+            a.pre_op(0, [0, 1], "allreduce:w:(8,):float32")
+            b.pre_op(0, [0, 1], "allreduce:w:(8,):float32")
+            time.sleep(0.1)  # both post caught-up beats
+            # ...then rank 1 dies mid-collective: beats stop, but its
+            # last posted snapshot stays in the KV forever
+            b._stopped.set()
+            with pytest.raises(HorovodInternalError) as ei:
+                a.wait_ready(0, _NeverReady())
+            msg = str(ei.value)
+            assert "stalled collective" in msg and "[1]" in msg
+        finally:
+            a.stop(); b.stop()
+
+    def test_rearm_names_outer_op_and_keeps_its_clock(self):
+        """After a nested negotiation clears the in-flight marker, the
+        outer wait re-arms under the OUTER op's descriptor and its
+        original start time — not the nested op's."""
+        kv = FakeKV()
+        insp = AmortizedStallInspector(
+            kv, 0, warn_s=60, abort_s=0, heartbeat_s=30.0, generation=1)
+        try:
+            outer = insp.pre_op(0, [0, 1], "alltoall:x:(4,):float32")
+            t_outer = insp._tracks["0"].t0
+            time.sleep(0.02)
+            insp.pre_op(0, [0, 1], "allgather:splits:(2,):int32")
+            insp.wait_ready(0, _Ready())  # nested finish clears marker
+            assert insp._tracks["0"].inflight is None
+
+            # outer finish: briefly pending, then ready
+            class _ReadyAfter:
+                n = 0
+
+                def is_ready(self):
+                    self.n += 1
+                    if self.n == 1:
+                        tr = insp._tracks["0"]
+                        assert tr.inflight == "alltoall:x:(4,):float32"
+                        assert tr.t0 == t_outer
+                    return self.n > 1
+
+            insp.wait_ready(0, _ReadyAfter(), outer)
+            assert insp._tracks["0"].inflight is None
+        finally:
+            insp.stop()
+
+    def test_slow_collective_everyone_present_no_warn(self, caplog):
+        """Both ranks dispatched the op (seq caught up): a long wait is
+        a slow collective, not a stall — no warning."""
+        kv = FakeKV()
+        a = self._make(kv, 0, warn_s=0.05, abort_s=0.0)
+        b = self._make(kv, 1, warn_s=0.05, abort_s=0.0)
+        try:
+            a.pre_op(0, [0, 1], "allreduce:big")
+            b.pre_op(0, [0, 1], "allreduce:big")
+            with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+                time.sleep(0.3)
+            assert a.failure is None and b.failure is None
+            assert not [r for r in caplog.records
+                        if "stalled" in r.getMessage()]
+        finally:
+            a.stop(); b.stop()
+
+
 pytestmark_integration = pytest.mark.multiprocess
 
 
@@ -186,7 +380,57 @@ def test_skipped_collective_aborts_cleanly_2proc():
 @pytest.mark.multiprocess
 def test_diverged_collectives_diagnosed_2proc():
     """Ranks entering DIFFERENT collectives at the same point must get
-    the mismatch diagnosis on both sides, immediately."""
+    the mismatch diagnosis within one heartbeat (amortized mode: the
+    doomed op may dispatch — even complete — but the very next
+    heartbeat latches the divergence and the job aborts with both op
+    names instead of silently desyncing)."""
+
+    def body():
+        import time as _t
+
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvt
+        from horovod_tpu.core.exceptions import HorovodInternalError
+
+        hvt.init()
+        r = hvt.rank()
+        try:
+            # the divergence: same step, different collectives
+            if r == 0:
+                hvt.allreduce(jnp.ones((2,)), op=hvt.Sum, name="grads")
+            else:
+                hvt.broadcast(jnp.ones((2,)), root_rank=0, name="weights")
+            # a real training loop keeps stepping — the watchdog must
+            # kill it within ~a heartbeat, not let it run corrupted
+            deadline = _t.monotonic() + 8.0
+            while _t.monotonic() < deadline:
+                hvt.allreduce(jnp.ones(()), op=hvt.Sum)
+                _t.sleep(0.1)
+        except HorovodInternalError as e:
+            return ("mismatch", str(e))
+        return ("no-error", None)
+
+    results = run(
+        body, np=2, cpu_devices=1, env={
+            **_ENV,
+            "HVTPU_STALL_CHECK_TIME_SECONDS": "1",
+            "HVTPU_STALL_SHUTDOWN_TIME_SECONDS": "10",
+            "HVTPU_STALL_HEARTBEAT_SECONDS": "0.2",
+        }, start_timeout=300.0, timeout=600.0)
+    assert any(s == "mismatch" for s, _ in results), results
+    for s, msg in results:
+        if s == "mismatch":
+            assert "diverged" in msg
+            # the diagnosis names the diverged ops by tensor name
+            assert "grads" in msg and "weights" in msg, msg
+
+
+@pytest.mark.multiprocess
+def test_diverged_strict_mode_immediate_2proc():
+    """HVTPU_STALL_CHECK_MODE=strict restores the pre-dispatch
+    rendezvous: a mismatched collective is diagnosed BEFORE anything
+    dispatches, on the first offending op."""
 
     def body():
         import jax.numpy as jnp
@@ -208,6 +452,7 @@ def test_diverged_collectives_diagnosed_2proc():
     results = run(
         body, np=2, cpu_devices=1, env={
             **_ENV,
+            "HVTPU_STALL_CHECK_MODE": "strict",
             "HVTPU_STALL_CHECK_TIME_SECONDS": "1",
             "HVTPU_STALL_SHUTDOWN_TIME_SECONDS": "10",
         }, start_timeout=300.0, timeout=600.0)
