@@ -35,6 +35,7 @@ from .api.handles import manager as _handle_manager
 from .comm import eager as _eager
 from .comm import spmd
 from .comm.compression import Compression
+from .comm.stall import stall_guard  # noqa: F401  (jit-plane watchdog)
 from .comm.reduce_ops import Adasum, Average, Max, Min, Product, ReduceOp, Sum
 from .core import (
     Config,

@@ -346,6 +346,18 @@ class AmortizedStallInspector:
                 target=self._exec_loop, name="hvt-stall-dispatch",
                 daemon=True)
             self._exec_thread.start()
+        with self._lock:
+            tr = self._tracks.get(str(set_id))
+            if tr is not None and tr.inflight is None and tr.ring:
+                # a nested negotiation collective (allgather's size
+                # exchange) cleared the marker before the MAIN wire
+                # exchange dispatches: re-arm it so a peer dying in
+                # the gap is still diagnosed while we wait on the
+                # executor (mirrors wait_ready's re-arm)
+                entry = tr.ring[-1]
+                tr.inflight = entry[1]
+                tr.t0 = entry[2]
+                tr.next_warn = self.warn_s
         box = [threading.Event(), None, None]  # done, value, error
         self._exec_q.put((box, fn, args))
         while not box[0].wait(0.05):
@@ -433,6 +445,16 @@ class AmortizedStallInspector:
             self._exec_q.put(None)
             self._exec_thread.join(timeout=2.0)
         self._thread.join(timeout=2.0)
+        # a goodbye tombstone, NOT a plain delete: peers must be able
+        # to tell a clean exit (don't blame this rank for a stall —
+        # e.g. a stall_guard(block=False) marker legitimately left
+        # armed after the final step) from a death (do)
+        try:
+            self._kv.key_value_set(
+                f"{_HB}/{self.gen}/{self.rank}/{self._beat}",
+                json.dumps({"bye": True, "sets": {}}))
+        except Exception:
+            pass
         for b in (self._beat - 1, self._beat - 2):
             if b >= 0:
                 try:
@@ -495,19 +517,26 @@ class AmortizedStallInspector:
             prev = self._peer_seen.get(r)
             if prev is None or b != prev[0]:
                 self._peer_seen[r] = (b, now)
-        stale = {r for r, (_b, t) in self._peer_seen.items()
-                 if now - t > self.stale_s}
         peers: Dict[int, dict] = {}
+        bye = set()
         for r, (_b, v) in latest.items():
             try:
-                peers[r] = json.loads(v)
+                snap = json.loads(v)
             except Exception:
-                pass
-        self._evaluate(peers, stale)
+                continue
+            if snap.get("bye"):
+                bye.add(r)
+            else:
+                peers[r] = snap
+        stale = {r for r, (_b, t) in self._peer_seen.items()
+                 if r not in bye and now - t > self.stale_s}
+        self._evaluate(peers, stale, bye)
 
     def _evaluate(self, peers: Dict[int, dict],
-                  stale: Optional[set] = None) -> None:
+                  stale: Optional[set] = None,
+                  bye: Optional[set] = None) -> None:
         stale = stale or set()
+        bye = bye or set()
         now = time.monotonic()
         fail: Optional[str] = None
         warns: List[tuple] = []
@@ -554,7 +583,10 @@ class AmortizedStallInspector:
                         continue
                     behind = []
                     for r in tr.members:
-                        if r == self.rank:
+                        if r == self.rank or r in bye:
+                            # a cleanly-exited rank is never blamed
+                            # for a stall (false-positive guard for
+                            # markers legitimately armed at exit)
                             continue
                         snap = peers.get(r)
                         pseq = 0
@@ -660,8 +692,24 @@ def check(st, ps, desc: str) -> None:
     return None
 
 
-def dispatch(st, ps, fn, args):
-    """The eager ops' execution hook (amortized mode).
+def _pending_leaf(out) -> bool:
+    """True when any array in ``out`` is still pending — i.e. the call
+    returned BEFORE the wire exchange finished, proving asynchronous
+    dispatch for its executable."""
+    try:
+        import jax as _jax
+
+        for leaf in _jax.tree_util.tree_leaves(out):
+            ir = getattr(leaf, "is_ready", None)
+            if ir is not None and not ir():
+                return True
+    except Exception:
+        pass
+    return False
+
+
+def dispatch(st, ps, fn, args, owner=None, set_id=None):
+    """The guarded execution hook (amortized mode).
 
     A COLD executable's first execution can run inline on the
     dispatching thread (observed on the CPU/Gloo backend), which would
@@ -671,24 +719,26 @@ def dispatch(st, ps, fn, args):
     PROVEN its dispatch is asynchronous; subsequent calls skip the
     executor (and its thread-handoff cost, a scheduler quantum per op
     on core-contended hosts) because ``wait_ready`` already keeps the
-    main thread interruptible.  Direct call for strict/disabled modes
-    and the controller's bypass thread."""
+    main thread interruptible.  ``owner`` is the stable callable to
+    carry the proof (defaults to ``fn``; pass it when ``fn`` is a
+    per-call closure).  Direct call for strict/disabled modes and the
+    controller's bypass thread."""
     insp = st.sync_stall
     if (not isinstance(insp, AmortizedStallInspector)
             or ps.size <= 1 or getattr(_tls, "bypass", False)):
         return fn(*args)
-    if getattr(fn, "_hvt_async_proven", False):
+    owner = owner if owner is not None else fn
+    if getattr(owner, "_hvt_async_proven", False):
         if insp.failure:
             raise HorovodInternalError(insp.failure)
         return fn(*args)
-    out = insp.dispatch(ps.process_set_id, fn, args)
-    try:
-        if not out.is_ready():
-            # returned before the wire exchange finished: dispatch is
-            # asynchronous for this executable
-            fn._hvt_async_proven = True
-    except Exception:
-        pass
+    out = insp.dispatch(
+        ps.process_set_id if set_id is None else set_id, fn, args)
+    if _pending_leaf(out):
+        try:
+            owner._hvt_async_proven = True
+        except Exception:
+            pass
     return out
 
 
@@ -707,6 +757,114 @@ def finish(st, ps, out, desc: Optional[str] = None):
         return out
     insp.wait_ready(ps.process_set_id, out, desc)
     return out
+
+
+def stall_guard(fn=None, *, name: Optional[str] = None,
+                process_set=None, block: bool = True):
+    """Opt-in stall coverage for the JIT/SPMD plane (SURVEY §5.2).
+
+    The collectives INSIDE a user's jitted step cannot be intercepted
+    — once every rank has dispatched the step, XLA's schedule is the
+    coordination.  What can diverge is the DISPATCH boundary: one
+    process stops stepping (crash loop, diverged step count, data
+    exhaustion without ``join``) and every other process hangs inside
+    an uninterruptible XLA collective with no diagnostic.  Wrapping
+    the step function closes exactly that gap with the same machinery
+    as the sync eager watchdog:
+
+    - each call records a step mark on a guard-private channel
+      (amortized mode: local bookkeeping + the existing heartbeat;
+      strict mode: one pre-dispatch KV rendezvous per STEP — cheap at
+      step granularity);
+    - a rank that stops stepping is diagnosed by name after
+      ``stall_check_time_seconds``, and the survivors raise
+      ``HorovodInternalError`` after ``stall_shutdown_time_seconds``
+      instead of hanging — from the interruptible completion wait
+      (``block=True``, default: the wrapper polls the step outputs'
+      ``is_ready`` and returns completed arrays) or from the next
+      call's pre-dispatch check (``block=False``: keeps JAX's async
+      dispatch pipelining; detection then needs the loop to come back
+      for another step or to consume an output).
+    - two ranks calling DIFFERENTLY-NAMED guarded steps at the same
+      point are diagnosed as diverged.
+
+    Usable as a decorator or a wrapper::
+
+        step = hvt.stall_guard(jax.jit(train_step))
+        # or
+        @hvt.stall_guard(name="train")
+        @jax.jit
+        def train_step(...): ...
+
+    No-op (plain passthrough) before ``init()``, at world size 1, when
+    stall checking is disabled, and on the controller's bypass thread.
+    Parity: ``horovod/common/stall_inspector.cc`` (StallInspector) —
+    the reference's coordinator sees every op because every op is
+    negotiated; here the jit plane negotiates nothing at runtime, so
+    coverage is opt-in at the step boundary.
+    """
+    if fn is None:
+        return lambda f: stall_guard(f, name=name,
+                                     process_set=process_set,
+                                     block=block)
+    import functools
+
+    import jax as _jax
+
+    gname = name or getattr(fn, "__name__", None) or "step"
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        from ..core import state as core_state
+
+        st = core_state.global_state()
+        if not st.initialized:
+            return fn(*args, **kwargs)
+        if process_set is None:
+            ps = st.process_set_table.global_process_set
+        elif isinstance(process_set, int):
+            ps = st.process_set_table.get(process_set)
+        else:
+            ps = process_set
+        if ps.size <= 1 or getattr(_tls, "bypass", False):
+            return fn(*args, **kwargs)
+        cfg = st.config
+        if cfg is None or cfg.stall_check_disable:
+            return fn(*args, **kwargs)
+        insp = st.sync_stall
+        if insp is None:
+            insp = _make_inspector(st, cfg)
+        if insp is None or insp is False:
+            return fn(*args, **kwargs)
+        # one channel per process set — NOT per guard name: ranks
+        # calling differently-named guarded steps at the same point
+        # must share a sequence channel so the ring comparison can
+        # diagnose them as diverged (the name lives in the descriptor)
+        sid = f"jit.{ps.process_set_id}"
+        desc = f"jit_step:{gname}"
+        members = list(ps.ranks) if ps.ranks is not None else list(
+            range(st.size))
+        if isinstance(insp, AmortizedStallInspector):
+            insp.pre_op(sid, members, desc)
+            # the step dispatches via the cold-executor / async-proven
+            # machinery: a step whose execution runs inline on the
+            # dispatching thread must not wedge the main thread
+            call = fn if not kwargs else (
+                lambda *a: fn(*a, **kwargs))
+            out = dispatch(st, ps, call, args, owner=wrapped,
+                           set_id=sid)
+            if block:
+                for leaf in _jax.tree_util.tree_leaves(out):
+                    insp.wait_ready(sid, leaf, desc)
+            # block=False: the marker stays armed (async dispatch
+            # preserved; a peer that stops stepping is still
+            # diagnosed because its counter falls behind, and clean
+            # exits are excluded via the goodbye tombstone)
+            return out
+        insp.rendezvous(sid, members, desc)
+        return fn(*args, **kwargs)
+
+    return wrapped
 
 
 def stop(st) -> None:

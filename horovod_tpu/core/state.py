@@ -244,6 +244,15 @@ def shutdown():
                 pass
             _state.timeline = None
         _state.autotuner = None
+        # The stall inspector's stop posts a goodbye tombstone over the
+        # coordination KV (so still-running peers don't blame this
+        # rank for a stall) — it must run BEFORE the client goes away.
+        try:
+            from ..comm import stall as _stall
+
+            _stall.stop(_state)
+        except Exception:
+            _state.sync_stall = None
         if _state.distributed_initialized_by_us:
             try:
                 from ..comm.stall import poisoned as _stall_poisoned
@@ -266,12 +275,7 @@ def shutdown():
                 pass
             _state.distributed_initialized_by_us = False
         _state.initialized = False
-        try:
-            from ..comm import stall as _stall
-
-            _stall.stop(_state)
-        except Exception:
-            _state.sync_stall = None
+        _state.sync_stall = None
         _state.config = None
         _state.topology = None
         _state.process_set_table = None
