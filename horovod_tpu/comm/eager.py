@@ -648,7 +648,7 @@ def allreduce(
                         stacked,
                         jnp.asarray(prescale_factor, jnp.float32),
                         jnp.asarray(postscale_factor, jnp.float32),
-                    ))
+                    ), desc=sdesc)
             )
             if postprocess is not None:
                 out = postprocess(out)
@@ -751,12 +751,14 @@ def allgather(tensor, *, process_set=None, name: Optional[str] = None):
     if md is not None:
         stacked, flat_size = _stack_global_multidev(padded, md)
         out = _fetch(stall.dispatch(
-            st, ps, _jitted("allgather_multidev", md, ()), (stacked,)))
+            st, ps, _jitted("allgather_multidev", md, ()), (stacked,),
+            desc=sdesc))
         gathered = out[:, :flat_size].reshape((p,) + padded.shape)
     else:
         stacked = _stack_global(padded, mesh)
         gathered = _fetch(stall.dispatch(
-            st, ps, _jitted("allgather", mesh, ()), (stacked,)))
+            st, ps, _jitted("allgather", mesh, ()), (stacked,),
+            desc=sdesc))
     # gathered: (P, maxd, ...); trim each rank's block to its size.
     if all(int(s) == maxd for s in sizes):
         out = gathered.reshape((p * maxd,) + gathered.shape[2:])
@@ -791,12 +793,13 @@ def broadcast(tensor, *, root_rank: int = 0, process_set=None,
         stacked, flat_size = _stack_global_multidev(x, md)
         out = _fetch(stall.dispatch(
             st, ps, _jitted("broadcast_multidev", md, (root_in_set,)),
-            (stacked,)))
+            (stacked,), desc=sdesc))
         return stall.finish(st, ps, out[:flat_size].reshape(x.shape),
                             sdesc)
     stacked = _stack_global(x, mesh)
     out = stall.dispatch(
-        st, ps, _jitted("broadcast", mesh, (root_in_set,)), (stacked,))
+        st, ps, _jitted("broadcast", mesh, (root_in_set,)), (stacked,),
+        desc=sdesc)
     return stall.finish(st, ps, _fetch(out), sdesc)
 
 
@@ -855,14 +858,16 @@ def alltoall(tensor, splits=None, *, process_set=None,
     if md is not None:
         stacked, inner = _stack_global_multidev_rows(send, p, md)
         got = _fetch(stall.dispatch(
-            st, ps, _jitted("alltoall_multidev", md, ()), (stacked,)))[0]
+            st, ps, _jitted("alltoall_multidev", md, ()), (stacked,),
+            desc=sdesc))[0]
         out = got[:, :inner].reshape((p, max_chunk) + x.shape[1:])
     else:
         stacked = _stack_global(send, mesh)
         # local shard of the (P, P, max_chunk, ...) output:
         # (1, P, max_chunk, ...)
         out = _fetch(stall.dispatch(
-            st, ps, _jitted("alltoall", mesh, ()), (stacked,)))[0]
+            st, ps, _jitted("alltoall", mesh, ()), (stacked,),
+            desc=sdesc))[0]
     parts = [out[r, : int(recv_splits[r])] for r in range(p)]
     result = stall.finish(st, ps, jnp.concatenate(parts, axis=0), sdesc)
     return (result, jnp.asarray(recv_splits)) if return_splits else result
@@ -901,13 +906,14 @@ def reducescatter(tensor, *, op=None, process_set=None,
             stacked, inner = _stack_global_multidev_rows(x, p, md)
             out = _fetch(stall.dispatch(
                 st, ps, _jitted("reducescatter_multidev", md, (rop,)),
-                (stacked,)))
+                (stacked,), desc=sdesc))
             return stall.finish(
                 st, ps, out[0][:inner].reshape((q,) + x.shape[1:]), sdesc)
         mesh = ps.proc_mesh()
         stacked = _stack_global(x, mesh)
         out = _fetch(stall.dispatch(
-            st, ps, _jitted("reducescatter", mesh, (rop,)), (stacked,)))[0]
+            st, ps, _jitted("reducescatter", mesh, (rop,)), (stacked,),
+            desc=sdesc))[0]
         return stall.finish(st, ps, out, sdesc)
     reduced = allreduce(x, op=rop, process_set=ps)
     r = ps.rank_in_set(st.rank)

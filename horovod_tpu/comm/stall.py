@@ -313,9 +313,11 @@ class AmortizedStallInspector:
         self._thread.start()
 
     # -- data-plane hooks (hot path: no RPCs) --------------------------
-    def pre_op(self, set_id, members, desc: str) -> None:
-        """Record the op start; raise a latched failure cleanly before
-        dispatching another doomed collective."""
+    def pre_op(self, set_id, members, desc: str) -> str:
+        """Record the op start and return the descriptor (callers
+        thread it to ``finish``/``wait_ready`` for re-arm naming);
+        raise a latched failure cleanly before dispatching another
+        doomed collective."""
         with self._lock:
             if self.failure:
                 raise HorovodInternalError(self.failure)
@@ -331,13 +333,39 @@ class AmortizedStallInspector:
             tr.seq += 1
         return desc
 
-    def dispatch(self, set_id, fn, args):
+    def _rearm(self, set_id, desc: Optional[str]) -> None:
+        """Re-arm the in-flight marker after a nested negotiation
+        collective cleared it — under the OUTER op's descriptor and
+        ORIGINAL start time (found in the ring), so a stall during
+        the main wire exchange is diagnosed as the op the user
+        called, with its true age."""
+        with self._lock:
+            tr = self._tracks.get(str(set_id))
+            if tr is None or tr.inflight is not None or not tr.ring:
+                return
+            entry = None
+            if desc is not None:
+                for e in reversed(tr.ring):
+                    if e[1] == desc:
+                        entry = e
+                        break
+            if entry is None:
+                entry = tr.ring[-1]
+            tr.inflight = entry[1]
+            tr.t0 = entry[2]
+            tr.next_warn = self.warn_s
+
+    def dispatch(self, set_id, fn, args, desc: Optional[str] = None):
         """Run ``fn(*args)`` (a compiled collective) on the executor
         thread; wait interruptibly so a latched failure aborts this
         rank even when the backend executes synchronously on the
         dispatching thread.  On abort the executor stays parked inside
         the dead collective — the process is poisoned (see
-        ``poisoned()``) and exit paths hard-exit."""
+        ``poisoned()``) and exit paths hard-exit.  Returns
+        ``(result, pending)`` where ``pending`` is True when the
+        result was still in flight the moment ``fn`` returned
+        (sampled on the executor thread, before handoff latency can
+        hide it) — the caller's async-dispatch proof."""
         with self._lock:
             if self.failure:
                 raise HorovodInternalError(self.failure)
@@ -346,19 +374,9 @@ class AmortizedStallInspector:
                 target=self._exec_loop, name="hvt-stall-dispatch",
                 daemon=True)
             self._exec_thread.start()
-        with self._lock:
-            tr = self._tracks.get(str(set_id))
-            if tr is not None and tr.inflight is None and tr.ring:
-                # a nested negotiation collective (allgather's size
-                # exchange) cleared the marker before the MAIN wire
-                # exchange dispatches: re-arm it so a peer dying in
-                # the gap is still diagnosed while we wait on the
-                # executor (mirrors wait_ready's re-arm)
-                entry = tr.ring[-1]
-                tr.inflight = entry[1]
-                tr.t0 = entry[2]
-                tr.next_warn = self.warn_s
-        box = [threading.Event(), None, None]  # done, value, error
+        self._rearm(set_id, desc)
+        # done, value, error, pending-at-return
+        box = [threading.Event(), None, None, False]
         self._exec_q.put((box, fn, args))
         while not box[0].wait(0.05):
             if self.failure:
@@ -370,7 +388,7 @@ class AmortizedStallInspector:
                 raise HorovodInternalError(self.failure)
         if box[2] is not None:
             raise box[2]
-        return box[1]
+        return box[1], box[3]
 
     def _exec_loop(self) -> None:
         while True:
@@ -380,6 +398,9 @@ class AmortizedStallInspector:
             box, fn, args = item
             try:
                 box[1] = fn(*args)
+                # sample async-ness HERE, before handoff latency lets
+                # a fast collective finish and hide the evidence
+                box[3] = _pending_leaf(box[1])
             except BaseException as e:  # surfaced on the caller thread
                 box[2] = e
             finally:
@@ -393,25 +414,7 @@ class AmortizedStallInspector:
         names the op being waited on (for re-arming after a nested
         negotiation collective cleared the in-flight marker)."""
         is_ready = getattr(out, "is_ready", None)
-        with self._lock:
-            tr = self._tracks.get(str(set_id))
-            if tr is not None and tr.inflight is None and tr.ring:
-                # a nested negotiation collective (alltoall's split
-                # exchange rides a full allgather) cleared the marker;
-                # re-arm it — under the OUTER op's name and original
-                # start time, so a stall here is diagnosed as the op
-                # the user called, with its true age
-                entry = None
-                if desc is not None:
-                    for e in reversed(tr.ring):
-                        if e[1] == desc:
-                            entry = e
-                            break
-                if entry is None:
-                    entry = tr.ring[-1]
-                tr.inflight = entry[1]
-                tr.t0 = entry[2]
-                tr.next_warn = self.warn_s
+        self._rearm(set_id, desc)
         sleep = 0.0
         waited = 0.0
         while is_ready is not None and not is_ready():
@@ -665,7 +668,7 @@ def _make_inspector(st, cfg):
     return insp
 
 
-def check(st, ps, desc: str) -> None:
+def check(st, ps, desc: str) -> Optional[str]:
     """The eager ops' pre-dispatch hook: record the op (amortized) or
     rendezvous with the other member ranks (strict), or no-op when
     stall checking cannot or should not engage (single member,
@@ -692,6 +695,38 @@ def check(st, ps, desc: str) -> None:
     return None
 
 
+# Backend/transport failure markers: a peer that aborted or died
+# closes its Gloo/coordination sockets, and the surviving ranks'
+# collectives then fail with these rather than hanging.  The reference
+# maps such collective failures to HorovodError so elastic recovery
+# can catch them — mirror that (HorovodInternalError), and attach the
+# watchdog's diagnosis when it lands within a heartbeat.
+_TRANSPORT_MARKERS = (
+    "Connection closed by peer", "Socket closed", "Connection reset",
+    "connection reset", "Broken pipe", "Connection refused",
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "coordination service",
+)
+
+
+def _map_backend_error(insp, err):
+    """Re-raise ``err``; transport-shaped failures become
+    ``HorovodInternalError`` (recoverable, reference parity), carrying
+    the watchdog's diagnosis if one latches within ~a heartbeat."""
+    msg = str(err)
+    if not any(m in msg for m in _TRANSPORT_MARKERS):
+        raise err
+    deadline = time.monotonic() + 2 * getattr(insp, "heartbeat_s", 0.5)
+    while insp is not None and time.monotonic() < deadline:
+        if insp.failure:
+            raise HorovodInternalError(
+                f"{insp.failure} (surfaced via backend error: "
+                f"{msg})") from err
+        time.sleep(0.02)
+    raise HorovodInternalError(
+        f"collective transport failure (a peer likely aborted or "
+        f"died): {msg}") from err
+
+
 def _pending_leaf(out) -> bool:
     """True when any array in ``out`` is still pending — i.e. the call
     returned BEFORE the wire exchange finished, proving asynchronous
@@ -708,7 +743,7 @@ def _pending_leaf(out) -> bool:
     return False
 
 
-def dispatch(st, ps, fn, args, owner=None, set_id=None):
+def dispatch(st, ps, fn, args, owner=None, set_id=None, desc=None):
     """The guarded execution hook (amortized mode).
 
     A COLD executable's first execution can run inline on the
@@ -731,10 +766,21 @@ def dispatch(st, ps, fn, args, owner=None, set_id=None):
     if getattr(owner, "_hvt_async_proven", False):
         if insp.failure:
             raise HorovodInternalError(insp.failure)
-        return fn(*args)
-    out = insp.dispatch(
-        ps.process_set_id if set_id is None else set_id, fn, args)
-    if _pending_leaf(out):
+        try:
+            return fn(*args)
+        except HorovodInternalError:
+            raise
+        except Exception as e:
+            _map_backend_error(insp, e)
+    try:
+        out, pending = insp.dispatch(
+            ps.process_set_id if set_id is None else set_id, fn, args,
+            desc)
+    except HorovodInternalError:
+        raise
+    except Exception as e:
+        _map_backend_error(insp, e)
+    if pending:
         try:
             owner._hvt_async_proven = True
         except Exception:

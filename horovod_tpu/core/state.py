@@ -294,8 +294,19 @@ def _shutdown_at_exit():
 
         if poisoned():
             # Interpreter teardown would park on the stuck collective
-            # (XLA client destructor joins pending executions).  All
-            # atexit work is done by now — hard-exit like the
+            # (XLA client destructor joins pending executions) —
+            # hard-exit like the reference's stall shutdown does.
+            # Trade-off, stated out loud since os._exit skips
+            # anything registered before horovod_tpu's atexit hook
+            # (LIFO): those handlers are sacrificed to avoid a
+            # teardown that never finishes.
+            import logging as _logging
+
+            _logging.getLogger("horovod_tpu").critical(
+                "hard-exiting past a wedged collective abandoned by "
+                "the stall watchdog; atexit handlers registered "
+                "before horovod_tpu will not run")
+            # hard-exit like the
             # reference's stall shutdown does.  Status 0 if the
             # process re-initialized past the poisoned generation
             # (elastic recovery succeeded), 1 otherwise.

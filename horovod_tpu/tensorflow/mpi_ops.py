@@ -322,26 +322,33 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
     """Parity: hvd.alltoall — returns (output, received_splits) when
     splits is given, else just the output."""
     if splits is None:
-        def impl(x):
-            return _hvt.alltoall(
-                x, None, process_set=process_set, name=name
-            )
-
-        shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
-
-        # Parity: RegisterGradient('HorovodAlltoall') — the adjoint of
-        # an alltoall routes each gradient chunk back to its sender,
-        # which for equal splits is another equal alltoall.
-        @tf.custom_gradient
-        def _op(x):
-            y = _graph_op(impl, [x], x.dtype, shape)
-
-            def grad(dy):
-                return alltoall(dy, process_set=process_set)
-
-            return y, grad
-
-        return _op(tf.convert_to_tensor(tensor))
+        # Route through the explicit-splits path with an equal send
+        # vector so the backward can replay with the NEGOTIATED
+        # received splits (parity: HorovodAlltoall's gradient uses
+        # received_splits).  Replaying with equal splits instead would
+        # crash — or silently misroute gradient rows — whenever ranks
+        # contribute different dim-0 row counts (legal: the engine
+        # only requires each rank's dim0 % size == 0).
+        tensor = tf.convert_to_tensor(tensor)
+        p = _participant_count(process_set)
+        n = tensor.shape[0]
+        if n is not None and int(n) % p:
+            # the engine's error would blame a splits vector the user
+            # never passed — raise the no-splits contract directly
+            raise ValueError(
+                f"alltoall dim0 {int(n)} not divisible by size {p}")
+        dyn = tf.shape(tensor)[0]
+        if n is None:
+            # dynamic dim0 (tf.function with a [None] signature):
+            # assert the contract at runtime so the failure names this
+            # op, not a splits vector the user never passed
+            tf.debugging.assert_equal(
+                dyn % p, 0,
+                message=f"alltoall dim0 not divisible by size {p}")
+        eq = tf.fill([p], dyn // p)
+        out, _received = alltoall(
+            tensor, splits=eq, name=name, process_set=process_set)
+        return out
 
     def _forward(x, s):
         if tf.executing_eagerly():

@@ -328,6 +328,47 @@ def test_tf_op_matrix_alltoall_reducescatter_sparse_2proc():
         assert out["obj"] == {"w": [1, 2, 3], "rank": 0}
 
 
+@pytest.mark.multiprocess
+def test_tf_alltoall_no_splits_ragged_grad_2proc():
+    """Round-4 advisor finding: the no-splits alltoall gradient must
+    replay with the NEGOTIATED received splits.  With ranks
+    contributing different dim-0 row counts (legal: the engine only
+    requires dim0 % size == 0), replaying with equal splits either
+    crashes (received count not divisible) or routes gradient rows to
+    the wrong senders."""
+
+    def body():
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvd
+
+        hvd.init()
+        r = hvd.rank()
+        # rank 0 sends 4 rows (2 per peer), rank 1 sends 2 (1 per
+        # peer): received counts are 3 and 3 — but NOT 2+2/1+1, so an
+        # equal-splits replay would misroute or crash
+        n = 4 if r == 0 else 2
+        x = tf.range(float(n))
+        with tf.GradientTape() as t:
+            t.watch(x)
+            out = hvd.alltoall(x)  # splits=None path
+            # weight received rows by this rank's multiplier so the
+            # gradient identifies which rank each sent row reached
+            y = tf.reduce_sum(out * float(r + 1))
+        g = t.gradient(y, x)
+        return (r, int(out.shape[0]), g.numpy().tolist())
+
+    results = run(body, np=2, cpu_devices=1, env=_ENV,
+                  start_timeout=300.0)
+    by_rank = dict((r, (n, g)) for r, n, g in results)
+    # each rank receives 2 rows from rank 0 + 1 row from rank 1
+    assert by_rank[0][0] == 3 and by_rank[1][0] == 3
+    # rank 0's rows [0,1] went to rank 0 (x1), rows [2,3] to rank 1 (x2)
+    assert by_rank[0][1] == [1.0, 1.0, 2.0, 2.0]
+    # rank 1's row [0] went to rank 0 (x1), row [1] to rank 1 (x2)
+    assert by_rank[1][1] == [1.0, 2.0]
+
+
 def test_tf_graph_mode_fused_broadcast_2proc():
     """Graph-mode (tf.function) broadcast_variables across real
     processes: the fused per-dtype path must deliver rank-0 values to
