@@ -64,6 +64,38 @@ STEPS_PER_CALL = int(os.environ.get("HVTPU_BENCH_STEPS_PER_CALL", "0")) \
     or MODELS[MODEL][4]
 
 
+def check_regression_floor(model: str, value: float,
+                           repo_root: str) -> "str | None":
+    """Round-over-round floor guard (VERDICT r4 #4): every benchmarked
+    model's recorded img/s is a floor with a small tolerance — a
+    silent regression in any model's path fails the bench run instead
+    of drifting in the recorded tables.  Floors live in
+    BENCH_MODELS.json's ``bar.floors`` (the ResNet-50 north star is
+    additionally enforced against the A100 parity bar by the driver).
+    Returns an error string on regression, else None."""
+    path = os.path.join(repo_root, "BENCH_MODELS.json")
+    try:
+        with open(path) as f:
+            bar = json.load(f).get("bar", {})
+    except Exception:
+        return None
+    if not isinstance(bar, dict):
+        return None
+    floor = bar.get("floors", {}).get(model)
+    if floor is None:
+        return None
+    tol = float(bar.get("tolerance", 0.02))
+    if value < floor * (1.0 - tol):
+        return (
+            f"REGRESSION: {model} measured {value:.1f} img/s/chip, "
+            f"below the recorded floor {floor:.1f} - {tol:.0%} "
+            f"tolerance ({floor * (1 - tol):.1f}). A deliberate perf "
+            "change must update BENCH_MODELS.json bar.floors in the "
+            "same commit."
+        )
+    return None
+
+
 def main():
     hvt.init()
     mesh = hvt.world_mesh()
@@ -181,6 +213,9 @@ def main():
         round(img_per_sec_per_chip / A100_BASELINE_IMG_PER_SEC_PER_CHIP, 4)
         if MODEL == "resnet50" else None
     )
+    regression = check_regression_floor(
+        MODEL, img_per_sec_per_chip,
+        os.path.dirname(os.path.abspath(__file__)))
     print(
         json.dumps(
             {
@@ -214,6 +249,9 @@ def main():
             }
         )
     )
+    if regression is not None:
+        print(regression, file=sys.stderr)
+        sys.exit(2)
 
 
 if __name__ == "__main__":

@@ -602,9 +602,14 @@ def allreduce(
             st, ps,
             f"allreduce:{tname}:{tuple(x.shape)}:{x.dtype}:{rop.name}")
         if p == 1:
-            out = x * jnp.asarray(prescale_factor, x.dtype)
-            # averaging / sum over one participant is identity
-            out = out * jnp.asarray(postscale_factor, out.dtype)
+            # averaging / sum over one participant is identity; skip
+            # the scale passes entirely at factor 1.0 (each is a full
+            # extra memory pass on the single-rank fast path)
+            out = x
+            if prescale_factor != 1.0:
+                out = out * jnp.asarray(prescale_factor, out.dtype)
+            if postscale_factor != 1.0:
+                out = out * jnp.asarray(postscale_factor, out.dtype)
         else:
             # integer AVERAGE floor-divides per stage, which differs
             # from a single flat division — stays on the flat path.

@@ -1,22 +1,131 @@
-"""Ray integration surface, local-mode functional.
+"""Ray integration surface: actors when Ray is live, local fallback.
 
 Parity surface: ``horovod.ray.RayExecutor`` (horovod/ray/runner.py) —
 ``start()`` / ``run(fn)`` / ``run_remote``+``execute`` / ``shutdown``
-driving one Horovod rank per Ray worker.  Ray placement-group
-scheduling is out of scope for the TPU build (SURVEY.md §7.3: pods are
-launched by hvtpurun / the cluster scheduler); the same API is provided
-in **local mode**, launching ranks as local worker processes through
-the hvtpurun machinery — the reference's own CI exercises RayExecutor
-on a local Ray cluster the same way.
+driving one Horovod rank per Ray worker.
+
+Two execution paths, chosen at ``start()``:
+
+- **Ray actors** (parity: BaseHorovodWorker actors): when ``ray`` is
+  importable AND ``ray.is_initialized()``, each rank runs inside a Ray
+  actor (``num_cpus=cpus_per_worker``, ``num_gpus`` when asked).  The
+  driver gathers each actor's node IP, derives the rank/local/cross
+  topology by host exactly like the hvtpurun launcher does by
+  hostfile, points every rank at rank 0's node for the JAX
+  coordination service, and runs ``fn`` on all actors.  Placement
+  GROUPS stay out of scope (SURVEY.md §7.3) — actors are scheduled by
+  Ray's default scheduler.
+- **local mode** otherwise: ranks as local worker processes through
+  the hvtpurun machinery — the reference's own CI exercises
+  RayExecutor on a local Ray cluster the same way.
 """
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def _probe_ray():
+    """The live-Ray probe: a user with a real Ray cluster must get
+    actors, not silently subprocesses on the driver node."""
+    try:
+        import ray
+    except Exception:
+        return None
+    try:
+        if not ray.is_initialized():
+            return None
+    except Exception:
+        return None
+    return ray
+
+
+class _ActorWorker:
+    """One rank inside a Ray actor process (parity:
+    horovod/ray/runner.py BaseHorovodWorker)."""
+
+    def node_info(self):
+        """(node ip, a free port) — rank 0's answer seeds the JAX
+        coordination-service address for every rank."""
+        import socket
+
+        from ..runner.launch import find_free_port
+
+        try:
+            from ray.util import get_node_ip_address
+
+            ip = get_node_ip_address()
+        except Exception:
+            ip = socket.gethostbyname(socket.gethostname())
+        return ip, find_free_port(bind_addr="")
+
+    def setup(self, env: Dict[str, str]):
+        import os
+
+        os.environ.update(env)
+        return True
+
+    def execute(self, fn, args=(), kwargs=None):
+        return fn(*args, **(kwargs or {}))
+
+
+def _topology_envs(infos, env_vars=None,
+                   cpu_devices=None) -> List[Dict[str, str]]:
+    """Per-rank launcher-equivalent env from the actors' node IPs:
+    rank/size, local rank/size by host, cross rank/size per
+    LOCAL RANK across hosts (exactly hosts.py get_host_assignments'
+    derivation — the cross communicator is the set of hosts holding a
+    slot at this local rank), and the coordination-service address on
+    rank 0's node."""
+    size = len(infos)
+    ip0, port0 = infos[0]
+    hosts: List[str] = []
+    for ip, _p in infos:
+        if ip not in hosts:
+            hosts.append(ip)
+    by_host = {h: [r for r, (ip, _p) in enumerate(infos) if ip == h]
+               for h in hosts}
+    local_sizes = {len(v) for v in by_host.values()}
+    uniform = local_sizes.pop() if len(local_sizes) == 1 else 0
+    # cross layout per local_rank (mirrors runner/hosts.py): with a
+    # ragged 2+1 placement, local_rank 1 exists on one host only, so
+    # its cross communicator has size 1 — not the host count
+    by_local_rank: Dict[int, List[str]] = {}
+    for h in hosts:
+        for lr in range(len(by_host[h])):
+            by_local_rank.setdefault(lr, []).append(h)
+    envs = []
+    for rank, (ip, _p) in enumerate(infos):
+        locals_ = by_host[ip]
+        lr = locals_.index(rank)
+        cross_hosts = by_local_rank[lr]
+        env = {
+            "HVTPU_RANK": str(rank),
+            "HVTPU_SIZE": str(size),
+            "HVTPU_LOCAL_RANK": str(lr),
+            "HVTPU_LOCAL_SIZE": str(len(locals_)),
+            "HVTPU_CROSS_RANK": str(cross_hosts.index(ip)),
+            "HVTPU_CROSS_SIZE": str(len(cross_hosts)),
+            "HVTPU_UNIFORM_LOCAL_SIZE": str(uniform),
+            "HVTPU_COORDINATOR_ADDR": ip0,
+            "HVTPU_COORDINATOR_PORT": str(port0),
+        }
+        if cpu_devices is not None:
+            # same CPU-platform forcing the local launcher path
+            # applies (actors must not silently target a different
+            # backend than the fallback would)
+            env["HVTPU_CPU_DEVICES"] = str(cpu_devices)
+        env.update(env_vars or {})
+        envs.append(env)
+    return envs
 
 
 class RayExecutor:
-    """Local-mode executor with the reference's lifecycle shape.
+    """Executor with the reference's lifecycle shape: Ray actors when
+    a Ray cluster is live, local worker processes otherwise.
 
     >>> ex = RayExecutor(num_workers=2)
     >>> ex.start()
@@ -45,11 +154,39 @@ class RayExecutor:
         self.num_workers = num_workers or 2
         self.cpu_devices = cpu_devices
         self.env_vars = env_vars
+        self.use_gpu = use_gpu
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker
         self._started = False
+        self._ray = None
+        self._actors: Optional[list] = None
 
     def start(self):
-        """No cluster to warm up in local mode; validates state."""
+        """Probe for a live Ray cluster; create one actor per rank
+        when found (parity: RayExecutor.start creating
+        BaseHorovodWorker actors), else arm the local fallback."""
+        self._ray = _probe_ray()
+        if self._ray is not None:
+            self._start_actors()
+        else:
+            logger.info(
+                "RayExecutor: no initialized Ray cluster found; "
+                "running ranks as local worker processes")
         self._started = True
+
+    def _start_actors(self):
+        ray = self._ray
+        opts: Dict[str, Any] = {"num_cpus": self.cpus_per_worker}
+        if self.use_gpu:
+            opts["num_gpus"] = self.gpus_per_worker or 1
+        actor_cls = ray.remote(**opts)(_ActorWorker)
+        self._actors = [actor_cls.remote()
+                        for _ in range(self.num_workers)]
+        infos = ray.get(
+            [a.node_info.remote() for a in self._actors])
+        envs = _topology_envs(infos, self.env_vars, self.cpu_devices)
+        ray.get([a.setup.remote(e)
+                 for a, e in zip(self._actors, envs)])
 
     def run(self, fn: Callable, args: tuple = (),
             kwargs: Optional[Dict[str, Any]] = None) -> List[Any]:
@@ -57,6 +194,9 @@ class RayExecutor:
         rank (parity: RayExecutor.run)."""
         if not self._started:
             raise RuntimeError("RayExecutor.start() must be called first")
+        if self._actors is not None:
+            return self._ray.get(
+                self.run_remote(fn, args=args, kwargs=kwargs))
         from .. import runner
 
         return runner.run(
@@ -67,19 +207,33 @@ class RayExecutor:
     # reference API aliases
     def run_remote(self, fn: Callable, args: tuple = (),
                    kwargs: Optional[Dict[str, Any]] = None):
-        """Local mode executes eagerly; returns the results list (the
-        reference returns Ray ObjectRefs to pass to ``execute``)."""
+        """Actor mode returns Ray ObjectRefs to pass to ``execute``
+        (reference contract); local mode executes eagerly and returns
+        the results list."""
+        if self._actors is not None:
+            return [a.execute.remote(fn, args, kwargs)
+                    for a in self._actors]
         return self.run(fn, args=args, kwargs=kwargs)
 
     def execute(self, fn_or_results):
         """Reference shape: ``execute(fn)`` runs fn on every worker.
-        Also accepts the output of :meth:`run_remote` (already a
-        results list in local mode) and returns it unchanged."""
+        Also accepts the output of :meth:`run_remote` (ObjectRefs in
+        actor mode, a results list in local mode)."""
         if callable(fn_or_results):
             return self.run(fn_or_results)
+        if self._actors is not None and isinstance(fn_or_results, list):
+            return self._ray.get(fn_or_results)
         return fn_or_results
 
     def shutdown(self):
+        if self._actors is not None:
+            for a in self._actors:
+                try:
+                    self._ray.kill(a)
+                except Exception:
+                    pass
+            self._actors = None
+        self._ray = None
         self._started = False
 
 

@@ -70,6 +70,12 @@ class HorovodEstimator(EstimatorParams):
             raise ValueError(
                 "store param is required (e.g. LocalStore(prefix)) — "
                 "it holds materialized data and run checkpoints")
+        if self.getResumeFromCheckpoint() and not self.getRunId():
+            raise ValueError(
+                "resume_from_checkpoint=True requires an explicit "
+                "run_id (each fit otherwise generates a fresh run id "
+                "whose checkpoint path cannot exist — the resume "
+                "would silently no-op)")
 
     def _resolve_backend(self) -> Backend:
         backend = self.getBackend()

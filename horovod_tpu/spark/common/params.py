@@ -99,6 +99,10 @@ class EstimatorParams(Params):
         "callbacks": [],
         "random_seed": None,
         "run_id": None,
+        # load the run's latest Store checkpoint before training (rank
+        # 0 loads, broadcast propagates) — the reference's resume
+        # semantics; default is a fresh fit from the shipped weights
+        "resume_from_checkpoint": False,
         "train_steps_per_epoch": None,
         "validation_steps_per_epoch": None,
         # (features, labels) hook applied to each rank's shard at data

@@ -52,6 +52,19 @@ def _keras_trainer(spec: Dict[str, Any]):
     model = keras.models.model_from_json(
         spec["model_json"], custom_objects=spec["custom_objects"])
     model.set_weights(cloudpickle.loads(spec["weights_blob"]))
+    # Resume (parity: reference checkpoint-resume on refit): rank 0
+    # loads the run's latest Store checkpoint over the shipped
+    # weights; BroadcastGlobalVariablesCallback propagates them.
+    if p.get("resume_from_checkpoint") and hvd.rank() == 0:
+        import os as _os
+
+        _ckpt = _os.path.join(
+            FilesystemStore(spec["store_prefix"]).get_checkpoint_path(
+                spec["run_id"]), CHECKPOINT_FILE)
+        if _os.path.exists(_ckpt):
+            with np.load(_ckpt) as z:
+                model.set_weights(
+                    [z[f"w{i}"] for i in range(len(z.files))])
     optimizer = keras.optimizers.deserialize(
         json.loads(spec["optimizer_config"]))
     loss, metrics, user_callbacks, transformation_fn = \

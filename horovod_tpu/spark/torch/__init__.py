@@ -114,6 +114,18 @@ def _torch_trainer(spec: Dict[str, Any]):
             val_features, val_labels = transformation_fn(
                 val_features, val_labels)
 
+    # Resume (parity: the reference estimator's checkpoint-resume on
+    # refit): rank 0 loads the run's latest Store checkpoint; the
+    # broadcast below propagates it to every rank.  Model AND
+    # optimizer state resume, so momentum etc. continue seamlessly.
+    if p.get("resume_from_checkpoint") and hvd.rank() == 0:
+        ckpt_path = os.path.join(
+            store.get_checkpoint_path(run_id), CHECKPOINT_FILE)
+        if os.path.exists(ckpt_path):
+            state = torch.load(ckpt_path, weights_only=True)
+            model.load_state_dict(state["model"])
+            optimizer.load_state_dict(state["optimizer"])
+
     # Horovod idiom: everyone starts from rank 0's state, gradients
     # are averaged in the wrapped optimizer.
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)

@@ -1,0 +1,51 @@
+"""bench.py's round-over-round regression floors (VERDICT r4 #4):
+BENCH_MODELS.json bar.floors are enforced by the bench harness — a
+deliberate 3% slowdown in any benchmarked model fails the run."""
+
+import json
+import os
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+@pytest.fixture
+def bench():
+    import importlib
+
+    import bench as bench_mod
+
+    return importlib.reload(bench_mod)
+
+
+class TestRegressionFloor:
+    def test_floors_recorded_for_all_models(self, bench):
+        with open(os.path.join(_ROOT, "BENCH_MODELS.json")) as f:
+            bar = json.load(f)["bar"]
+        assert set(bar["floors"]) == set(bench.MODELS)
+        assert 0 < bar["tolerance"] < 0.1
+
+    def test_within_tolerance_passes(self, bench):
+        with open(os.path.join(_ROOT, "BENCH_MODELS.json")) as f:
+            floors = json.load(f)["bar"]["floors"]
+        for model, floor in floors.items():
+            assert bench.check_regression_floor(
+                model, floor * 0.99, _ROOT) is None
+            assert bench.check_regression_floor(
+                model, floor * 1.10, _ROOT) is None
+
+    def test_three_percent_slowdown_fails(self, bench):
+        with open(os.path.join(_ROOT, "BENCH_MODELS.json")) as f:
+            floors = json.load(f)["bar"]["floors"]
+        for model, floor in floors.items():
+            err = bench.check_regression_floor(model, floor * 0.97, _ROOT)
+            assert err is not None and "REGRESSION" in err, model
+            assert model in err
+
+    def test_unknown_model_or_missing_file_is_silent(self, bench, tmp_path):
+        assert bench.check_regression_floor("nosuch", 1.0, _ROOT) is None
+        assert bench.check_regression_floor(
+            "resnet50", 1.0, str(tmp_path)) is None
