@@ -143,14 +143,21 @@ def coordination(iters=30, ps=(1, 2, 4, 8)):
         agg = {k: round(max(r[k] for r in results), 3)
                for k in results[0]}
         rows.append({"processes": p, **agg})
-    # stall-watchdog overhead isolated at P=4
-    results = run(_coordination_body, args=(iters,), np=4, cpu_devices=1,
-                  env={"HVTPU_STALL_CHECK_DISABLE": "1"},
-                  timeout=900.0)
-    rows.append({
-        "processes": 4, "stall_check": "disabled",
-        **{k: round(max(r[k] for r in results), 3) for k in results[0]},
-    })
+    # stall-watchdog cost isolated at P=4: the default rows above run
+    # the amortized mode; compare against the round-4 strict per-op
+    # rendezvous and against checking disabled (the amortized target:
+    # within noise of disabled — VERDICT r4 #1)
+    for label, env in (
+            ("amortized", {}),
+            ("strict", {"HVTPU_STALL_CHECK_MODE": "strict"}),
+            ("disabled", {"HVTPU_STALL_CHECK_DISABLE": "1"})):
+        results = run(_coordination_body, args=(iters,), np=4,
+                      cpu_devices=1, env=env or None, timeout=900.0)
+        rows.append({
+            "processes": 4, "stall_check": label,
+            **{k: round(max(r[k] for r in results), 3)
+               for k in results[0]},
+        })
     return rows
 
 

@@ -602,14 +602,16 @@ def allreduce(
             st, ps,
             f"allreduce:{tname}:{tuple(x.shape)}:{x.dtype}:{rop.name}")
         if p == 1:
-            # averaging / sum over one participant is identity; skip
-            # the scale passes entirely at factor 1.0 (each is a full
-            # extra memory pass on the single-rank fast path)
-            out = x
-            if prescale_factor != 1.0:
-                out = out * jnp.asarray(prescale_factor, out.dtype)
-            if postscale_factor != 1.0:
-                out = out * jnp.asarray(postscale_factor, out.dtype)
+            # averaging / sum over one participant is identity; fuse
+            # the scales into at most ONE pass (the old code always
+            # paid two).  A copy still happens at factor 1.0: callers
+            # are promised a NEW tensor (the torch frontend's DLPack
+            # round-trip would otherwise alias the input buffer).
+            factor = prescale_factor * postscale_factor
+            if factor != 1.0:
+                out = x * jnp.asarray(factor, x.dtype)
+            else:
+                out = jnp.copy(x)
         else:
             # integer AVERAGE floor-divides per stage, which differs
             # from a single flat division — stays on the flat path.

@@ -184,7 +184,11 @@ class RayExecutor:
                         for _ in range(self.num_workers)]
         infos = ray.get(
             [a.node_info.remote() for a in self._actors])
-        envs = _topology_envs(infos, self.env_vars, self.cpu_devices)
+        # CPU-platform forcing must never override reserved
+        # accelerators: use_gpu actors keep their native platform
+        envs = _topology_envs(
+            infos, self.env_vars,
+            None if self.use_gpu else self.cpu_devices)
         ray.get([a.setup.remote(e)
                  for a, e in zip(self._actors, envs)])
 
