@@ -738,7 +738,10 @@ def allgather(tensor, *, process_set=None, name: Optional[str] = None):
     mesh = ps.proc_mesh()
     p = mesh.devices.size
     if p == 1:
-        return x
+        # gather over one participant is identity — but callers are
+        # promised a NEW tensor (frontend DLPack round-trips would
+        # otherwise alias the input buffer; same contract as allreduce)
+        return jnp.copy(x)
     # dim0 excluded from the descriptor: per-rank sizes are legitimate
     # for allgather and negotiated right below
     tname = name or f"allgather.{x.shape[1:]}.{x.dtype}"
@@ -781,7 +784,7 @@ def broadcast(tensor, *, root_rank: int = 0, process_set=None,
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
     if mesh.devices.size == 1:
-        return x
+        return jnp.copy(x)  # new-tensor contract (see allgather)
     # root_rank is a *global* rank (reference semantics); translate to
     # the set-relative index the proc-mesh axis uses.
     root_in_set = ps.rank_in_set(root_rank)
@@ -838,7 +841,8 @@ def alltoall(tensor, splits=None, *, process_set=None,
     if splits.shape != (p,) or int(splits.sum()) != x.shape[0]:
         raise ValueError("splits must be a (size,) vector summing to dim0")
     if p == 1:
-        return (x, jnp.asarray(splits)) if return_splits else x
+        out = jnp.copy(x)  # new-tensor contract (see allgather)
+        return (out, jnp.asarray(splits)) if return_splits else out
     tname = name or f"alltoall.{x.shape[1:]}.{x.dtype}"
     sdesc = stall.check(
         st, ps, f"alltoall:{tname}:{tuple(x.shape[1:])}:{x.dtype}")
@@ -894,7 +898,7 @@ def reducescatter(tensor, *, op=None, process_set=None,
     x = jnp.asarray(tensor)
     p = ps.size
     if p == 1:
-        return x
+        return jnp.copy(x)  # new-tensor contract (see allgather)
     tname = name or f"reducescatter.{x.shape}.{x.dtype}"
     sdesc = stall.check(
         st, ps,

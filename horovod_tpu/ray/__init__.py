@@ -205,7 +205,10 @@ class RayExecutor:
 
         return runner.run(
             fn, args=args, kwargs=kwargs, np=self.num_workers,
-            cpu_devices=self.cpu_devices, env=self.env_vars,
+            # same platform rule as the actor path: use_gpu must not
+            # be silently downgraded to the CPU platform
+            cpu_devices=None if self.use_gpu else self.cpu_devices,
+            env=self.env_vars,
         )
 
     # reference API aliases

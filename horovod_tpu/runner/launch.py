@@ -141,6 +141,15 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     p.add_argument("--no-stall-check", action="store_true",
                    help="disable stall detection entirely (parity: "
                         "horovodrun --no-stall-check)")
+    p.add_argument("--stall-check-mode", default=None,
+                   choices=["amortized", "strict"],
+                   help="amortized (default: local bookkeeping + KV "
+                        "heartbeat, ~zero per-op cost) or strict "
+                        "(per-op pre-dispatch rendezvous: nothing "
+                        "dispatches until all members confirm)")
+    p.add_argument("--stall-heartbeat", type=float, default=None,
+                   help="amortized-mode heartbeat interval seconds "
+                        "(default 0.5; detection latency is one beat)")
     p.add_argument("--log-level", default=None,
                    choices=["trace", "debug", "info", "warning", "error",
                             "fatal"])
@@ -264,6 +273,8 @@ def build_worker_env(
             "HVTPU_COMPRESSION": args.compression,
             "HVTPU_STALL_CHECK_TIME_SECONDS": args.stall_check_time,
             "HVTPU_STALL_SHUTDOWN_TIME_SECONDS": args.stall_shutdown_time,
+            "HVTPU_STALL_CHECK_MODE": args.stall_check_mode,
+            "HVTPU_STALL_HEARTBEAT_SECONDS": args.stall_heartbeat,
             "HVTPU_LOG_LEVEL": args.log_level,
             "HVTPU_CPU_DEVICES": args.cpu_devices,
             "HVTPU_ELASTIC_TIMEOUT": args.elastic_timeout,
