@@ -451,11 +451,16 @@ class AmortizedStallInspector:
         # a goodbye tombstone, NOT a plain delete: peers must be able
         # to tell a clean exit (don't blame this rank for a stall —
         # e.g. a stall_guard(block=False) marker legitimately left
-        # armed after the final step) from a death (do)
+        # armed after the final step) from a death (do).  It CARRIES
+        # any latched failure: an aborting rank usually stops before
+        # its next scheduled beat, and without this the peers would
+        # never learn the diagnosis — they'd hang in the next
+        # collective and die on the torn-down transport instead.
         try:
             self._kv.key_value_set(
                 f"{_HB}/{self.gen}/{self.rank}/{self._beat}",
-                json.dumps({"bye": True, "sets": {}}))
+                json.dumps({"bye": True, "fail": self.failure,
+                            "sets": {}}))
         except Exception:
             pass
         for b in (self._beat - 1, self._beat - 2):
@@ -522,6 +527,7 @@ class AmortizedStallInspector:
                 self._peer_seen[r] = (b, now)
         peers: Dict[int, dict] = {}
         bye = set()
+        bye_fails = []
         for r, (_b, v) in latest.items():
             try:
                 snap = json.loads(v)
@@ -529,15 +535,18 @@ class AmortizedStallInspector:
                 continue
             if snap.get("bye"):
                 bye.add(r)
+                if snap.get("fail"):
+                    bye_fails.append((r, snap["fail"]))
             else:
                 peers[r] = snap
         stale = {r for r, (_b, t) in self._peer_seen.items()
                  if r not in bye and now - t > self.stale_s}
-        self._evaluate(peers, stale, bye)
+        self._evaluate(peers, stale, bye, bye_fails)
 
     def _evaluate(self, peers: Dict[int, dict],
                   stale: Optional[set] = None,
-                  bye: Optional[set] = None) -> None:
+                  bye: Optional[set] = None,
+                  bye_fails: Optional[list] = None) -> None:
         stale = stale or set()
         bye = bye or set()
         now = time.monotonic()
@@ -548,12 +557,17 @@ class AmortizedStallInspector:
                 return
             # a peer that already latched a failure takes the whole job
             # down (reference shutdown-on-stall semantics): surface its
-            # diagnosis instead of hanging on our side
-            for r, snap in peers.items():
-                pf = snap.get("fail")
-                if pf:
-                    fail = f"rank {r} aborted the job: {pf}"
-                    break
+            # diagnosis instead of hanging on our side — including a
+            # peer that already STOPPED, whose tombstone carries it
+            for r, pf in (bye_fails or []):
+                fail = f"rank {r} aborted the job: {pf}"
+                break
+            if not fail:
+                for r, snap in peers.items():
+                    pf = snap.get("fail")
+                    if pf:
+                        fail = f"rank {r} aborted the job: {pf}"
+                        break
             for sid, tr in self._tracks.items():
                 if fail:
                     break

@@ -504,6 +504,31 @@ class TestStallGuardUnit:
         finally:
             a.stop(); b.stop()
 
+    def test_stopped_ranks_tombstone_propagates_failure(self):
+        """An aborting rank usually stops BEFORE its next scheduled
+        beat: its goodbye tombstone must carry the latched diagnosis,
+        or the peers never learn it — they'd hang in their next
+        collective and die on the torn-down transport instead."""
+        kv = FakeKV()
+        a = AmortizedStallInspector(kv, 0, warn_s=60, abort_s=0,
+                                    heartbeat_s=5.0, generation=1)
+        b = AmortizedStallInspector(kv, 1, warn_s=60, abort_s=0,
+                                    heartbeat_s=0.03, generation=1)
+        try:
+            # rank 0 latches a divergence and stops immediately — its
+            # 5s heartbeat never gets to post the failure in a beat
+            with a._lock:
+                a.failure = ("collective mismatch at process set 0 op "
+                             "#3: ... diverged ...")
+            a.stop()
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline and not b.failure:
+                time.sleep(0.02)
+            assert b.failure and "rank 0 aborted" in b.failure
+            assert "diverged" in b.failure
+        finally:
+            a.stop(); b.stop()
+
     def test_clean_exit_not_blamed(self):
         """A rank whose inspector stopped CLEANLY (goodbye tombstone)
         is never blamed for a stall, even with a marker still armed."""
