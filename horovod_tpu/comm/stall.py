@@ -457,8 +457,11 @@ class AmortizedStallInspector:
         # never learn the diagnosis — they'd hang in the next
         # collective and die on the torn-down transport instead.
         try:
+            # beat+1: strictly greater than any beat a wedged
+            # heartbeat thread might still post, so the tombstone
+            # always wins the latest-beat selection
             self._kv.key_value_set(
-                f"{_HB}/{self.gen}/{self.rank}/{self._beat}",
+                f"{_HB}/{self.gen}/{self.rank}/{self._beat + 1}",
                 json.dumps({"bye": True, "fail": self.failure,
                             "sets": {}}))
         except Exception:
