@@ -234,7 +234,7 @@ class SyncStallInspector:
             if self.warn_s > 0 and elapsed > next_warn:
                 next_warn += self.warn_s
                 logger.warning(
-                    "stalled collective [%s] (process set %d, op #%d): "
+                    "stalled collective [%s] (process set %s, op #%d): "
                     "waited %.1fs; ranks not at the rendezvous: %s",
                     desc, set_id, seq, elapsed, pending,
                 )

@@ -615,6 +615,63 @@ def test_stall_guard_jit_plane_2proc():
 
 
 @pytest.mark.multiprocess
+def test_stall_guard_strict_mode_2proc():
+    """stall_guard under HVTPU_STALL_CHECK_MODE=strict: each step is a
+    pre-dispatch rendezvous — a rank that stops stepping aborts the
+    survivor at the step boundary BEFORE it dispatches the doomed
+    step."""
+
+    def body():
+        import time as _t
+        from functools import partial
+
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        import horovod_tpu as hvt
+        from horovod_tpu.core.exceptions import HorovodInternalError
+
+        hvt.init()
+        r = hvt.rank()
+        mesh = hvt.world_mesh()
+
+        @hvt.stall_guard(name="strict_train")
+        @jax.jit
+        @partial(shard_map, mesh=mesh, in_specs=P("world"),
+                 out_specs=P(), check_rep=False)
+        def train(x):
+            return jax.lax.psum(x.sum(), "world")
+
+        xs = jax.device_put(
+            jnp.ones((2,)), NamedSharding(mesh, P("world")))
+        try:
+            for i in range(50):
+                if r == 1 and i == 2:
+                    _t.sleep(8)
+                    return ("stopped", None)
+                float(train(xs))
+        except HorovodInternalError as e:
+            return ("aborted", str(e))
+        return ("finished", None)
+
+    results = run(
+        body, np=2, cpu_devices=1, env={
+            **_ENV,
+            "HVTPU_STALL_CHECK_MODE": "strict",
+            "HVTPU_STALL_CHECK_TIME_SECONDS": "1",
+            "HVTPU_STALL_SHUTDOWN_TIME_SECONDS": "3",
+        }, start_timeout=300.0, timeout=600.0)
+    status0, msg0 = results[0]
+    assert status0 == "aborted", results
+    # strict mode: the abort happens at the rendezvous, pre-dispatch,
+    # with the step and absent rank named
+    assert "jit_step:strict_train" in msg0 and "[1]" in msg0
+    assert results[1][0] == "stopped"
+
+
+@pytest.mark.multiprocess
 def test_watchdog_transparent_on_healthy_path_2proc():
     """With stall checking at defaults, the full sync op matrix still
     produces correct results (the rendezvous must be semantically
