@@ -67,6 +67,7 @@ from ..tensorflow import (  # noqa: F401
     xla_built,
 )
 from . import callbacks  # noqa: F401
+from . import elastic  # noqa: F401  (hvd.elastic.KerasState parity)
 
 
 def DistributedOptimizer(optimizer, name=None,

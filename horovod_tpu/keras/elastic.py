@@ -1,0 +1,8 @@
+"""Elastic keras state (parity: ``horovod/keras/elastic.py``
+``KerasState``): alias of the TF/Keras state object plus the shared
+``run`` decorator."""
+
+from ..elastic import run  # noqa: F401  (parity: hvd.elastic.run)
+from ..tensorflow.elastic import TensorFlowKerasState
+
+KerasState = TensorFlowKerasState

@@ -59,10 +59,13 @@ from .mpi_ops import (  # noqa: E402
     alltoall,
     barrier,
     broadcast,
+    grouped_allgather,
     grouped_allreduce,
+    grouped_reducescatter,
     join,
     reducescatter,
 )
+from . import elastic  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -342,8 +345,9 @@ __all__ = [
     "ProcessSet", "add_process_set", "remove_process_set",
     "HorovodInternalError", "HostsUpdatedInterrupt",
     "Sum", "Average", "Adasum", "Min", "Max", "Product",
-    "allreduce", "grouped_allreduce", "allgather", "broadcast",
-    "alltoall", "reducescatter", "barrier", "join",
+    "allreduce", "grouped_allreduce", "allgather", "grouped_allgather",
+    "broadcast", "alltoall", "reducescatter", "grouped_reducescatter",
+    "barrier", "join", "elastic",
     "broadcast_variables", "broadcast_object", "allgather_object",
     "is_homogeneous", "size_op", "rank_op", "local_rank_op",
     "local_size_op",

@@ -9,6 +9,7 @@ from typing import Any, Dict
 
 import numpy as np
 
+from ..elastic import run  # noqa: F401  (parity: hvd.elastic.run)
 from ..elastic.state import ObjectState
 
 

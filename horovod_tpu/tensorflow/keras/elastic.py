@@ -1,0 +1,10 @@
+"""Elastic keras state (parity: ``horovod/tensorflow/keras/elastic.py``
+``KerasState``): the tf.keras alias of ``TensorFlowKerasState`` plus
+the shared ``run`` decorator."""
+
+from ...elastic import run  # noqa: F401  (parity: hvd.elastic.run)
+from ..elastic import TensorFlowKerasState
+
+# Reference class name for the tf.keras path: KerasState(model,
+# optimizer=None, **kwargs) with commit/restore/sync semantics.
+KerasState = TensorFlowKerasState

@@ -219,7 +219,7 @@ def allreduce(tensor, average=None, op=None, name=None,
 
 
 @_no_autograph
-def grouped_allreduce(tensors: List, average=None, op=None,
+def grouped_allreduce(tensors: List, average=None, op=None, name=None,
                       compression=Compression.none, process_set=None):
     if tf.executing_eagerly():
         def impl(*xs):
@@ -290,6 +290,90 @@ def allgather(tensor, name=None, process_set=None):
         return y, grad
 
     return _op(tf.convert_to_tensor(tensor))
+
+
+@_no_autograph
+def grouped_allgather(tensors: List, name=None, process_set=None):
+    """Allgather a list of tensors as one negotiated group (parity:
+    hvd.grouped_allgather for TF; ``name`` accepted for signature
+    compatibility — members are auto-named like the torch frontend)."""
+    if tf.executing_eagerly():
+        def impl(*xs):
+            outs = _hvt.grouped_allgather(
+                [_to_engine(x) for x in xs], process_set=process_set,
+            )
+            return tuple(_from_engine(o, dtype=x.dtype)
+                         for x, o in zip(xs, outs))
+
+        # Parity: RegisterGradient('HorovodGroupedAllgather') — one
+        # grouped allreduce-sum of the upstream gradients, then each
+        # member slices out the rows this rank contributed.  All
+        # members' row counts ride ONE size-allgather ([1, N] per
+        # rank), not one collective per member.
+        @tf.custom_gradient
+        def _op(*xs):
+            ys = impl(*xs)
+
+            def grad(*dys):
+                summed = grouped_allreduce(
+                    list(dys), op=Sum, process_set=process_set)
+                r = _participant_rank(process_set)
+                rows = tf.stack([tf.shape(x)[0] for x in xs])
+                sizes = allgather(tf.reshape(rows, [1, -1]),
+                                  process_set=process_set)  # [p, N]
+                offsets = tf.reduce_sum(sizes[:r, :], axis=0)
+                return tuple(
+                    s[offsets[i]:offsets[i] + tf.shape(x)[0]]
+                    for i, (x, s) in enumerate(zip(xs, summed)))
+
+            return ys, grad
+
+        return list(_op(*[tf.convert_to_tensor(t) for t in tensors]))
+    return [allgather(t, process_set=process_set) for t in tensors]
+
+
+@_no_autograph
+def grouped_reducescatter(tensors: List, op=None, name=None,
+                          process_set=None):
+    """Reducescatter a list of tensors as one negotiated group (parity:
+    hvd.grouped_reducescatter for TF; ``name`` accepted for signature
+    compatibility)."""
+    if tf.executing_eagerly():
+        def impl(*xs):
+            outs = _hvt.grouped_reducescatter(
+                [_to_engine(x) for x in xs], op=op,
+                process_set=process_set,
+            )
+            return tuple(_from_engine(o, dtype=x.dtype)
+                         for x, o in zip(xs, outs))
+
+        # Parity: RegisterGradient('HorovodGroupedReducescatter') —
+        # allgather each member's shard gradient; Average forwards
+        # additionally average the backward.
+        @tf.custom_gradient
+        def _op(*xs):
+            ys = impl(*xs)
+
+            def grad(*dys):
+                from ..comm.reduce_ops import ReduceOp, normalize_op
+
+                rop = normalize_op(op, None)
+                if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+                    raise NotImplementedError(
+                        f"gradient of a {rop.name} grouped_"
+                        "reducescatter is not defined")
+                gs = grouped_allgather(list(dys),
+                                       process_set=process_set)
+                if rop == ReduceOp.AVERAGE:
+                    n = _participant_count(process_set)
+                    gs = [g / tf.cast(n, g.dtype) for g in gs]
+                return tuple(gs)
+
+            return ys, grad
+
+        return list(_op(*[tf.convert_to_tensor(t) for t in tensors]))
+    return [reducescatter(t, op=op, process_set=process_set)
+            for t in tensors]
 
 
 @_no_autograph

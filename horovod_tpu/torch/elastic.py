@@ -13,6 +13,7 @@ from typing import Any, Dict
 
 import torch
 
+from ..elastic import run  # noqa: F401  (parity: hvd.elastic.run)
 from ..elastic.state import ObjectState
 
 

@@ -160,6 +160,12 @@ class TestTorchState:
         assert torch.allclose(model.weight, w0)
         assert state.epoch == 0
 
+    def test_run_decorator_reexported(self, hvt):
+        # parity: `import horovod.torch as hvd; hvd.elastic.run(...)`
+        import horovod_tpu.torch as hvd_torch
+
+        assert hvd_torch.elastic.run is elastic.run
+
     def test_elastic_sampler_reshards_and_skips(self, hvt):
         from horovod_tpu.torch.elastic import ElasticSampler
 

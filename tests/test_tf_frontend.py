@@ -97,6 +97,26 @@ class TestTfOps:
         assert hvd_tf.broadcast_object(obj, root_rank=0) == obj
         assert hvd_tf.allgather_object(obj) == [obj]
 
+    def test_elastic_module_attribute(self, hvt):
+        # parity: examples use `import horovod.tensorflow as hvd;
+        # hvd.elastic.run(...)`
+        assert hasattr(hvd_tf.elastic, "run")
+
+    def test_tensorflow_keras_package_layout(self, hvt):
+        # parity: the reference ships the keras surface at BOTH
+        # horovod.keras and horovod.tensorflow.keras (shared impl in
+        # horovod/_keras/); the canonical import path must work
+        import horovod_tpu.tensorflow.keras as hvd_tfk
+
+        assert hvd_tfk.DistributedOptimizer is hvd_keras.DistributedOptimizer
+        assert hasattr(hvd_tfk.callbacks, "BroadcastGlobalVariablesCallback")
+        # elastic.KerasState (horovod/tensorflow/keras/elastic.py)
+        assert hasattr(hvd_tfk.elastic, "KerasState")
+        assert hasattr(hvd_tfk.elastic, "run")
+        import horovod_tpu.keras.elastic as k_elastic
+
+        assert hasattr(k_elastic, "KerasState")
+
     def test_build_info_surface(self, hvt):
         assert hvd_tf.xla_built()
         assert not hvd_tf.nccl_built()
@@ -190,6 +210,43 @@ class TestRegisteredGradients:
             t.watch(x)
             y = tf.reduce_sum(hvd_tf.alltoall(x) * 2.0)
         np.testing.assert_allclose(t.gradient(y, x).numpy(), [2.0, 2.0])
+
+    def test_grouped_allgather_values_and_grad(self, hvt):
+        xs = [tf.constant([[1.0], [2.0]]), tf.constant([[3.0, 4.0]])]
+        with tf.GradientTape() as t:
+            t.watch(xs)
+            outs = hvd_tf.grouped_allgather(xs)
+            y = (tf.reduce_sum(outs[0] * tf.constant([[2.0], [5.0]]))
+                 + tf.reduce_sum(outs[1] * 3.0))
+        np.testing.assert_allclose(outs[0].numpy(), [[1.0], [2.0]])
+        np.testing.assert_allclose(outs[1].numpy(), [[3.0, 4.0]])
+        g0, g1 = t.gradient(y, xs)
+        np.testing.assert_allclose(g0.numpy(), [[2.0], [5.0]])
+        np.testing.assert_allclose(g1.numpy(), [[3.0, 3.0]])
+
+    def test_grouped_reducescatter_values_and_grad(self, hvt):
+        xs = [tf.constant([[1.0], [2.0]]), tf.constant([3.0, 4.0])]
+        with tf.GradientTape() as t:
+            t.watch(xs)
+            outs = hvd_tf.grouped_reducescatter(xs, op=hvd_tf.Sum)
+            y = (tf.reduce_sum(outs[0] * 7.0)
+                 + tf.reduce_sum(outs[1] * 2.0))
+        np.testing.assert_allclose(outs[0].numpy(), [[1.0], [2.0]])
+        np.testing.assert_allclose(outs[1].numpy(), [3.0, 4.0])
+        g0, g1 = t.gradient(y, xs)
+        np.testing.assert_allclose(g0.numpy(), [[7.0], [7.0]])
+        np.testing.assert_allclose(g1.numpy(), [2.0, 2.0])
+
+    def test_grouped_ops_graph_mode_fallback(self, hvt):
+        @tf.function
+        def step(a, b):
+            outs = hvd_tf.grouped_allgather([a, b])
+            red = hvd_tf.grouped_reducescatter([a, b], op=hvd_tf.Sum)
+            return outs[0], red[1]
+
+        o0, r1 = step(tf.constant([[1.0]]), tf.constant([2.0]))
+        np.testing.assert_allclose(o0.numpy(), [[1.0]])
+        np.testing.assert_allclose(r1.numpy(), [2.0])
 
 
 class TestDistributedGradientTape:
