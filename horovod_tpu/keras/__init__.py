@@ -47,7 +47,9 @@ from ..tensorflow import (  # noqa: F401
     ddl_built,
     gloo_built,
     gloo_enabled,
+    grouped_allgather,
     grouped_allreduce,
+    grouped_reducescatter,
     init,
     is_initialized,
     join,

@@ -13,6 +13,47 @@ from ..elastic import run  # noqa: F401  (parity: hvd.elastic.run)
 from ..elastic.state import ObjectState
 
 
+class TensorFlowState(ObjectState):
+    """Elastic state over a plain list of ``tf.Variable``s (parity:
+    ``horovod/tensorflow/elastic.py`` ``TensorFlowState(variables,
+    session)``).  TF2-idiomatic: eager variables, no session — pass the
+    variables explicitly (the reference's no-arg default reads the TF1
+    global-variables collection, which does not exist eagerly)."""
+
+    def __init__(self, variables=None, **kwargs):
+        if variables is None:
+            # The reference's no-arg default reads the TF1
+            # global-variables collection under a session; this build
+            # is TF2-eager only, where graph RefVariables would not
+            # survive _capture's .numpy() anyway — require the list.
+            raise ValueError(
+                "TensorFlowState needs an explicit `variables` list "
+                "(TF2 eager has no global-variables collection); pass "
+                "e.g. model.trainable_variables")
+        self._variables = list(variables)
+        super().__init__(**kwargs)  # ObjectState snapshots at the end
+
+    def _capture(self) -> Dict[str, Any]:
+        payload = super()._capture()
+        payload["__vars__"] = [np.asarray(v.numpy())
+                               for v in self._variables]
+        return payload
+
+    def _apply(self, payload: Dict[str, Any]):
+        for k, v in payload.items():
+            if k == "__vars__":
+                if len(v) != len(self._variables):
+                    raise ValueError(
+                        f"snapshot holds {len(v)} variables but this "
+                        f"state tracks {len(self._variables)} — the "
+                        "variable list changed since the commit; "
+                        "refusing a partial restore")
+                for var, val in zip(self._variables, v):
+                    var.assign(val)
+            else:
+                setattr(self, k, v)
+
+
 class TensorFlowKerasState(ObjectState):
     """Elastic state for a keras model (+ optional optimizer) plus
     plain attributes (parity: TensorFlowKerasState(model, optimizer,

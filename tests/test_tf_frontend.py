@@ -386,6 +386,37 @@ class TestKerasOptimizer:
             hvd_tf.DistributedOptimizer(object())
 
 
+class TestTensorFlowState:
+    def test_variable_commit_restore_roundtrip(self, hvt):
+        from horovod_tpu.tensorflow.elastic import TensorFlowState
+
+        v = tf.Variable([1.0, 2.0])
+        w = tf.Variable([[3.0]])
+        state = TensorFlowState(variables=[v, w], batch=0)
+        state.commit()
+        v.assign([9.0, 9.0])
+        w.assign([[9.0]])
+        state.batch = 7
+        state.restore()
+        np.testing.assert_allclose(v.numpy(), [1.0, 2.0])
+        np.testing.assert_allclose(w.numpy(), [[3.0]])
+        assert state.batch == 0
+
+    def test_eager_requires_explicit_variables(self, hvt):
+        from horovod_tpu.tensorflow.elastic import TensorFlowState
+
+        with pytest.raises(ValueError, match="explicit"):
+            TensorFlowState()
+
+    def test_refuses_partial_restore_on_var_count_mismatch(self, hvt):
+        from horovod_tpu.tensorflow.elastic import TensorFlowState
+
+        state = TensorFlowState(
+            variables=[tf.Variable([1.0]), tf.Variable([2.0])])
+        with pytest.raises(ValueError, match="partial restore"):
+            state._apply({"__vars__": [np.zeros(1)]})
+
+
 class TestTensorFlowKerasState:
     def test_commit_restore_roundtrip(self, hvt):
         from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
