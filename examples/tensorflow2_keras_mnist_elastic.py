@@ -1,0 +1,75 @@
+"""Elastic tf.keras training example — the horovod_tpu analog of the
+reference's examples/elastic/tensorflow2/tensorflow2_keras_mnist_elastic.py:
+``hvd.elastic.run`` drives ``model.fit`` with ``KerasState`` and the
+elastic state callbacks; commits survive worker loss and world
+resizes, and a restarted/resized world resumes from the committed
+epoch with weights re-broadcast from rank 0.
+
+Run:
+  hvtpurun --host-discovery-script ./discover.sh --min-np 2 \
+      --cpu-devices 1 python examples/tensorflow2_keras_mnist_elastic.py
+where discover.sh prints e.g. "localhost:4".
+"""
+
+import numpy as np
+
+import horovod_tpu.tensorflow.keras as hvd
+
+
+def main():
+    import keras
+
+    hvd.init()
+    np.random.seed(0)
+    x = np.random.rand(1024, 784).astype(np.float32)
+    w = np.random.randn(784, 10).astype(np.float32)
+    y = (x @ w).argmax(axis=1)
+
+    model = keras.Sequential([
+        keras.layers.Input((784,)),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(0.05 * hvd.size()))
+    model.compile(optimizer=opt,
+                  loss="sparse_categorical_crossentropy")
+
+    state = hvd.elastic.KerasState(model, optimizer=opt,
+                                   batch=0, epoch=0)
+
+    def on_reset():
+        # world size changed: rescale the lr like the reference's
+        # elastic keras example does on state reset
+        opt.learning_rate = 0.05 * hvd.size()
+
+    state.register_reset_callbacks([on_reset])
+    epochs = 8
+
+    @hvd.elastic.run
+    def train(state):
+        # shard by the CURRENT world — resizes survive
+        n = len(x) // hvd.size()
+        lo = hvd.rank() * n
+        model.fit(
+            x[lo:lo + n], y[lo:lo + n],
+            batch_size=64,
+            initial_epoch=state.epoch,
+            epochs=epochs,
+            verbose=1 if hvd.rank() == 0 else 0,
+            callbacks=[
+                hvd.callbacks.MetricAverageCallback(),
+                hvd.elastic.UpdateBatchStateCallback(state),
+                hvd.elastic.UpdateEpochStateCallback(state),
+                hvd.elastic.CommitStateCallback(state),
+            ],
+        )
+
+    train(state)
+    if hvd.rank() == 0:
+        print(f"done after epoch {state.epoch}; ranks consistent "
+              f"({hvd.size()} ranks)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -443,6 +443,91 @@ class TestTensorFlowKerasState:
         assert state.epoch == 3  # size-1 world: identity
 
 
+class TestElasticKerasCallbacks:
+    """Parity: horovod/_keras/elastic.py — the callbacks the
+    reference's elastic keras examples drive model.fit with."""
+
+    def test_fit_maintains_state_and_commits(self, hvt):
+        import horovod_tpu.tensorflow.keras as hvd_tfk
+
+        model = keras.Sequential([keras.layers.Dense(1)])
+        model.compile(optimizer=keras.optimizers.SGD(0.1), loss="mse")
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 4).astype(np.float32)
+        y = x @ rng.rand(4, 1).astype(np.float32)
+
+        state = hvd_tfk.elastic.KerasState(model, batch=0, epoch=0)
+        commits = []
+        orig = state.commit
+        state.commit = lambda: (commits.append(True), orig())
+        model.fit(
+            x, y, batch_size=8, epochs=2, verbose=0,
+            callbacks=[
+                hvd_tfk.elastic.UpdateBatchStateCallback(state),
+                hvd_tfk.elastic.UpdateEpochStateCallback(state),
+                hvd_tfk.elastic.CommitStateCallback(
+                    state, batches_per_commit=2),
+            ])
+        assert state.epoch == 2
+        assert state.batch == 0  # reset at epoch end
+        # 4 batches/epoch: commits at batch 2 and 4, plus epoch end
+        assert len(commits) >= 4
+        # the committed snapshot carries the post-fit epoch
+        assert state._saved["epoch"] == 2
+
+    def test_batch_callback_tracks_within_epoch(self, hvt):
+        import horovod_tpu.keras.elastic as k_elastic
+
+        class S:
+            batch = 0
+            epoch = 0
+
+        s = S()
+        cb = k_elastic.UpdateBatchStateCallback(s)
+        cb.on_train_batch_end(5)
+        assert s.batch == 6
+        cb.on_epoch_end(0)
+        assert s.batch == 0
+        ecb = k_elastic.UpdateEpochStateCallback(s)
+        ecb.on_epoch_end(3)
+        assert s.epoch == 4
+
+    def test_batch_callback_resumes_mid_epoch(self, hvt):
+        # parity: horovod/_keras/elastic.py shortens the resumed
+        # epoch by the batches already consumed before the reset
+        import horovod_tpu.keras.elastic as k_elastic
+
+        class S:
+            batch = 3
+            epoch = 1
+
+        cb = k_elastic.UpdateBatchStateCallback(S())
+        cb.params = {"steps": 10}
+        cb.on_epoch_begin(1)
+        assert cb.params["steps"] == 7
+        # a different epoch (not the interrupted one) is untouched
+        cb2 = k_elastic.UpdateBatchStateCallback(S())
+        cb2.params = {"steps": 10}
+        cb2.on_epoch_begin(2)
+        assert cb2.params["steps"] == 10
+
+    def test_commit_zero_batches_per_commit(self, hvt):
+        import horovod_tpu.keras.elastic as k_elastic
+
+        commits = []
+
+        class S:
+            def commit(self):
+                commits.append(True)
+
+        cb = k_elastic.CommitStateCallback(S(), batches_per_commit=0)
+        for b in range(5):
+            cb.on_batch_end(b)
+        assert commits == []  # per-batch commits disabled
+        cb.on_epoch_end(0)
+        assert commits == [True]
+
+
 class TestKerasCallbacks:
     def _model(self):
         model = keras.Sequential([keras.layers.Dense(1)])
