@@ -65,7 +65,7 @@ def main():
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
     hvd.broadcast_optimizer_state(opt, root_rank=0)
 
-    steps = len(data) // args.batch_size
+    steps = max(len(data) // args.batch_size, 1)
     for epoch in range(args.epochs):
         perm = torch.randperm(len(data))
         total = 0.0

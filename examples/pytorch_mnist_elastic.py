@@ -1,0 +1,89 @@
+"""Elastic torch training example — the horovod_tpu analog of the
+reference's examples/elastic/pytorch/pytorch_mnist_elastic.py:
+``hvd.elastic.run`` with ``TorchState`` (model + optimizer) and the
+``ElasticSampler``; commits survive worker loss and world resizes.
+
+Run:
+  hvtpurun --host-discovery-script ./discover.sh --min-np 2 \
+      --cpu-devices 1 python examples/pytorch_mnist_elastic.py
+where discover.sh prints e.g. "localhost:4".
+"""
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 128)
+        self.fc2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        x = F.relu(self.fc1(x))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def main():
+    hvd.init()
+    torch.manual_seed(42)
+
+    rng = np.random.RandomState(0)
+    x = torch.from_numpy(rng.rand(1024, 784).astype(np.float32))
+    w = rng.randn(784, 10).astype(np.float32)
+    y = torch.from_numpy((x.numpy() @ w).argmax(axis=1))
+
+    model = Net()
+    # elastic: lr scales with the CURRENT size; rebuilt on reset
+    opt = torch.optim.SGD(model.parameters(), lr=0.05 * hvd.size())
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+
+    dataset = torch.utils.data.TensorDataset(x, y)
+    sampler = hvd.elastic.ElasticSampler(dataset, shuffle=True)
+    state = hvd.elastic.TorchState(
+        model=model, optimizer=opt, sampler=sampler, epoch=0)
+
+    def on_reset():
+        for g in opt.param_groups:
+            g["lr"] = 0.05 * hvd.size()
+
+    state.register_reset_callbacks([on_reset])
+    batch = 64
+    epochs = 6
+
+    @hvd.elastic.run
+    def train(state):
+        while state.epoch < epochs:
+            sampler.set_epoch(state.epoch)
+            loader = torch.utils.data.DataLoader(
+                dataset, batch_size=batch, sampler=sampler)
+            total, steps = 0.0, 0
+            for bi, (bx, by) in enumerate(loader):
+                opt.zero_grad()
+                loss = F.nll_loss(model(bx), by)
+                loss.backward()
+                opt.step()
+                sampler.record_batch(bi, batch)
+                total += float(loss)
+                steps += 1
+            avg = hvd.allreduce(
+                torch.tensor(total / max(steps, 1)), op=hvd.Average)
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss={float(avg):.4f} "
+                      f"(world size {hvd.size()})", flush=True)
+            state.epoch += 1
+            state.commit()
+
+    train(state)
+    if hvd.rank() == 0:
+        print(f"done; ranks consistent ({hvd.size()} ranks)",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -85,6 +85,10 @@ class ElasticDriver:
         # run() this is the FINAL world (result collection filters
         # stale rank files from larger earlier incarnations with it)
         self.final_world_size: Optional[int] = None
+        # incarnation counter: 0 for the first launch, +1 per
+        # relaunch; workers use it to run reset callbacks after a
+        # world reconfiguration (HVTPU_ELASTIC_GENERATION)
+        self._generation = 0
 
     def _log(self, msg: str):
         if self.verbose:
@@ -120,6 +124,8 @@ class ElasticDriver:
         base_env = dict(os.environ)
         base_env["HVTPU_ELASTIC"] = "1"
         base_env["HVTPU_ELASTIC_STATE_DIR"] = self.state_dir
+        base_env["HVTPU_ELASTIC_GENERATION"] = str(self._generation)
+        self._generation += 1
         # One coordinator address for the whole world (rank 0's host),
         # exactly like the static launch path.
         coordinator_addr = _default_coordinator_addr(slots)

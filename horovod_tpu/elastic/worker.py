@@ -76,6 +76,16 @@ def run(func):
             )
         try:
             state.sync()
+            if os.environ.get("HVTPU_ELASTIC_GENERATION", "0") != "0":
+                # Relaunched incarnation after a world change: run the
+                # user's reset callbacks AFTER sync restored the
+                # committed state, so world-size-derived values they
+                # rebuild (lr schedules etc.) are not clobbered by the
+                # old world's committed copy.  Parity:
+                # horovod/common/elastic.py run_fn's state.on_reset()
+                # between reset() and the next sync — same net order
+                # (callbacks see the new world, then training resumes).
+                state.on_reset()
             return func(state, *args, **kwargs)
         except HorovodInternalError:
             # Peer loss mid-collective: roll back so the durable commit

@@ -38,6 +38,14 @@ class CommitStateCallback(keras.callbacks.Callback):
         self._remaining -= 1
         if self._remaining <= 0:
             self._remaining = self.batches_per_commit
+            steps = (self.params or {}).get("steps")
+            if steps is not None and batch + 1 >= steps:
+                # the epoch's final batch: skip — the epoch-end commit
+                # below snapshots the same weights WITH the updated
+                # epoch/batch counters (the Update*StateCallbacks run
+                # first), so committing here would only duplicate the
+                # full deep-copy/pickle
+                return
             self.state.commit()
 
     def on_epoch_end(self, epoch, logs=None):
@@ -46,13 +54,18 @@ class CommitStateCallback(keras.callbacks.Callback):
 
 
 class UpdateBatchStateCallback(keras.callbacks.Callback):
-    """Track the in-epoch batch number on the state and resume
-    mid-epoch after a reset (parity:
-    ``hvd.elastic.UpdateBatchStateCallback``): after a restore,
-    ``fit`` restarts the interrupted epoch, and this callback shortens
-    it by the ``state.batch`` steps already consumed (the reference's
-    ``params['steps'] -= state.batch``); resets to 0 at each epoch
-    end."""
+    """Track the in-epoch batch number on the state (parity:
+    ``hvd.elastic.UpdateBatchStateCallback``); resets to 0 at each
+    epoch end.
+
+    Resume granularity under ``model.fit``: Keras 3's fit loop owns
+    its iterator, so a restore mid-epoch cannot skip the
+    already-consumed batches — fit resumes at EPOCH granularity
+    (``initial_epoch=state.epoch``) and replays the interrupted epoch
+    from its start (this callback logs that and re-zeros
+    ``state.batch`` so in-epoch commits renumber correctly).  Custom
+    training loops get true batch-granular resume by starting their
+    step range at ``state.batch``."""
 
     def __init__(self, state):
         super().__init__()
@@ -60,9 +73,14 @@ class UpdateBatchStateCallback(keras.callbacks.Callback):
 
     def on_epoch_begin(self, epoch, logs=None):
         if self.state.batch > 0 and epoch == self.state.epoch:
-            steps = (self.params or {}).get("steps")
-            if steps is not None:
-                self.params["steps"] = max(steps - self.state.batch, 0)
+            import logging
+
+            logging.getLogger("horovod_tpu").warning(
+                "elastic resume: epoch %d replays from its start "
+                "(%d batches were already consumed before the reset; "
+                "keras fit cannot skip into an epoch)",
+                epoch, self.state.batch)
+            self.state.batch = 0
 
     def on_train_batch_end(self, batch, logs=None):
         self.state.batch = batch + 1

@@ -81,14 +81,38 @@ class TensorFlowKerasState(ObjectState):
             payload["__opt_vars__"] = [np.asarray(v) for v in opt_vars]
         return payload
 
+    def _opt_vars(self):
+        opt_vars = self._opt_handle.variables
+        if callable(opt_vars):  # legacy optimizers: method not prop
+            opt_vars = opt_vars()
+        return opt_vars
+
     def _apply(self, payload: Dict[str, Any]):
         for k, v in payload.items():
             if k == "__model_weights__":
                 self._model_handle.set_weights(list(v))
             elif k == "__opt_vars__":
-                opt_vars = self._opt_handle.variables
-                if callable(opt_vars):
-                    opt_vars = opt_vars()
+                opt_vars = self._opt_vars()
+                if len(opt_vars) != len(v) \
+                        and not getattr(self._opt_handle, "built", True):
+                    # Elastic restart: the relaunched process holds a
+                    # FRESH optimizer whose slot variables (momentum
+                    # etc.) don't exist until build — a plain zip
+                    # would silently drop the committed slots.  Build
+                    # against the model's trainables, then restore.
+                    try:
+                        self._opt_handle.build(
+                            self._model_handle.trainable_variables)
+                    except Exception:
+                        pass
+                    opt_vars = self._opt_vars()
+                if len(opt_vars) != len(v):
+                    raise ValueError(
+                        f"snapshot holds {len(v)} optimizer variables "
+                        f"but the live optimizer has {len(opt_vars)} "
+                        "— commit after the optimizer's first step, "
+                        "or pass a built optimizer; refusing a "
+                        "partial restore")
                 for var, val in zip(opt_vars, v):
                     var.assign(val)
             else:

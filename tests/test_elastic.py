@@ -42,6 +42,33 @@ class TestObjectState:
         state.restore()
         assert fired == [1]
 
+    def test_relaunch_generation_runs_reset_callbacks(
+            self, hvt, monkeypatch):
+        # A relaunched incarnation (driver sets
+        # HVTPU_ELASTIC_GENERATION > 0) must run the user's reset
+        # callbacks AFTER sync, so world-size-derived values (lr
+        # schedules) are rebuilt instead of staying at the old
+        # world's committed copy.
+        events = []
+        state = elastic.ObjectState(epoch=0)
+        orig_sync = state.sync
+        state.sync = lambda: (events.append("sync"), orig_sync())
+        state.register_reset_callbacks(
+            [lambda: events.append("reset_cb")])
+
+        @elastic.run
+        def train(st):
+            events.append("train")
+
+        monkeypatch.setenv("HVTPU_ELASTIC_GENERATION", "1")
+        train(state)
+        assert events == ["sync", "reset_cb", "train"]
+        # first incarnation: no reset callbacks
+        events.clear()
+        monkeypatch.setenv("HVTPU_ELASTIC_GENERATION", "0")
+        train(state)
+        assert events == ["sync", "train"]
+
     def test_commit_persists_to_state_dir(self, hvt, tmp_path,
                                           monkeypatch):
         monkeypatch.setenv("HVTPU_ELASTIC_STATE_DIR", str(tmp_path))

@@ -442,6 +442,51 @@ class TestTensorFlowKerasState:
         state.sync()
         assert state.epoch == 3  # size-1 world: identity
 
+    def test_restart_restores_momentum_into_fresh_optimizer(
+            self, hvt, tmp_path, monkeypatch):
+        # Elastic relaunch: the committed optimizer has built slot
+        # variables (momentum), the fresh process's optimizer doesn't
+        # — restore must build it and carry the slots over, not
+        # silently truncate to the pre-build variable list.
+        import tensorflow as tf
+
+        from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+        monkeypatch.setenv("HVTPU_ELASTIC_STATE_DIR", str(tmp_path))
+
+        def make():
+            m = keras.Sequential([keras.layers.Dense(1)])
+            m.build((None, 2))
+            return m, keras.optimizers.SGD(0.1, momentum=0.9)
+
+        model, opt = make()
+        opt.build(model.trainable_variables)
+        n_built = len(opt.variables)
+        for v in opt.variables:
+            if "momentum" in v.path:
+                v.assign(tf.fill(v.shape, 0.5))
+        TensorFlowKerasState(model, optimizer=opt, epoch=1).commit()
+
+        model2, opt2 = make()  # unbuilt: no momentum slots yet
+        assert len(opt2.variables) < n_built
+        state2 = TensorFlowKerasState(model2, optimizer=opt2, epoch=0)
+        state2.sync()  # loads the durable commit
+        assert state2.epoch == 1
+        mom = [v for v in opt2.variables if "momentum" in v.path]
+        assert mom and all(
+            np.allclose(np.asarray(v), 0.5) for v in mom)
+
+    def test_refuses_partial_optimizer_restore(self, hvt):
+        from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+        model = keras.Sequential([keras.layers.Dense(1)])
+        model.build((None, 2))
+        opt = keras.optimizers.SGD(0.1, momentum=0.9)
+        opt.build(model.trainable_variables)
+        state = TensorFlowKerasState(model, optimizer=opt)
+        with pytest.raises(ValueError, match="partial restore"):
+            state._apply({"__opt_vars__": [np.zeros(1)]})
+
 
 class TestElasticKerasCallbacks:
     """Parity: horovod/_keras/elastic.py — the callbacks the
@@ -492,24 +537,31 @@ class TestElasticKerasCallbacks:
         ecb.on_epoch_end(3)
         assert s.epoch == 4
 
-    def test_batch_callback_resumes_mid_epoch(self, hvt):
-        # parity: horovod/_keras/elastic.py shortens the resumed
-        # epoch by the batches already consumed before the reset
+    def test_batch_callback_resumed_epoch_replays(self, hvt, caplog):
+        # keras fit cannot skip into an epoch: a mid-epoch restore
+        # replays the epoch from its start — the callback says so and
+        # re-zeros the counter so in-epoch commits renumber correctly
+        import logging
+
         import horovod_tpu.keras.elastic as k_elastic
 
         class S:
             batch = 3
             epoch = 1
 
-        cb = k_elastic.UpdateBatchStateCallback(S())
-        cb.params = {"steps": 10}
-        cb.on_epoch_begin(1)
-        assert cb.params["steps"] == 7
-        # a different epoch (not the interrupted one) is untouched
-        cb2 = k_elastic.UpdateBatchStateCallback(S())
-        cb2.params = {"steps": 10}
-        cb2.on_epoch_begin(2)
-        assert cb2.params["steps"] == 10
+        s = S()
+        cb = k_elastic.UpdateBatchStateCallback(s)
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            cb.on_epoch_begin(1)
+        assert s.batch == 0
+        assert any("replays from its start" in r.message
+                   for r in caplog.records)
+        # a different epoch (not the interrupted one): no warning
+        s2 = S()
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            k_elastic.UpdateBatchStateCallback(s2).on_epoch_begin(2)
+        assert s2.batch == 3 and not caplog.records
 
     def test_commit_zero_batches_per_commit(self, hvt):
         import horovod_tpu.keras.elastic as k_elastic
@@ -526,6 +578,26 @@ class TestElasticKerasCallbacks:
         assert commits == []  # per-batch commits disabled
         cb.on_epoch_end(0)
         assert commits == [True]
+
+    def test_commit_skips_final_batch_duplicate(self, hvt):
+        # the epoch's final batch defers to the epoch-end commit
+        # (same weights, updated counters) instead of snapshotting
+        # twice back-to-back
+        import horovod_tpu.keras.elastic as k_elastic
+
+        commits = []
+
+        class S:
+            def commit(self):
+                commits.append(True)
+
+        cb = k_elastic.CommitStateCallback(S(), batches_per_commit=1)
+        cb.params = {"steps": 4}
+        for b in range(4):
+            cb.on_batch_end(b)
+        cb.on_epoch_end(0)
+        # batches 0-2 commit; batch 3 (final) skips; epoch end commits
+        assert len(commits) == 4
 
 
 class TestKerasCallbacks:
