@@ -42,3 +42,10 @@ class LightningEstimator:
 class LightningModel:
     def __init__(self, *args, **kwargs):
         raise ImportError(_GUIDANCE)
+
+
+# The reference exports the lightning estimator under this name
+# (horovod/spark/lightning/__init__.py: `from ...estimator import
+# TorchEstimator`) — keep the upstream import path working.
+TorchEstimator = LightningEstimator
+TorchModel = LightningModel

@@ -215,6 +215,15 @@ class TestTorchEstimator:
         with pytest.raises(ImportError, match="TorchEstimator"):
             LightningEstimator(model=object(), num_proc=2)
 
+    def test_lightning_shim_upstream_name(self):
+        # the reference exports the lightning estimator as
+        # horovod.spark.lightning.TorchEstimator — same path here
+        import horovod_tpu.spark.lightning as l
+
+        assert l.TorchEstimator is l.LightningEstimator
+        with pytest.raises(ImportError, match="migration.md"):
+            l.TorchEstimator(model=object())
+
     def test_shard_smaller_than_batch_still_trains(self, tmp_path):
         """The tail batch must train (drop_last=False): 50 rows over 2
         ranks at batch_size=32 means every rank's shard (25 rows) is
