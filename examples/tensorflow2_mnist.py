@@ -23,6 +23,8 @@ def main():
     p.add_argument("--steps", type=int, default=24)
     p.add_argument("--batch-size", type=int, default=64)
     args = p.parse_args()
+    if args.steps < 1:
+        p.error("--steps must be >= 1")
 
     hvd.init()
     np.random.seed(0)
@@ -60,7 +62,8 @@ def main():
         return loss
 
     for step in range(args.steps):
-        i = (step * args.batch_size) % max(len(xs) - args.batch_size, 1)
+        # wrap over the whole shard (the tail batch may be short)
+        i = (step * args.batch_size) % len(xs)
         loss = training_step(
             tf.constant(xs[i:i + args.batch_size]),
             tf.constant(ys[i:i + args.batch_size]), step == 0)

@@ -17,6 +17,17 @@ def main():
     epochs = int(os.environ.get("ELASTIC_EPOCHS", "6"))
     sleep_s = float(os.environ.get("EPOCH_SLEEP", "0.3"))
     state = elastic.ObjectState(epoch=0, total=0.0)
+    # Relaunched-incarnation contract: the run decorator fires reset
+    # callbacks (after sync) when HVTPU_ELASTIC_GENERATION > 0, so
+    # world-size-derived values can be rebuilt — the integration
+    # tests assert this line appears with the POST-resize size.
+    state.register_reset_callbacks([
+        lambda: print(
+            f"RESET_CB rank={hvt.rank()} size={hvt.size()} "
+            f"gen={os.environ.get('HVTPU_ELASTIC_GENERATION')}",
+            flush=True,
+        )
+    ])
 
     @elastic.run
     def train(state):

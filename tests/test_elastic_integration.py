@@ -158,6 +158,10 @@ def test_discovery_grow_resizes_world(tmp_path):
     assert grown, out[-2000:]
     assert any("size=2" in ln for ln in lines), out[-3000:]
     assert "DONE size=3 epoch=10" in out, out[-3000:]
+    # reset callbacks fire in the relaunched incarnation, seeing the
+    # NEW world size (generation-stamped by the driver)
+    assert any("RESET_CB" in ln and "size=3" in ln for ln in lines), \
+        out[-3000:]
     # resume-from-commit: the size-3 incarnation must not replay epoch 0
     sizes_by_epoch = [
         (int(ln.split("epoch=")[1].split()[0]), "size=3" in ln)
