@@ -25,6 +25,8 @@ def main():
     args = p.parse_args()
     if args.steps < 1:
         p.error("--steps must be >= 1")
+    if args.batch_size < 1:
+        p.error("--batch-size must be >= 1")
 
     hvd.init()
     np.random.seed(0)

@@ -1069,3 +1069,50 @@ def test_eager_multidevice_optout_2proc_x_4dev():
     for _, ok, no_lanes in sorted(results):
         assert ok is True
         assert no_lanes
+
+
+def test_eager_collectives_8proc():
+    """World-size-8 smoke across REAL processes — the largest world
+    this sandbox launches (multi-host shape at process granularity):
+    sync + async-fused allreduce, ragged allgather, and a broadcast
+    stay correct and the amortized stall watchdog stays transparent."""
+
+    def body():
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvt
+
+        hvt.init()
+        r, s = hvt.rank(), hvt.size()
+        assert s == 8
+        out = {}
+
+        x = jnp.full((64,), float(r + 1))
+        out["sum"] = float(np.asarray(
+            hvt.allreduce(x, op=hvt.Sum))[0])  # 1+..+8 = 36
+        out["avg"] = float(np.asarray(
+            hvt.allreduce(x, op=hvt.Average))[0])  # 4.5
+
+        # async fused burst through the controller
+        hs = [hvt.allreduce_async(jnp.full((8,), float(r)),
+                                  op=hvt.Sum, name=f"t{i}")
+              for i in range(4)]
+        outs = [float(np.asarray(hvt.synchronize(h))[0]) for h in hs]
+        out["async"] = outs  # sum of ranks 0..7 = 28, every tensor
+
+        g = hvt.allgather(jnp.full((r % 2 + 1, 2), float(r)))
+        out["gather_rows"] = int(np.asarray(g).shape[0])  # 4*1+4*2=12
+
+        b = hvt.broadcast(jnp.full((2,), float(r)), root_rank=5)
+        out["bcast"] = float(np.asarray(b)[0])
+        return (r, out)
+
+    results = _run(body, np=8)
+    assert len(results) == 8
+    for _, out in sorted(results):
+        assert out["sum"] == 36.0
+        assert out["avg"] == 4.5
+        assert out["async"] == [28.0] * 4
+        assert out["gather_rows"] == 12
+        assert out["bcast"] == 5.0
