@@ -120,8 +120,10 @@ def broadcast_variables(variables, root_rank: int = 0, process_set=None):
     if not variables:
         return
     if len(variables) == 1 or not tf.executing_eagerly():
-        _broadcast_variables_graph(variables, root_rank, process_set)
-        return
+        # TF1 session callers run the returned grouped op; tf.function
+        # callers execute the assigns as traced side effects
+        return _broadcast_variables_graph(variables, root_rank,
+                                          process_set)
     from ..comm import eager as _eager_comm
     from ..comm.packing import pack_bytes, unpack_bytes
 
@@ -141,9 +143,12 @@ def _broadcast_variables_graph(variables, root_rank, process_set):
     broadcast once (one engine round-trip per dtype instead of one per
     variable — N py_function hops at graph-mode startup was the
     measured cost), then split and assigned back.  Variables with
-    dynamic shapes fall back to per-variable broadcasts."""
+    dynamic shapes fall back to per-variable broadcasts.  Returns one
+    grouped op so a TF1 session caller can ``session.run`` it
+    (tf.function callers execute the assigns as traced side effects)."""
     by_dtype = {}
     singles = []
+    assigns = []
     for v in variables:
         if v.shape.is_fully_defined():
             by_dtype.setdefault(v.dtype.base_dtype, []).append(v)
@@ -162,12 +167,47 @@ def _broadcast_variables_graph(variables, root_rank, process_set):
         # py_function erases static shape; restore for split
         out = tf.ensure_shape(out, [sum(sizes)])
         for v, part in zip(vs, tf.split(out, sizes)):
-            v.assign(tf.reshape(part, v.shape))
+            assigns.append(v.assign(tf.reshape(part, v.shape)))
     for v in singles:
-        v.assign(
+        assigns.append(v.assign(
             broadcast(tf.convert_to_tensor(v), root_rank=root_rank,
                       process_set=process_set)
-        )
+        ))
+    return tf.group(*assigns)
+
+
+def broadcast_global_variables(root_rank: int = 0):
+    """TF1 parity: ``hvd.broadcast_global_variables(root_rank)`` — an
+    op assigning every variable in the v1 GLOBAL_VARIABLES collection
+    its root-rank value; run it once after session creation."""
+    if tf.executing_eagerly():
+        raise RuntimeError(
+            "broadcast_global_variables() is graph-mode only (the "
+            "global-variables collection is a TF1 concept); use "
+            "broadcast_variables(model.variables, root_rank) eagerly")
+    return _broadcast_variables_graph(
+        tf.compat.v1.global_variables(), root_rank, None)
+
+
+class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+    """TF1 parity: ``hvd.BroadcastGlobalVariablesHook(0)`` — a
+    SessionRunHook for ``tf.compat.v1.train.MonitoredTrainingSession``
+    / tf.estimator that broadcasts rank 0's initial global variables
+    once the session exists (the reference's canonical way to start
+    v1 ranks from identical weights)."""
+
+    def __init__(self, root_rank: int = 0, device: str = ""):
+        super().__init__()
+        self.root_rank = root_rank
+        # accepted for signature parity; placement is engine-side
+        self.device = device
+        self.bcast_op = None
+
+    def begin(self):
+        self.bcast_op = broadcast_global_variables(self.root_rank)
+
+    def after_create_session(self, session, coord):
+        session.run(self.bcast_op)
 
 
 def broadcast_object(obj, root_rank: int = 0, process_set=None):
@@ -348,7 +388,9 @@ __all__ = [
     "allreduce", "grouped_allreduce", "allgather", "grouped_allgather",
     "broadcast", "alltoall", "reducescatter", "grouped_reducescatter",
     "barrier", "join", "elastic",
-    "broadcast_variables", "broadcast_object", "allgather_object",
+    "broadcast_variables", "broadcast_global_variables",
+    "BroadcastGlobalVariablesHook", "broadcast_object",
+    "allgather_object",
     "is_homogeneous", "size_op", "rank_op", "local_rank_op",
     "local_size_op",
     "Compression", "DistributedGradientTape", "DistributedOptimizer",

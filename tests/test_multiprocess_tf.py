@@ -273,6 +273,41 @@ def test_tf_v1_graph_optimizer_minimize_2proc():
     np.testing.assert_allclose(w0, [1.0, -2.0, 0.5], atol=0.15)
 
 
+def test_tf_v1_broadcast_hook_monitored_session_2proc():
+    """TF1 parity: BroadcastGlobalVariablesHook under a
+    MonitoredTrainingSession equalizes rank-dependent initial
+    variables to rank 0's values (the reference's canonical v1
+    startup pattern)."""
+
+    def body():
+        import numpy as np
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvd
+
+        hvd.init()
+        r = hvd.rank()
+        tf1 = tf.compat.v1
+        tf1.disable_eager_execution()
+        g = tf.Graph()
+        with g.as_default():
+            v1 = tf1.get_variable(
+                "a", initializer=tf.fill([2, 2], float(10 + r)))
+            v2 = tf1.get_variable(
+                "b", initializer=tf.fill([3], float(100 + r)))
+            hook = hvd.BroadcastGlobalVariablesHook(0)
+            with tf1.train.MonitoredTrainingSession(
+                    hooks=[hook]) as sess:
+                a, b = sess.run([v1, v2])
+        return (r, a.ravel().tolist(), b.tolist())
+
+    results = run(body, np=2, cpu_devices=1, env=_ENV,
+                  start_timeout=300.0)
+    for r, a, b in results:
+        assert a == [10.0] * 4  # rank 0's init, on both ranks
+        assert b == [100.0] * 3
+
+
 def test_tf_op_matrix_alltoall_reducescatter_sparse_2proc():
     """The remaining TF op matrix across real processes: variable-split
     alltoall, reducescatter (even + uneven), IndexedSlices allreduce,

@@ -97,6 +97,13 @@ class TestTfOps:
         assert hvd_tf.broadcast_object(obj, root_rank=0) == obj
         assert hvd_tf.allgather_object(obj) == [obj]
 
+    def test_broadcast_global_variables_eager_rejected(self, hvt):
+        # TF1 surface: graph-mode only — eager users get pointed at
+        # broadcast_variables instead of a silent empty-collection scan
+        with pytest.raises(RuntimeError, match="graph-mode only"):
+            hvd_tf.broadcast_global_variables(0)
+        assert hasattr(hvd_tf, "BroadcastGlobalVariablesHook")
+
     def test_elastic_module_attribute(self, hvt):
         # parity: examples use `import horovod.tensorflow as hvd;
         # hvd.elastic.run(...)`
