@@ -5,6 +5,11 @@ trick: build a subclass of the user's optimizer class that allreduces
 gradients in ``apply`` before delegating to the original math, then
 rebuild the instance ``from_config``.  Works for Keras 3 (``apply`` is
 the single funnel ``apply_gradients`` and ``model.fit`` go through).
+``make_distributed_class`` exposes the subclass itself for
+``hvd.load_model``, which wraps a freshly-loaded optimizer in place
+(plain-optimizer checkpoints) and registers ``Distributed*`` names as
+custom objects so checkpoints saved from an already-wrapped optimizer
+deserialize (the role of the reference's ``horovod_objects`` dict).
 
 ``backward_passes_per_step > 1`` implements local gradient aggregation
 (parity: horovod/tensorflow/aggregation_helper.py
@@ -18,11 +23,14 @@ IndexedSlices gradients are densified when aggregating.
 from __future__ import annotations
 
 
-def create_distributed_optimizer(optimizer, name=None, compression=None,
-                                 op=None, gradient_predivide_factor=1.0,
-                                 backward_passes_per_step=1,
-                                 average_aggregated_gradients=True,
-                                 process_set=None):
+def make_distributed_class(base_cls, compression=None, op=None,
+                           gradient_predivide_factor=1.0,
+                           backward_passes_per_step=1,
+                           average_aggregated_gradients=True,
+                           process_set=None):
+    """Build the allreduce-wrapping subclass of ``base_cls`` (parity:
+    the class the reference's create_distributed_optimizer generates,
+    factored out so load_model can register it as a custom object)."""
     import tensorflow as tf
 
     from ..tensorflow import Average, allreduce
@@ -36,8 +44,6 @@ def create_distributed_optimizer(optimizer, name=None, compression=None,
         raise ValueError(
             f"backward_passes_per_step must be >= 1, got {bpps}"
         )
-
-    base_cls = optimizer.__class__
 
     class _DistributedOptimizer(base_cls):
         """Allreduce-averaging subclass (parity: _keras
@@ -123,7 +129,77 @@ def create_distributed_optimizer(optimizer, name=None, compression=None,
             return None
 
     _DistributedOptimizer.__name__ = "Distributed" + base_cls.__name__
+    return _DistributedOptimizer
+
+
+def create_distributed_optimizer(optimizer, name=None, compression=None,
+                                 op=None, gradient_predivide_factor=1.0,
+                                 backward_passes_per_step=1,
+                                 average_aggregated_gradients=True,
+                                 process_set=None):
+    cls = make_distributed_class(
+        optimizer.__class__, compression=compression, op=op,
+        gradient_predivide_factor=gradient_predivide_factor,
+        backward_passes_per_step=backward_passes_per_step,
+        average_aggregated_gradients=average_aggregated_gradients,
+        process_set=process_set,
+    )
     config = optimizer.get_config()
     if name is not None:
         config["name"] = name
-    return _DistributedOptimizer.from_config(config)
+    return cls.from_config(config)
+
+
+def load_model_impl(keras_module, filepath, custom_optimizers=None,
+                    custom_objects=None, compression=None):
+    """Parity: horovod/_keras/__init__.py ``_load_model`` — load a
+    saved keras model and wrap its optimizer in the distributed
+    subclass, preserving the saved optimizer state (iterations, slot
+    variables).
+
+    Keras 3 resolves BUILT-IN optimizer classes by module path and
+    never consults custom_objects for them, so a plain-optimizer
+    checkpoint is wrapped AFTER load: swap the live optimizer's class
+    to the generated subclass in place (same instance, all restored
+    variables untouched), falling back to rebuild-from-config +
+    variable copy for optimizers whose layout rejects the swap.  A
+    checkpoint saved from an ALREADY-wrapped optimizer records
+    ``Distributed<Base>`` under this module — those names ARE looked
+    up in custom_objects, so they're pre-registered here (the
+    reference's horovod_objects role); ``custom_optimizers`` extends
+    that registry with user optimizer classes."""
+    horovod_objects = {}
+    opt_classes = list(custom_optimizers or [])
+    base = keras_module.optimizers.Optimizer
+    for name in dir(keras_module.optimizers):
+        cls = getattr(keras_module.optimizers, name)
+        if isinstance(cls, type) and issubclass(cls, base) \
+                and cls is not base:
+            opt_classes.append(cls)
+    for cls in opt_classes:
+        horovod_objects["Distributed" + cls.__name__] = \
+            make_distributed_class(cls, compression=compression)
+    horovod_objects.update(custom_objects or {})
+    model = keras_module.models.load_model(
+        filepath, custom_objects=horovod_objects)
+    opt = getattr(model, "optimizer", None)
+    if opt is None or getattr(opt, "_hvtpu_distributed", False):
+        return model
+    cls = make_distributed_class(opt.__class__,
+                                 compression=compression)
+    try:
+        opt.__class__ = cls
+    except TypeError:
+        new_opt = cls.from_config(opt.get_config())
+        if getattr(opt, "built", False):
+            new_opt.build(model.trainable_variables)
+            if len(new_opt.variables) != len(opt.variables):
+                raise ValueError(
+                    f"optimizer rebuild produced "
+                    f"{len(new_opt.variables)} variables vs "
+                    f"{len(opt.variables)} loaded — refusing a "
+                    "partial state copy")
+            for dst, src in zip(new_opt.variables, opt.variables):
+                dst.assign(src)
+        model.optimizer = new_opt
+    return model

@@ -91,3 +91,21 @@ def DistributedOptimizer(optimizer, name=None,
         average_aggregated_gradients=average_aggregated_gradients,
         process_set=process_set,
     )
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none):
+    """Load a saved keras model with its optimizer wrapped in
+    ``DistributedOptimizer`` (parity: horovod.keras.load_model /
+    horovod.tensorflow.keras.load_model).  The optimizer deserializes
+    INTO the wrapped class, so saved optimizer state (iterations,
+    Adam m/v slots) restores and subsequent fits allreduce gradients
+    — resuming a single-rank checkpoint distributed is the
+    reference's canonical use."""
+    import keras
+
+    from .._keras import load_model_impl
+
+    return load_model_impl(
+        keras, filepath, custom_optimizers=custom_optimizers,
+        custom_objects=custom_objects, compression=compression)

@@ -273,6 +273,47 @@ def test_tf_v1_graph_optimizer_minimize_2proc():
     np.testing.assert_allclose(w0, [1.0, -2.0, 0.5], atol=0.15)
 
 
+def test_keras_load_model_lockstep_2proc(tmp_path):
+    """hvd.load_model across real ranks: every rank loads the same
+    checkpoint, refits on rank-dependent data, and the wrapped
+    optimizer's gradient averaging keeps weights in lockstep."""
+    import numpy as np
+
+    save_dir = str(tmp_path)
+
+    def body(save_dir):
+        import keras
+        import numpy as np
+
+        import horovod_tpu.tensorflow.keras as hvd
+
+        hvd.init()
+        r = hvd.rank()
+        path = save_dir + "/shared.keras"
+        if r == 0:
+            keras.utils.set_random_seed(0)
+            m = keras.Sequential([
+                keras.layers.Input((4,)), keras.layers.Dense(1)])
+            m.compile(optimizer=keras.optimizers.Adam(0.05),
+                      loss="mse")
+            x0 = np.random.rand(32, 4).astype(np.float32)
+            m.fit(x0, x0.sum(1, keepdims=True), epochs=1, verbose=0)
+            m.save(path)
+        hvd.allreduce(np.zeros(1), op=hvd.Sum)  # save barrier
+        m = hvd.load_model(path)
+        assert m.optimizer._hvtpu_distributed
+        rng = np.random.RandomState(10 + r)  # rank-DEPENDENT data
+        x = rng.rand(64, 4).astype(np.float32)
+        y = x.sum(1, keepdims=True)
+        m.fit(x, y, batch_size=16, epochs=1, verbose=0)
+        return (r, [float(w.sum()) for w in m.get_weights()])
+
+    results = run(body, args=(save_dir,), np=2, cpu_devices=1,
+                  env=_ENV, start_timeout=300.0)
+    (r0, w0), (r1, w1) = sorted(results)
+    np.testing.assert_allclose(w0, w1, rtol=1e-5)
+
+
 def test_tf_v1_broadcast_hook_monitored_session_2proc():
     """TF1 parity: BroadcastGlobalVariablesHook under a
     MonitoredTrainingSession equalizes rank-dependent initial

@@ -495,6 +495,72 @@ class TestTensorFlowKerasState:
             state._apply({"__opt_vars__": [np.zeros(1)]})
 
 
+class TestLoadModel:
+    def test_load_model_wraps_and_preserves_state(self, hvt, tmp_path):
+        # parity: hvd.load_model — the optimizer comes back as the
+        # Distributed* subclass with saved state (iterations, Adam
+        # slots) intact, and refit runs through the allreduce path
+        model = keras.Sequential([
+            keras.layers.Input((4,)), keras.layers.Dense(2)])
+        model.compile(optimizer=keras.optimizers.Adam(0.01),
+                      loss="mse")
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 4).astype(np.float32)
+        y = rng.rand(32, 2).astype(np.float32)
+        model.fit(x, y, epochs=2, verbose=0)
+        it0 = int(model.optimizer.iterations)
+        path = str(tmp_path / "m.keras")
+        model.save(path)
+
+        m2 = hvd_keras.load_model(path)
+        assert type(m2.optimizer).__name__ == "DistributedAdam"
+        assert m2.optimizer._hvtpu_distributed
+        assert int(m2.optimizer.iterations) == it0
+        slots = [v for v in m2.optimizer.variables
+                 if "momentum" in v.path or "velocity" in v.path]
+        assert slots and any(
+            float(np.abs(np.asarray(v)).max()) > 0 for v in slots)
+        m2.fit(x, y, epochs=1, verbose=0)
+        assert int(m2.optimizer.iterations) == it0 + 1
+
+    def test_load_model_roundtrips_wrapped_checkpoint(
+            self, hvt, tmp_path):
+        # a checkpoint SAVED from an already-wrapped optimizer
+        # (class_name 'DistributedAdam') must reload: the wrapped
+        # names are pre-registered as custom objects
+        model = keras.Sequential([
+            keras.layers.Input((4,)), keras.layers.Dense(2)])
+        model.compile(
+            optimizer=hvd_keras.DistributedOptimizer(
+                keras.optimizers.Adam(0.01)),
+            loss="mse")
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 4).astype(np.float32)
+        y = rng.rand(32, 2).astype(np.float32)
+        model.fit(x, y, epochs=2, verbose=0)
+        path = str(tmp_path / "wrapped.keras")
+        model.save(path)
+        m2 = hvd_keras.load_model(path)
+        assert m2.optimizer._hvtpu_distributed
+        assert int(m2.optimizer.iterations) == 2
+        m2.fit(x, y, epochs=1, verbose=0)
+        assert int(m2.optimizer.iterations) == 3
+
+    def test_load_model_available_on_tf_keras_path(self, hvt):
+        import horovod_tpu.tensorflow.keras as hvd_tfk
+
+        assert hvd_tfk.load_model is hvd_keras.load_model
+
+    def test_load_model_without_optimizer(self, hvt, tmp_path):
+        model = keras.Sequential([
+            keras.layers.Input((2,)), keras.layers.Dense(1)])
+        path = str(tmp_path / "bare.keras")
+        model.save(path)
+        m2 = hvd_keras.load_model(path)
+        assert getattr(m2, "optimizer", None) is None \
+            or not getattr(m2.optimizer, "_hvtpu_distributed", False)
+
+
 class TestElasticKerasCallbacks:
     """Parity: horovod/_keras/elastic.py — the callbacks the
     reference's elastic keras examples drive model.fit with."""
