@@ -169,13 +169,15 @@ def load_model_impl(keras_module, filepath, custom_optimizers=None,
     reference's horovod_objects role); ``custom_optimizers`` extends
     that registry with user optimizer classes."""
     horovod_objects = {}
-    opt_classes = list(custom_optimizers or [])
     base = keras_module.optimizers.Optimizer
-    for name in dir(keras_module.optimizers):
-        cls = getattr(keras_module.optimizers, name)
-        if isinstance(cls, type) and issubclass(cls, base) \
-                and cls is not base:
-            opt_classes.append(cls)
+    opt_classes = [
+        cls for name in dir(keras_module.optimizers)
+        if isinstance(cls := getattr(keras_module.optimizers, name),
+                      type) and issubclass(cls, base) and cls is not base
+    ]
+    # user classes LAST so a name collision resolves to the user's
+    # optimizer (reference horovod_objects.update order)
+    opt_classes.extend(custom_optimizers or [])
     for cls in opt_classes:
         horovod_objects["Distributed" + cls.__name__] = \
             make_distributed_class(cls, compression=compression)

@@ -56,6 +56,28 @@ def test_cli_tf_keras_mnist_2proc():
     assert "ranks consistent (2 ranks)" in res.stdout
 
 
+def test_cli_torch_adasum_2proc():
+    res = _hvtpurun([
+        "-np", "2", "--cpu-devices", "1", "--",
+        sys.executable,
+        os.path.join(_REPO, "examples", "pytorch_mnist_adasum.py"),
+        "--epochs", "1", "--train-size", "256", "--batch-size", "64",
+    ])
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "ranks consistent (2 ranks)" in res.stdout
+
+
+def test_cli_tf2_custom_loop_2proc():
+    res = _hvtpurun([
+        "-np", "2", "--cpu-devices", "1", "--",
+        sys.executable,
+        os.path.join(_REPO, "examples", "tensorflow2_mnist.py"),
+        "--steps", "8",
+    ], timeout=420)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "ranks consistent (2 ranks)" in res.stdout
+
+
 def test_cli_failure_exit_code():
     res = _hvtpurun([
         "-np", "2", "--cpu-devices", "1", "--",
