@@ -27,6 +27,17 @@ from jax.sharding import Mesh
 from .topology import PROC_AXIS, Topology
 
 
+class _CallableInt(int):
+    """An int answering reference method-call syntax: upstream's
+    ProcessSet exposes size()/rank() as METHODS while this engine
+    reads them as values — ``x`` and ``x()`` both yield the count."""
+
+    __slots__ = ()
+
+    def __call__(self) -> int:
+        return int(self)
+
+
 class ProcessSet:
     """A subset of ranks (processes) that collectives can be scoped to.
 
@@ -49,9 +60,25 @@ class ProcessSet:
             self.ranks = list(range(world_size))
 
     @property
-    def size(self) -> int:
+    def size(self) -> "_CallableInt":
+        """Member count.  An int that is ALSO callable: the engine
+        reads ``ps.size`` as a value while reference-ported user code
+        calls ``ps.size()`` (upstream ProcessSet.size is a method) —
+        both work."""
         assert self.ranks is not None
-        return len(self.ranks)
+        return _CallableInt(len(self.ranks))
+
+    @property
+    def rank(self):
+        """THIS process's rank within the set (parity:
+        ProcessSet.rank()): a callable int for members, None when this
+        process is not in the set (upstream returns None likewise).
+        Works both as ``ps.rank`` and reference-style ``ps.rank()``."""
+        from . import state as _state
+
+        st = _state.require_init("ProcessSet.rank")
+        r = self.rank_in_set(st.rank)
+        return None if r < 0 else _CallableInt(r)
 
     def rank_in_set(self, global_rank: int) -> int:
         """Position of ``global_rank`` within the set (-1 if absent)."""
@@ -61,7 +88,13 @@ class ProcessSet:
         except ValueError:
             return -1
 
-    def included(self, global_rank: int) -> bool:
+    def included(self, global_rank: Optional[int] = None) -> bool:
+        """Membership test (parity: ProcessSet.included() asks about
+        THIS process; passing a global rank asks about that one)."""
+        if global_rank is None:
+            from . import state as _state
+
+            global_rank = _state.require_init("ProcessSet.included").rank
         return self.rank_in_set(global_rank) >= 0
 
     def proc_mesh(self) -> Mesh:

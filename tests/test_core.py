@@ -109,6 +109,36 @@ class TestProcessSets:
         assert g.included(0)
         assert g.size == 1
 
+    def test_reference_method_call_syntax(self, hvt):
+        # upstream ProcessSet exposes size()/rank()/included() as
+        # no-arg METHODS; the engine reads size as a value — both
+        # spellings must work on the same object
+        g = hvt.global_process_set
+        assert g.size == 1 and g.size() == 1
+        assert g.rank == 0 and g.rank() == 0
+        assert g.included() is True
+        assert g.included(0) is True
+        # a set this process is NOT in: rank is None, included False
+        ns = ProcessSet([0])
+        ns.ranks = [7]  # simulate membership elsewhere (1-proc world)
+        ns._topology = g._topology
+        assert ns.rank is None
+        assert ns.included() is False
+
+    def test_rank_and_included_require_init(self):
+        import horovod_tpu as mod
+        from horovod_tpu.core.exceptions import NotInitializedError
+
+        if mod.is_initialized():
+            mod.shutdown()
+        ps = ProcessSet([0])
+        ps.ranks = [0]
+        with pytest.raises(NotInitializedError):
+            _ = ps.rank
+        with pytest.raises(NotInitializedError):
+            ps.included()
+        assert ps.included(0)  # explicit-rank query needs no init
+
     def test_duplicate_set_rejected(self, hvt):
         # [0] duplicates the global set's ranks in a 1-process world.
         with pytest.raises(ValueError):
