@@ -194,6 +194,14 @@ class KerasEstimator(HorovodEstimator):
             raise ValueError("optimizer param is required")
         if self.getLoss() is None:
             raise ValueError("loss param is required")
+        if self.getSampleWeightCol() is not None \
+                and self.getTransformationFn() is not None:
+            raise ValueError(
+                "sample_weight_col cannot be combined with "
+                "transformation_fn: the transform may reorder or "
+                "resize rows and the weight column would silently "
+                "misalign; fold the weighting into the "
+                "transformation instead")
 
     def _serialize_training_spec(self) -> Dict[str, Any]:
         import cloudpickle
