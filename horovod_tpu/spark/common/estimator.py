@@ -76,6 +76,14 @@ class HorovodEstimator(EstimatorParams):
                 "run_id (each fit otherwise generates a fresh run id "
                 "whose checkpoint path cannot exist — the resume "
                 "would silently no-op)")
+        if self.getSampleWeightCol() is not None \
+                and self.getTransformationFn() is not None:
+            raise ValueError(
+                "sample_weight_col cannot be combined with "
+                "transformation_fn: the transform may reorder or "
+                "resize rows and the weight column would silently "
+                "misalign; fold the weighting into the "
+                "transformation instead")
 
     def _resolve_backend(self) -> Backend:
         backend = self.getBackend()

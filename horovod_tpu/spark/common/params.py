@@ -90,6 +90,13 @@ class EstimatorParams(Params):
         "validation": None,         # float fraction | indicator column
         "sample_weight_col": None,
         "compression": None,
+        # reference spelling of the same knob (horovod estimators name
+        # it gradient_compression); either works, reference wins when
+        # both are set
+        "gradient_compression": None,
+        # per-output loss scaling for multi-output models (reference:
+        # loss_weights on both estimators)
+        "loss_weights": None,
         "batch_size": 32,
         "val_batch_size": None,
         "epochs": 1,

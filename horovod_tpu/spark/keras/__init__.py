@@ -72,8 +72,11 @@ def _keras_trainer(spec: Dict[str, Any]):
     model.compile(
         optimizer=hvd.DistributedOptimizer(
             optimizer,
-            compression=resolve_compression(hvd, p.get("compression"))),
+            compression=resolve_compression(
+                hvd, p.get("gradient_compression")
+                or p.get("compression"))),
         loss=loss, metrics=metrics or None,
+        loss_weights=p.get("loss_weights"),
         weighted_metrics=None,
     )
 
@@ -121,9 +124,16 @@ def _keras_trainer(spec: Dict[str, Any]):
             "desync); grow the validation split or reduce num_proc",
             spec["n_val"], hvd.size())
     if spec["n_val"] >= hvd.size():
-        fit_kwargs["validation_data"] = xy(
-            load_shard(store.get_val_data_path(), VAL_NPZ,
-                       hvd.rank(), hvd.size()))
+        vshard = load_shard(store.get_val_data_path(), VAL_NPZ,
+                            hvd.rank(), hvd.size())
+        vx, vy = xy(vshard)
+        if p.get("sample_weight_col"):
+            # weighted val_loss, matching the torch trainer's
+            # weighted validation for the same param
+            fit_kwargs["validation_data"] = (
+                vx, vy, vshard[p["sample_weight_col"]])
+        else:
+            fit_kwargs["validation_data"] = (vx, vy)
         if p.get("validation_steps_per_epoch") is not None:
             fit_kwargs["validation_steps"] = \
                 p["validation_steps_per_epoch"]
@@ -194,14 +204,11 @@ class KerasEstimator(HorovodEstimator):
             raise ValueError("optimizer param is required")
         if self.getLoss() is None:
             raise ValueError("loss param is required")
-        if self.getSampleWeightCol() is not None \
-                and self.getTransformationFn() is not None:
+        lw = self.getLossWeights()
+        if lw is not None and len(lw) != len(self.getLabelCols() or []):
             raise ValueError(
-                "sample_weight_col cannot be combined with "
-                "transformation_fn: the transform may reorder or "
-                "resize rows and the weight column would silently "
-                "misalign; fold the weighting into the "
-                "transformation instead")
+                f"loss_weights has {len(lw)} entries for "
+                f"{len(self.getLabelCols() or [])} output column(s)")
 
     def _serialize_training_spec(self) -> Dict[str, Any]:
         import cloudpickle

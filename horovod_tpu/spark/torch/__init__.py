@@ -144,8 +144,16 @@ def _torch_trainer(spec: Dict[str, Any]):
     bps = p.get("backward_passes_per_step") or 1
     optimizer = hvd.DistributedOptimizer(
         optimizer, named_parameters=model.named_parameters(),
-        compression=resolve_compression(hvd, p.get("compression")),
+        compression=resolve_compression(
+            hvd, p.get("gradient_compression") or p.get("compression")),
         backward_passes_per_step=bps)
+
+    # per-output loss scaling (reference: loss_weights); None = 1.0
+    loss_weights = p.get("loss_weights")
+    if loss_weights is not None and len(loss_weights) != len(loss_fns):
+        raise ValueError(
+            f"loss_weights has {len(loss_weights)} entries for "
+            f"{len(loss_fns)} loss function(s)")
 
     def forward_loss(feat_batch, label_batch, weight_batch=None):
         outputs = model(*feat_batch)
@@ -155,6 +163,8 @@ def _torch_trainer(spec: Dict[str, Any]):
             fn(o, y) if weight_batch is None else fn(o, y, weight_batch)
             for fn, o, y in zip(loss_fns, outputs, label_batch)
         ]
+        if loss_weights is not None:
+            losses = [w * l for w, l in zip(loss_weights, losses)]
         return outputs, sum(losses)
 
     batch_size = p["batch_size"]
@@ -285,6 +295,8 @@ class TorchEstimator(HorovodEstimator):
             loss = self.getLoss()
             fns = list(loss) if isinstance(loss, (list, tuple)) \
                 else [loss]
+            weight_names = {"weight", "weights", "sample_weight",
+                            "sample_weights", "sw", "w"}
             for fn in fns:
                 # nn.Module.__call__ is (*args, **kwargs): the real
                 # arity lives on forward
@@ -300,19 +312,32 @@ class TorchEstimator(HorovodEstimator):
                     q for q in params
                     if q.kind in (q.POSITIONAL_ONLY,
                                   q.POSITIONAL_OR_KEYWORD)]
-                if len(positional) < 3:
+                # the weight batch binds to the THIRD positional slot;
+                # that slot must clearly be a weight: either required
+                # (no default) or weight-named.  This rejects losses
+                # like F.mse_loss, whose third slot is the defaulted
+                # legacy `size_average` — the weight tensor would bind
+                # there and crash (or silently train unweighted for a
+                # defaulted `eps`-style third arg).
+                third_ok = len(positional) >= 3 and (
+                    positional[2].default is positional[2].empty
+                    or positional[2].name.lower() in weight_names)
+                if not third_ok:
                     raise ValueError(
                         f"sample_weight_col is set but loss "
-                        f"{getattr(fn, '__name__', fn)!r} accepts only "
-                        f"{len(positional)} positional args — it must "
-                        "accept (output, label, sample_weight)")
-            if self.getTransformationFn() is not None:
+                        f"{getattr(fn, '__name__', fn)!r} does not "
+                        "take a sample-weight third argument — it "
+                        "must accept (output, label, sample_weight) "
+                        "with the third parameter required or named "
+                        "like a weight")
+        lw = self.getLossWeights()
+        if lw is not None:
+            loss = self.getLoss()
+            n_fns = len(loss) if isinstance(loss, (list, tuple)) else 1
+            if len(lw) != n_fns:
                 raise ValueError(
-                    "sample_weight_col cannot be combined with "
-                    "transformation_fn: the transform may reorder or "
-                    "resize rows and the weight column would silently "
-                    "misalign; fold the weighting into the "
-                    "transformation instead")
+                    f"loss_weights has {len(lw)} entries for {n_fns} "
+                    "loss function(s)")
 
     def _serialize_training_spec(self) -> Dict[str, Any]:
         import cloudpickle

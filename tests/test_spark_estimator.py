@@ -267,6 +267,49 @@ class TestTorchEstimator:
         # pulled toward the +25 poisoned rows: far from clean labels
         assert float(((upred - y) ** 2).mean()) > clean_mse * 10
 
+    def test_loss_weights_and_gradient_compression_params(
+            self, tmp_path):
+        """Reference param spellings: loss_weights scales each
+        output's loss (exactly 2x on the first step), and
+        gradient_compression is accepted alongside compression."""
+        import torch
+        import torch.nn as nn
+        import torch.nn.functional as F
+
+        from horovod_tpu.spark import TorchEstimator
+
+        df, _x, _y = _regression_frame()
+
+        def make_est(run_id, **kw):
+            model = nn.Sequential(nn.Linear(4, 1))
+            torch.manual_seed(3)
+            for m in model:
+                if hasattr(m, "reset_parameters"):
+                    m.reset_parameters()
+            return TorchEstimator(
+                model=model,
+                optimizer=torch.optim.SGD(model.parameters(), lr=0.1),
+                loss=F.mse_loss, feature_cols=["features"],
+                label_cols=["label"], batch_size=32, epochs=1,
+                train_steps_per_epoch=1, num_proc=2, verbose=0,
+                random_seed=7, run_id=run_id,
+                store=LocalStore(str(tmp_path)), **kw)
+
+        base = make_est("lw_base").fit(df).getHistory()["loss"][0]
+        doubled = make_est(
+            "lw_x2", loss_weights=[2.0]).fit(df).getHistory()["loss"][0]
+        assert abs(doubled - 2.0 * base) < 1e-4 * max(abs(base), 1.0)
+
+        # reference spelling of the compression knob
+        est = make_est("gc_fp16", gradient_compression="fp16")
+        assert est.getGradientCompression() == "fp16"
+        h = est.fit(df).getHistory()["loss"]
+        assert len(h) == 1
+
+        # mismatched loss_weights length fails loudly
+        with pytest.raises(Exception, match="loss_weights"):
+            make_est("lw_bad", loss_weights=[1.0, 2.0]).fit(df)
+
     def test_sample_weight_col_driver_side_guards(self, tmp_path):
         import torch
         import torch.nn as nn
