@@ -66,6 +66,7 @@ from .mpi_ops import (  # noqa: E402
     reducescatter,
 )
 from . import elastic  # noqa: E402
+from .sync_batch_norm import SyncBatchNormalization  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -387,7 +388,7 @@ __all__ = [
     "Sum", "Average", "Adasum", "Min", "Max", "Product",
     "allreduce", "grouped_allreduce", "allgather", "grouped_allgather",
     "broadcast", "alltoall", "reducescatter", "grouped_reducescatter",
-    "barrier", "join", "elastic",
+    "barrier", "join", "elastic", "SyncBatchNormalization",
     "broadcast_variables", "broadcast_global_variables",
     "BroadcastGlobalVariablesHook", "broadcast_object",
     "allgather_object",

@@ -1,0 +1,92 @@
+"""Cross-rank synchronized BatchNormalization for tf.keras (parity:
+``horovod/tensorflow/sync_batch_norm.py`` ``SyncBatchNormalization``).
+
+The reference subclasses the keras BatchNormalization layer and
+replaces its batch-moment computation with a cross-rank one
+(``_calculate_mean_and_var`` in tf.keras 2); Keras 3 exposes the same
+seam as ``_moments``.  Training-mode statistics are computed over the
+GLOBAL batch: local (sum, sum-of-squares, count) ride ONE fused
+allreduce — the same wire structure as the torch frontend's
+``SyncBatchNorm`` — and the backward differentiates through the
+allreduce via the registered collective gradients, so the gradient
+sums match cross-rank batchnorm semantics without a hand-written
+adjoint.  Moving-average updates and inference mode are inherited
+unchanged from the base layer.
+"""
+
+from __future__ import annotations
+
+import keras
+import tensorflow as tf
+
+from . import mpi_ops
+
+
+class SyncBatchNormalization(keras.layers.BatchNormalization):
+    """Drop-in for ``keras.layers.BatchNormalization`` whose training
+    statistics span every rank's batch (parity:
+    hvd.SyncBatchNormalization; ``process_set`` scopes the stats to a
+    subset of ranks)."""
+
+    def __init__(self, *args, process_set=None, **kwargs):
+        # the cross-rank hook lives on the Keras 3 `_moments` seam; a
+        # base class without it (keras 2's layer uses
+        # _calculate_mean_and_var) would silently train on LOCAL stats
+        if not hasattr(keras.layers.BatchNormalization, "_moments"):
+            raise RuntimeError(
+                "SyncBatchNormalization requires Keras 3 "
+                "(keras.layers.BatchNormalization._moments seam not "
+                "found)")
+        super().__init__(*args, **kwargs)
+        # a ProcessSet object, or its id (what get_config round-trips
+        # — the engine resolves ids against the live table)
+        self._process_set = process_set
+
+    def get_config(self):
+        config = super().get_config()
+        ps = self._process_set
+        config["process_set"] = (
+            ps if ps is None or isinstance(ps, int)
+            else ps.process_set_id)
+        return config
+
+    def _moments(self, inputs, mask):
+        import horovod_tpu as _hvt
+        from ..core.process_set import participant_count
+
+        if not _hvt.is_initialized() \
+                or participant_count(self._process_set) == 1:
+            # single rank: base-layer semantics
+            return super()._moments(inputs, mask)
+        if mask is not None:
+            # falling back to local masked moments would silently
+            # desync the ranks' statistics — refuse loudly
+            raise NotImplementedError(
+                "SyncBatchNormalization does not support masked "
+                "moments in multi-rank training")
+
+        x = tf.cast(inputs, tf.float32)
+        axes = list(self._reduction_axes)
+        local_sum = tf.reduce_sum(x, axis=axes)
+        local_sqsum = tf.reduce_sum(tf.square(x), axis=axes)
+        # per-rank row counts may differ (ragged final batches): the
+        # count rides the same fused collective as the sums
+        local_count = tf.cast(
+            tf.size(x) / tf.size(local_sum), tf.float32)
+        c = tf.size(local_sum)
+        packed = tf.concat(
+            [local_sum, local_sqsum, tf.reshape(local_count, [1])], 0)
+        packed = mpi_ops.allreduce(
+            packed, op=mpi_ops.Sum, name="sync_bn.stats",
+            process_set=self._process_set)
+        g_sum = packed[:c]
+        g_sqsum = packed[c:2 * c]
+        g_count = packed[2 * c]
+        mean = g_sum / g_count
+        # E[x^2]-E[x]^2 can go fractionally negative via float32
+        # cancellation when |mean| >> std — rsqrt(var+eps) would then
+        # poison the moving stats with NaN
+        variance = tf.maximum(
+            g_sqsum / g_count - tf.square(mean), 0.0)
+        return (tf.cast(mean, inputs.dtype),
+                tf.cast(variance, inputs.dtype))

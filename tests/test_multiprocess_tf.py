@@ -273,6 +273,58 @@ def test_tf_v1_graph_optimizer_minimize_2proc():
     np.testing.assert_allclose(w0, [1.0, -2.0, 0.5], atol=0.15)
 
 
+def test_sync_batch_normalization_2proc():
+    """SyncBatchNormalization across real ranks: each rank holds half
+    the global batch, and the layer's training output + moving stats
+    must equal a single-process BatchNormalization over the FULL batch
+    (parity: hvd.SyncBatchNormalization)."""
+    import numpy as np
+
+    def body():
+        import keras
+        import numpy as np
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvd
+
+        hvd.init()
+        r = hvd.rank()
+        rng = np.random.RandomState(0)
+        full = rng.rand(16, 4).astype(np.float32) * 2 + 3
+        mine = full[r * 8:(r + 1) * 8]
+
+        sbn = hvd.SyncBatchNormalization(momentum=0.9)
+        with tf.GradientTape() as tape:
+            y = sbn(tf.constant(mine), training=True)
+            loss = tf.reduce_sum(tf.square(y))
+        g_gamma, _ = tape.gradient(loss, sbn.trainable_variables)
+        return (r, y.numpy().tolist(),
+                sbn.moving_mean.numpy().tolist(),
+                sbn.moving_variance.numpy().tolist(),
+                g_gamma.numpy().tolist())
+
+    results = run(body, np=2, cpu_devices=1, env=_ENV,
+                  start_timeout=300.0)
+    import keras
+
+    rng = np.random.RandomState(0)
+    full = rng.rand(16, 4).astype(np.float32) * 2 + 3
+    bn = keras.layers.BatchNormalization(momentum=0.9)
+    ref = bn(full, training=True).numpy()
+    for r, y, mm, mv, gg in sorted(results):
+        # per-rank output equals the full-batch BN's matching slice
+        np.testing.assert_allclose(
+            np.asarray(y), ref[r * 8:(r + 1) * 8],
+            rtol=1e-4, atol=1e-4)
+        # moving stats reflect GLOBAL batch statistics on every rank
+        np.testing.assert_allclose(np.asarray(mm),
+                                   bn.moving_mean.numpy(), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(mv),
+                                   bn.moving_variance.numpy(),
+                                   rtol=1e-4)
+        assert all(np.isfinite(gg))
+
+
 def test_keras_load_model_lockstep_2proc(tmp_path):
     """hvd.load_model across real ranks: every rank loads the same
     checkpoint, refits on rank-dependent data, and the wrapped

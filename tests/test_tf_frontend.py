@@ -495,6 +495,44 @@ class TestTensorFlowKerasState:
             state._apply({"__opt_vars__": [np.zeros(1)]})
 
 
+class TestSyncBatchNormalization:
+    def test_single_rank_matches_vanilla_bn(self, hvt):
+        # size-1 world: identical outputs AND identical moving-stat
+        # updates as the base keras layer
+        rng = np.random.RandomState(0)
+        x = tf.constant(rng.rand(8, 4).astype(np.float32) * 3 + 1)
+        sbn = hvd_tf.SyncBatchNormalization(momentum=0.9)
+        bn = keras.layers.BatchNormalization(momentum=0.9)
+        y_s = sbn(x, training=True)
+        y_v = bn(x, training=True)
+        np.testing.assert_allclose(y_s.numpy(), y_v.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(sbn.moving_mean.numpy(),
+                                   bn.moving_mean.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(sbn.moving_variance.numpy(),
+                                   bn.moving_variance.numpy(),
+                                   rtol=1e-5)
+
+    def test_gradients_flow(self, hvt):
+        x = tf.constant(
+            np.random.RandomState(1).rand(8, 3).astype(np.float32))
+        sbn = hvd_tf.SyncBatchNormalization()
+        with tf.GradientTape() as tape:
+            y = sbn(x, training=True)
+            loss = tf.reduce_sum(y * y)
+        grads = tape.gradient(loss, sbn.trainable_variables)
+        assert len(grads) == 2 and all(g is not None for g in grads)
+
+    def test_config_roundtrips_process_set_id(self, hvt):
+        sbn = hvd_tf.SyncBatchNormalization(
+            momentum=0.8, process_set=hvd_tf.global_process_set)
+        cfg = sbn.get_config()
+        assert cfg["process_set"] == 0  # serialized as the set id
+        assert cfg["momentum"] == 0.8
+        rebuilt = hvd_tf.SyncBatchNormalization.from_config(cfg)
+        assert rebuilt._process_set == 0  # engine resolves ids
+
+
 class TestLoadModel:
     def test_load_model_wraps_and_preserves_state(self, hvt, tmp_path):
         # parity: hvd.load_model — the optimizer comes back as the
