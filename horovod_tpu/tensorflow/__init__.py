@@ -218,6 +218,19 @@ def broadcast_object(obj, root_rank: int = 0, process_set=None):
                                        process_set=process_set)
 
 
+def broadcast_object_fn(root_rank: int = 0, session=None, name=None,
+                        process_set=None):
+    """Parity: hvd.broadcast_object_fn — returns a callable
+    ``bcast(obj)`` bound to the given root (``session`` and ``name``
+    accepted for reference signature compatibility; the engine
+    broadcast is session-free and self-naming)."""
+    def _bcast(obj):
+        return broadcast_object(obj, root_rank=root_rank,
+                                process_set=process_set)
+
+    return _bcast
+
+
 def allgather_object(obj, process_set=None):
     from ..api import functions as _functions
 
@@ -391,7 +404,7 @@ __all__ = [
     "barrier", "join", "elastic", "SyncBatchNormalization",
     "broadcast_variables", "broadcast_global_variables",
     "BroadcastGlobalVariablesHook", "broadcast_object",
-    "allgather_object",
+    "broadcast_object_fn", "allgather_object",
     "is_homogeneous", "size_op", "rank_op", "local_rank_op",
     "local_size_op",
     "Compression", "DistributedGradientTape", "DistributedOptimizer",

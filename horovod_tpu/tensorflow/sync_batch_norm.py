@@ -45,9 +45,16 @@ class SyncBatchNormalization(keras.layers.BatchNormalization):
     def get_config(self):
         config = super().get_config()
         ps = self._process_set
-        config["process_set"] = (
-            ps if ps is None or isinstance(ps, int)
-            else ps.process_set_id)
+        if ps is not None and not isinstance(ps, int):
+            if ps.process_set_id is None:
+                # an unbound set would serialize as None and silently
+                # widen the reloaded layer to the GLOBAL set
+                raise ValueError(
+                    "SyncBatchNormalization's process_set is not "
+                    "registered — call hvd.add_process_set(ps) (after "
+                    "init) before serializing the model")
+            ps = ps.process_set_id
+        config["process_set"] = ps
         return config
 
     def _moments(self, inputs, mask):

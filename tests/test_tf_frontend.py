@@ -92,6 +92,11 @@ class TestTfOps:
         hvd_tf.broadcast_variables([v1, v2], root_rank=0)
         np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
 
+    def test_broadcast_object_fn(self, hvt):
+        # parity: hvd.broadcast_object_fn returns a bound bcast(obj)
+        bcast = hvd_tf.broadcast_object_fn(root_rank=0)
+        assert bcast({"k": 7}) == {"k": 7}
+
     def test_broadcast_object_roundtrip(self, hvt):
         obj = {"step": 12, "name": "x"}
         assert hvd_tf.broadcast_object(obj, root_rank=0) == obj
