@@ -41,3 +41,15 @@ def hvt():
 @pytest.fixture(scope="session")
 def world_axis():
     return "world"
+
+
+def make_discovery_script(tmp_path, spec: str):
+    """Shared elastic-driver discovery fixture: a script printing the
+    (rewritable) hosts file — used by the elastic integration tests
+    (which mutate the file mid-run) and the CLI example smokes."""
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text(spec + "\n")
+    script = tmp_path / "discover.sh"
+    script.write_text(f'#!/bin/sh\ncat "{hosts_file}"\n')
+    script.chmod(0o755)
+    return hosts_file, str(script)

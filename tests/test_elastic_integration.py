@@ -24,13 +24,7 @@ _REPO = os.path.dirname(os.path.dirname(horovod_tpu.__file__))
 _SCRIPT = os.path.join(_REPO, "tests", "elastic_train_script.py")
 
 
-def _make_discovery(tmp_path, spec: str):
-    hosts_file = tmp_path / "hosts.txt"
-    hosts_file.write_text(spec + "\n")
-    script = tmp_path / "discover.sh"
-    script.write_text(f'#!/bin/sh\ncat "{hosts_file}"\n')
-    script.chmod(0o755)
-    return hosts_file, str(script)
+from conftest import make_discovery_script as _make_discovery  # noqa: E402
 
 
 def _launch(discovery_script, extra_env=None, min_np=2, max_np=None,

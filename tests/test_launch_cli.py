@@ -78,6 +78,37 @@ def test_cli_tf2_custom_loop_2proc():
     assert "ranks consistent (2 ranks)" in res.stdout
 
 
+def _static_discovery(tmp_path, slots=2):
+    from conftest import make_discovery_script
+
+    _hosts, script = make_discovery_script(tmp_path,
+                                           f"localhost:{slots}")
+    return script
+
+
+def test_cli_torch_elastic_example(tmp_path):
+    res = _hvtpurun([
+        "--host-discovery-script", _static_discovery(tmp_path),
+        "--min-np", "2", "--cpu-devices", "1", "--",
+        sys.executable,
+        os.path.join(_REPO, "examples", "pytorch_mnist_elastic.py"),
+    ], timeout=420)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "ranks consistent (2 ranks)" in res.stdout
+
+
+def test_cli_keras_elastic_example(tmp_path):
+    res = _hvtpurun([
+        "--host-discovery-script", _static_discovery(tmp_path),
+        "--min-np", "2", "--cpu-devices", "1", "--",
+        sys.executable,
+        os.path.join(_REPO, "examples",
+                     "tensorflow2_keras_mnist_elastic.py"),
+    ], timeout=420)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "ranks consistent (2 ranks)" in res.stdout
+
+
 def test_cli_failure_exit_code():
     res = _hvtpurun([
         "-np", "2", "--cpu-devices", "1", "--",
