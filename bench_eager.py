@@ -173,10 +173,9 @@ def main():
              else run_tf_graph_sweep if args.tf else run_sweep)
     if args.np == 1:
         if args.cpu_devices:
-            import jax
+            from horovod_tpu.core.state import force_cpu_devices
 
-            jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+            force_cpu_devices(args.cpu_devices)
         results = sweep(sizes, args.iters)
     else:
         from horovod_tpu.runner import run as hvt_run

@@ -169,8 +169,9 @@ def sweep(args):
     import jax
 
     if args.platform == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.devices)
+        from horovod_tpu.core.state import force_cpu_devices
+
+        force_cpu_devices(args.devices)
 
     import jax.numpy as jnp
     import numpy as np

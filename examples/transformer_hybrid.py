@@ -33,8 +33,9 @@ def main():
     import jax
 
     if args.cpu_devices:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        from horovod_tpu.core.state import force_cpu_devices
+
+        force_cpu_devices(args.cpu_devices)
 
     import jax.numpy as jnp
     import numpy as np

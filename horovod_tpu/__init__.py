@@ -27,6 +27,27 @@ from typing import Optional
 
 import jax
 
+if not hasattr(jax, "shard_map"):
+    # jax < 0.5 only ships shard_map under experimental, with the
+    # replication check spelled check_rep instead of check_vma; alias
+    # the modern surface so every call site (and user code written
+    # against it) runs on both.
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map_compat(f=None, /, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        if f is None:
+            return lambda g: _exp_shard_map(g, **kw)
+        return _exp_shard_map(f, **kw)
+
+    jax.shard_map = _shard_map_compat
+
+if not hasattr(jax.lax, "axis_size"):
+    # jax < 0.5 has no lax.axis_size; core.axis_frame(name) resolves
+    # the bound size of a mesh axis at trace time there.
+    jax.lax.axis_size = lambda axis_name: jax.core.axis_frame(axis_name)
+
 from . import comm, core
 from . import elastic  # noqa: F401  (hvt.elastic.State/run parity surface)
 from .api import functions as _functions

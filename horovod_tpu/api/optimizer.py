@@ -33,6 +33,7 @@ from ..comm.compression import NoneCompressor
 from ..comm.fusion import fused_tree_allreduce, plan_buckets
 from ..comm.reduce_ops import ReduceOp, normalize_op
 from ..core import state as core_state
+from ..obs import metrics as obs_metrics
 
 
 def allreduce_gradients(
@@ -147,6 +148,10 @@ def allreduce_gradients(
             out[e.index] = o
     if use_autotune:
         st.autotuner.record_step(total_bytes)
+    # Step telemetry for the eager reduction path (the jit path's
+    # update is traced once, so its host loop reports via
+    # metrics.note_step directly — see bench.py).
+    obs_metrics.note_step()
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
@@ -346,6 +351,13 @@ def DistributedOptimizer(
             # Eager path: Python control flow on a concrete counter.
             if int(count) == n_acc:
                 return at_boundary(None)
+            # local aggregation only — no collective fired this call
+            # (parity: the reference's skipped synchronize)
+            obs_metrics.counter(
+                "hvtpu_optimizer_skipped_steps_total",
+                "Updates that only accumulated locally "
+                "(backward_passes_per_step aggregation).",
+            ).inc()
             return mid_cycle(None)
         # In-jit: the boundary test must be static-friendly; the cycle
         # counter is a traced value, so use lax.cond.  Collectives

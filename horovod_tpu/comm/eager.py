@@ -42,6 +42,7 @@ profile of an eager-only program reads as designed behavior.
 from __future__ import annotations
 
 import functools
+import time
 from typing import List, Optional, Tuple
 
 import jax
@@ -52,10 +53,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import state as core_state
 from ..core.topology import DCN_AXIS, ICI_AXIS, LDEV_AXIS, PROC_AXIS
+from ..obs import metrics as obs_metrics
 from . import spmd
 from . import stall
 from .compression import NoneCompressor
 from .reduce_ops import ReduceOp, normalize_op
+
+
+def _record_collective(kind: str, x, p: int, compression=None):
+    """Registry bookkeeping for one eager collective: per-kind count,
+    payload bytes before compression, and the bytes this rank actually
+    contributes to the wire after compression/quantization (incl. the
+    int8 path's fp32 block-scale sidecar).  P==1 worlds move nothing,
+    so only the op count is recorded.  Covers the sync API and the
+    async controller's execution (which dispatches through these same
+    functions).  Cost: a few dict updates, ~1 us."""
+    obs_metrics.op_counter(kind).inc()
+    if p <= 1:
+        return
+    nbytes = int(x.nbytes)
+    obs_metrics.TENSOR_BYTES.inc(nbytes)
+    wire_nbytes = nbytes
+    if compression is not None:
+        try:
+            wd = jnp.dtype(compression.wire_dtype(x.dtype))
+            wire_nbytes = int(x.size) * wd.itemsize
+            if wd == jnp.dtype(jnp.int8):
+                from .compression import Int8Compressor
+
+                wire_nbytes += 4 * (
+                    -(-int(x.size) // Int8Compressor.BLOCK))
+        except Exception:
+            pass
+    obs_metrics.WIRE_BYTES.inc(wire_nbytes)
 
 
 # --------------------------------------------------------------------------
@@ -588,6 +618,8 @@ def allreduce(
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
     p = mesh.devices.size
+    _record_collective("allreduce", x, p, compression)
+    t_dispatch = time.monotonic()
 
     timeline = st.timeline
     tname = name or f"allreduce.{x.shape}.{x.dtype}"
@@ -669,6 +701,8 @@ def allreduce(
             # After the interruptible finish: block_until_ready parks
             # inside XLA, which must never precede the stall wait.
             jax.block_until_ready(out)
+        obs_metrics.ALLREDUCE_LATENCY.observe(
+            time.monotonic() - t_dispatch)
         return out
     finally:
         if timeline is not None:
@@ -737,6 +771,7 @@ def allgather(tensor, *, process_set=None, name: Optional[str] = None):
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
     p = mesh.devices.size
+    _record_collective("allgather", x, p)
     if p == 1:
         # gather over one participant is identity — but callers are
         # promised a NEW tensor (frontend DLPack round-trips would
@@ -783,6 +818,7 @@ def broadcast(tensor, *, root_rank: int = 0, process_set=None,
     st, ps = _resolve_process_set(process_set)
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
+    _record_collective("broadcast", x, mesh.devices.size)
     if mesh.devices.size == 1:
         return jnp.copy(x)  # new-tensor contract (see allgather)
     # root_rank is a *global* rank (reference semantics); translate to
@@ -830,6 +866,7 @@ def alltoall(tensor, splits=None, *, process_set=None,
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
     p = mesh.devices.size
+    _record_collective("alltoall", x, p)
     return_splits = splits is not None
     if splits is None:
         if x.shape[0] % p:
@@ -897,6 +934,7 @@ def reducescatter(tensor, *, op=None, process_set=None,
     st, ps = _resolve_process_set(process_set)
     x = jnp.asarray(tensor)
     p = ps.size
+    _record_collective("reducescatter", x, p)
     if p == 1:
         return jnp.copy(x)  # new-tensor contract (see allgather)
     tname = name or f"reducescatter.{x.shape}.{x.dtype}"
@@ -937,6 +975,9 @@ def reducescatter(tensor, *, op=None, process_set=None,
 def barrier(*, process_set=None):
     """Block until every member reaches the barrier (parity: hvd.barrier)."""
     st, ps = _resolve_process_set(process_set)
+    # the carrying allreduce below also counts itself — a barrier IS a
+    # scalar allreduce on this data plane
+    obs_metrics.op_counter("barrier").inc()
     if ps.size == 1:
         return
     out = allreduce(jnp.zeros((), jnp.int32), op=ReduceOp.SUM, process_set=ps)

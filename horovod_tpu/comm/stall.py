@@ -70,8 +70,20 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from ..core.exceptions import HorovodInternalError
+from ..obs import metrics as obs_metrics
 
 logger = logging.getLogger("horovod_tpu")
+
+# Watchdog telemetry (obs/metrics.py; catalog in docs/observability.md).
+_M_HB_AGE = obs_metrics.gauge(
+    "hvtpu_stall_heartbeat_age_seconds",
+    "Age of the most-stale live peer heartbeat (amortized mode); a "
+    "climbing value means a peer stopped beating.")
+_M_WARNINGS = obs_metrics.counter(
+    "hvtpu_stall_warnings_total", "Stall warnings emitted.")
+_M_ABORTS = obs_metrics.counter(
+    "hvtpu_stall_aborts_total",
+    "Stall/mismatch failures latched or raised (job-fatal).")
 
 _NS = "hvtstall"      # strict-mode per-op rendezvous marks
 _HB = "hvtstallhb"    # amortized-mode heartbeat snapshots
@@ -220,6 +232,7 @@ class SyncStallInspector:
                 if val is None:
                     still.append(r)
                 elif val != desc:
+                    _M_ABORTS.inc()
                     raise HorovodInternalError(
                         _mismatch_msg(set_id, seq, self.rank, desc,
                                       r, val))
@@ -228,11 +241,13 @@ class SyncStallInspector:
                 break
             elapsed = time.monotonic() - start
             if self.abort_s > 0 and elapsed > self.abort_s:
+                _M_ABORTS.inc()
                 raise HorovodInternalError(
                     _stall_abort_msg(desc, set_id, seq, elapsed,
                                      self.abort_s, pending))
             if self.warn_s > 0 and elapsed > next_warn:
                 next_warn += self.warn_s
+                _M_WARNINGS.inc()
                 logger.warning(
                     "stalled collective [%s] (process set %s, op #%d): "
                     "waited %.1fs; ranks not at the rendezvous: %s",
@@ -528,6 +543,9 @@ class AmortizedStallInspector:
             prev = self._peer_seen.get(r)
             if prev is None or b != prev[0]:
                 self._peer_seen[r] = (b, now)
+        _M_HB_AGE.set(max(
+            (now - t for _b, t in self._peer_seen.values()),
+            default=0.0))
         peers: Dict[int, dict] = {}
         bye = set()
         bye_fails = []
@@ -632,7 +650,9 @@ class AmortizedStallInspector:
                             (tr.inflight, sid, tr.seq - 1, age, behind))
             if fail:
                 self.failure = fail
+                _M_ABORTS.inc()
         for desc, sid, op, age, behind in warns:
+            _M_WARNINGS.inc()
             logger.warning(
                 "stalled collective [%s] (process set %s, op #%d): "
                 "waited %.1fs; ranks not at the rendezvous: %s",

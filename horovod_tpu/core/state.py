@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import atexit
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -68,6 +69,24 @@ def _coordination_client_active() -> bool:
         return False
 
 
+def force_cpu_devices(n: int):
+    """Request the CPU platform with ``n`` XLA devices, portably across
+    jax versions.  Must run before anything initializes the XLA backend
+    (a ``jax.devices()`` call locks the platform in)."""
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # Older jax (< 0.5) spells this as an XLA flag; it is read at
+        # backend init, which hasn't happened yet here.
+        import os as _os
+
+        flag = f"--xla_force_host_platform_device_count={n}"
+        if flag not in _os.environ.get("XLA_FLAGS", ""):
+            _os.environ["XLA_FLAGS"] = (
+                _os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+
+
 def global_state() -> GlobalState:
     return _state
 
@@ -116,8 +135,7 @@ def init(config: Optional[Config] = None) -> GlobalState:
         # env vars are read too early — the override must go through
         # jax.config before any backend touch.
         if cfg.cpu_devices > 0:
-            jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", cfg.cpu_devices)
+            force_cpu_devices(cfg.cpu_devices)
 
         # Multi-process launch (set up by hvtpurun, like HOROVOD_RANK/SIZE
         # env from the reference launcher): join the JAX coordination
@@ -133,6 +151,18 @@ def init(config: Optional[Config] = None) -> GlobalState:
                     "HVTPU_SIZE > 1 but HVTPU_COORDINATOR_ADDR is unset; "
                     "launch with hvtpurun or set coordinator env vars"
                 )
+            from ..obs import metrics as _metrics
+
+            # Cross-process CPU collectives: newer jax defaults the CPU
+            # backend to gloo; jax < 0.5 needs it requested explicitly
+            # or multi-process XLA programs fail at dispatch.
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except (AttributeError, ValueError):
+                pass  # newer jax: gloo is already the CPU default
+
+            _t_rdv = time.monotonic()
             jax.distributed.initialize(
                 coordinator_address=(
                     f"{cfg.coordinator_addr}:{cfg.coordinator_port}"
@@ -141,6 +171,11 @@ def init(config: Optional[Config] = None) -> GlobalState:
                 process_id=cfg.rank,
                 initialization_timeout=int(cfg.start_timeout),
             )
+            _metrics.histogram(
+                "hvtpu_rendezvous_seconds",
+                "Coordination-service rendezvous duration at init "
+                "(per incarnation; elastic restarts re-observe it).",
+            ).observe(time.monotonic() - _t_rdv)
             _state.distributed_initialized_by_us = True
 
         _state.config = cfg
@@ -207,6 +242,29 @@ def init(config: Optional[Config] = None) -> GlobalState:
                     _state.size * n_local,
                 )
 
+        # Always-on telemetry (obs/metrics.py): identity gauges for the
+        # cluster view, plus the Prometheus endpoint when enabled.  The
+        # worker-count gauge is the per-rank view of the live world —
+        # summed by metrics.aggregate, it is the cluster worker count;
+        # an elastic relaunch re-initializes it at the new world size.
+        import os as _os
+
+        from ..obs import metrics as _metrics
+
+        _metrics.gauge(
+            "hvtpu_elastic_workers",
+            "Live worker (rank) count of this incarnation's world as "
+            "seen by this rank.",
+        ).set(_state.size)
+        _metrics.gauge(
+            "hvtpu_elastic_generation",
+            "Elastic incarnation counter (0 = first launch; bumps on "
+            "every driver relaunch).",
+        ).set(int(_os.environ.get("HVTPU_ELASTIC_GENERATION", "0") or 0))
+        # HVTPU_METRICS_PORT (or --metrics-port): each worker binds
+        # port + local_rank so multi-slot hosts don't collide.
+        _metrics.serve_from_env(local_rank=_state.local_rank)
+
         if cfg.timeline_filename:
             from ..obs.timeline import Timeline
 
@@ -244,6 +302,12 @@ def shutdown():
                 pass
             _state.timeline = None
         _state.autotuner = None
+        try:
+            from ..obs import metrics as _metrics
+
+            _metrics.stop_http_server()
+        except Exception:
+            pass
         # The stall inspector's stop posts a goodbye tombstone over the
         # coordination KV (so still-running peers don't blame this
         # rank for a stall) — it must run BEFORE the client goes away.

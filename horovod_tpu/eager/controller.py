@@ -42,8 +42,29 @@ from ..comm.compression import NoneCompressor
 from ..comm.packing import pack_flat, unpack_flat
 from ..comm.reduce_ops import ReduceOp
 from ..core.exceptions import HorovodInternalError
+from ..obs import metrics as obs_metrics
 
 logger = logging.getLogger("horovod_tpu.eager")
+
+# Controller telemetry (obs/metrics.py; catalog in docs/observability.md).
+_M_CYCLES = obs_metrics.counter(
+    "hvtpu_controller_cycles_total", "Coordination cycles run.")
+_M_CYCLE_S = obs_metrics.histogram(
+    "hvtpu_controller_cycle_seconds",
+    "Coordination cycle duration (coalescing gate + drain + transport "
+    "exchange + execution).")
+_M_QUEUE_DEPTH = obs_metrics.gauge(
+    "hvtpu_controller_queue_depth",
+    "Ops enqueued but not yet executed, sampled after each cycle.")
+_M_NEGOTIATION_S = obs_metrics.histogram(
+    "hvtpu_negotiation_seconds",
+    "Enqueue-to-agreed-response latency through the controller.")
+_M_CACHE_HITS = obs_metrics.counter(
+    "hvtpu_controller_cache_hits_total",
+    "Requests answered from the response cache (name+signature only "
+    "on the wire).")
+_M_CACHE_SIZE = obs_metrics.gauge(
+    "hvtpu_controller_cache_size", "Live response-cache entries.")
 
 _RED_TO_WIRE = {
     ReduceOp.SUM: wire.RED_SUM,
@@ -617,6 +638,7 @@ class EagerController:
         # Deterministic groups keep the pack/unpack compile caches hot.
         # quiesce = one full cycle of quiet; deadline bounds the added
         # negotiation latency for a genuinely continuous stream
+        t_cycle0 = time.monotonic()
         quiesce = self.cycle_time_s
         deadline = time.monotonic() + 8 * self.cycle_time_s
         while True:
@@ -632,7 +654,7 @@ class EagerController:
         self._cycle += 1
         if self._timeline is not None and getattr(
                 self._timeline, "mark_cycles", False):
-            self._timeline.mark_cycle()
+            self._timeline.mark_cycle(cycle)
         with self._lock:
             # counter reset and drain in ONE critical section: an
             # enqueue between them would be drained yet still counted,
@@ -640,6 +662,11 @@ class EagerController:
             drained = self._undrained
             self._undrained = 0
             req = self._ctrl.drain_requests()
+        if drained:
+            # drained requests carry their cache-hit marks; cycles that
+            # drained nothing skip the (tiny) blob re-parse entirely
+            _M_CACHE_HITS.inc(
+                len(wire.parse_request_list(req).cache_hits))
         resp_blob = self._transport.exchange(self._ctrl, cycle, req)
         finished = self._ctrl.apply_responses(resp_blob)
         rl = wire.parse_response_list(resp_blob)
@@ -666,6 +693,16 @@ class EagerController:
             self._shutdown_seen.set()
         if cycle % 256 == 0:
             self._inspect_stalls()
+        _M_CYCLES.inc()
+        _M_CYCLE_S.observe(time.monotonic() - t_cycle0)
+        with self._lock:
+            _M_QUEUE_DEPTH.set(len(self._payloads))
+        cache_size = getattr(self._ctrl, "cache_size", None)
+        if callable(cache_size):
+            try:
+                _M_CACHE_SIZE.set(cache_size())
+            except Exception:
+                pass
         return active
 
     def _inspect_stalls(self):
@@ -682,6 +719,7 @@ class EagerController:
             key = s["name"]
             if key not in self._stall_logged:
                 self._stall_logged.add(key)
+                obs_metrics.counter("hvtpu_stall_warnings_total").inc()
                 logger.warning(
                     "stalled collective %r: waited %.1fs; ranks ready %s, "
                     "ranks missing %s",
@@ -689,6 +727,7 @@ class EagerController:
                 )
             if (self.stall_abort_s > 0
                     and s["waiting_s"] > self.stall_abort_s):
+                obs_metrics.counter("hvtpu_stall_aborts_total").inc()
                 raise HorovodInternalError(
                     f"collective {s['name']!r} stalled for "
                     f"{s['waiting_s']:.0f}s; missing ranks {s['missing']}"
@@ -708,12 +747,14 @@ class EagerController:
             key = f"local:{name}"
             if key not in self._stall_logged:
                 self._stall_logged.add(key)
+                obs_metrics.counter("hvtpu_stall_warnings_total").inc()
                 logger.warning(
                     "stalled collective %r: waited %.1fs on rank %d "
                     "(coordinator rank 0 logs which ranks are missing)",
                     name, waited, self.rank,
                 )
             if self.stall_abort_s > 0 and waited > self.stall_abort_s:
+                obs_metrics.counter("hvtpu_stall_aborts_total").inc()
                 raise HorovodInternalError(
                     f"collective {name!r} stalled for {waited:.0f}s on "
                     f"rank {self.rank}"
@@ -812,9 +853,11 @@ class EagerController:
                 self._fail_error_response(rs)
                 continue
             payloads = self._take_payloads(rs)
-            if self._timeline is not None:
-                for p in payloads:
-                    if p.seq != -1:  # not a synthetic zero payload
+            now = time.monotonic()
+            for p in payloads:
+                if p.seq != -1:  # not a synthetic zero payload
+                    _M_NEGOTIATION_S.observe(now - p.t_enqueue)
+                    if self._timeline is not None:
                         self._timeline.end(p.name)
             try:
                 self._execute_one(rs, payloads)

@@ -25,6 +25,7 @@ import tempfile
 import time
 from typing import Dict, List, Optional
 
+from ..obs import metrics as obs_metrics
 from ..runner import hosts as hosts_mod
 from ..runner import safe_shell_exec
 from ..runner.launch import (
@@ -41,6 +42,22 @@ from .worker import RESET_EXIT_CODE
 # A host is blacklisted after this many consecutive crashed (not
 # reset-requested) workers (parity: registration.py blacklist policy).
 BLACKLIST_THRESHOLD = 3
+
+# Driver-side telemetry (obs/metrics.py): the driver process keeps its
+# own registry — workers each publish theirs (HVTPU_METRICS_PORT; the
+# driver deliberately does not bind a port, it would collide with the
+# rank-0 worker on the same host).
+_M_WORKERS = obs_metrics.gauge(
+    "hvtpu_elastic_workers",
+    "Live worker (rank) count of this incarnation's world as seen by "
+    "this rank.")
+_M_RESTARTS = obs_metrics.counter(
+    "hvtpu_elastic_restarts_total",
+    "Worker-set relaunches performed by the elastic driver.")
+_M_RENDEZVOUS_S = obs_metrics.histogram(
+    "hvtpu_elastic_rendezvous_seconds",
+    "Driver-side rendezvous: discovery reaching min_np through a "
+    "launched worker set, per incarnation.")
 
 _TERM_CODES = (-signal.SIGTERM, 128 + signal.SIGTERM)
 # SIGUSR1 arriving before the worker installed its handler kills the
@@ -165,6 +182,7 @@ class ElasticDriver:
     def run(self) -> int:
         """Main loop (parity: ElasticDriver.start + _run_elastic)."""
         while True:
+            t_rdv = time.monotonic()
             if not self._wait_for_min_hosts():
                 print(
                     f"hvtpu.elastic: fewer than min_np={self.min_np} "
@@ -186,7 +204,10 @@ class ElasticDriver:
             )
             self.final_world_size = np_now
             workers = self._spawn(slots, port)
+            _M_RENDEZVOUS_S.observe(time.monotonic() - t_rdv)
+            _M_WORKERS.set(np_now)
             outcome = self._supervise(workers, slots)
+            _M_WORKERS.set(0)
             if outcome == "done":
                 if self._owns_state_dir:
                     import shutil
@@ -196,6 +217,7 @@ class ElasticDriver:
             if outcome == "failed":
                 return 1
             # outcome == "restart": loop around, re-discover, relaunch
+            _M_RESTARTS.inc()
 
     def _supervise(self, workers, slots) -> str:
         """Watch one incarnation. Returns 'done' | 'restart' | 'failed'."""
@@ -216,6 +238,7 @@ class ElasticDriver:
                     reset_req.append(w)
                 else:
                     crashed.append((w, code))
+            _M_WORKERS.set(len(running))
             if not running:
                 if crashed or reset_req:
                     return self._finish_incarnation(workers, slots, crashed)

@@ -24,7 +24,16 @@ import sys
 
 from ..core import state as core_state
 from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..obs import metrics as obs_metrics
 from .state import State, _HostUpdateFlag
+
+# Worker-side elastic telemetry (obs/metrics.py): reset requests by
+# cause — the driver's restart counter says HOW OFTEN the world was
+# rebuilt; this says WHY (peer crash vs planned membership change).
+_M_RESETS = obs_metrics.counter(
+    "hvtpu_elastic_worker_resets_total",
+    "World-reset requests issued by this worker, by reason "
+    "(collective_failure | hosts_updated).")
 
 # Exit code the driver interprets as "re-rendezvous requested" (worker
 # hit a recoverable elastic event); anything else non-zero is a crash.
@@ -90,9 +99,11 @@ def run(func):
         except HorovodInternalError:
             # Peer loss mid-collective: roll back so the durable commit
             # reflects the last good step, then ask for a new world.
+            _M_RESETS.inc(reason="collective_failure")
             state.restore()
             _exit_for_reset("collective failure")
         except HostsUpdatedInterrupt:
+            _M_RESETS.inc(reason="hosts_updated")
             _exit_for_reset("hosts updated")
 
     return wrapper

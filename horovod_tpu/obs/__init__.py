@@ -1,4 +1,22 @@
+from . import metrics
+from . import profile
 from .autotune import Autotuner
+from .metrics import REGISTRY as metrics_registry
+from .profile import device_time_ms, op_summary, plane_names, trace
 from .timeline import Timeline, start_jax_profiler, stop_jax_profiler
 
-__all__ = ["Autotuner", "Timeline", "start_jax_profiler", "stop_jax_profiler"]
+__all__ = [
+    "Autotuner",
+    "Timeline",
+    "start_jax_profiler",
+    "stop_jax_profiler",
+    # device-trace profiling (obs/profile.py)
+    "profile",
+    "trace",
+    "op_summary",
+    "device_time_ms",
+    "plane_names",
+    # metrics registry + Prometheus exposition (obs/metrics.py)
+    "metrics",
+    "metrics_registry",
+]

@@ -61,6 +61,12 @@ class Timeline:
             }
         )
 
+    @property
+    def mark_cycles(self) -> bool:
+        """Whether CYCLE instants are enabled (the controller consults
+        this before paying for a mark_cycle call each cycle)."""
+        return self._mark_cycles
+
     def _now_us(self) -> float:
         return (time.monotonic() - self._t0) * 1e6
 
@@ -75,6 +81,12 @@ class Timeline:
             self._file.flush()
 
     def begin(self, tensor_name: str, phase: str):
+        # A tensor entering its next phase before end() closes the
+        # previous one (NEGOTIATE -> QUEUE -> ICI_ALLREDUCE) must end
+        # that span first — silently overwriting the open-span entry
+        # leaves an unmatched 'B' event in the trace.
+        if tensor_name in self._open_spans:
+            self.end(tensor_name)
         self._open_spans[tensor_name] = phase
         self._emit(
             {

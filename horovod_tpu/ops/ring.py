@@ -59,12 +59,31 @@ from .pallas_ops import _LANES, _QROWS, _pallas_mode, block_scale_inv
 # Per-rank chunk rows must be a multiple of the f32 tile height.
 _CHUNK_ROW_QUANTUM = 8
 
+def _CompilerParams(**kw):
+    """Portable pltpu compiler params: jax < 0.5 names the dataclass
+    TPUCompilerParams and lacks newer fields (has_side_effects), which
+    are dropped there — the interpreter path those versions take does
+    not consult them."""
+    import dataclasses
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    fields = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in kw.items() if k in fields})
+
 
 def _interpret_arg():
     use, interp = _pallas_mode()
     if not use:
         return None  # caller must fall back
-    return pltpu.InterpretParams() if interp else False
+    if not interp:
+        return False
+    if not hasattr(pltpu, "InterpretParams"):
+        # jax < 0.5: the legacy Pallas interpreter cannot simulate
+        # remote DMA semaphores ("Remote signal not implemented"), so
+        # the ring kernels are unrunnable on CPU there — fall back to
+        # the XLA collectives the wrappers keep for exactly this case.
+        return None
+    return pltpu.InterpretParams()
 
 
 # ----------------------------------------------------------------------
@@ -146,7 +165,7 @@ def ring_allgather_2d(local, *, axis_name: str):
         # distinct collective_id per kernel entry point: concurrent
         # collective kernels sharing a barrier semaphore is documented
         # as a correctness hazard (allgather=0, allreduce=1, quant=2)
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             has_side_effects=True, collective_id=0
         ),
         interpret=interp,
@@ -418,7 +437,7 @@ def _ring_allreduce_2d(x2, *, axis_name: str, quantized: bool):
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             has_side_effects=True, collective_id=2 if quantized else 1
         ),
         interpret=interp,

@@ -11,10 +11,23 @@ platform pinned in env, so the CPU override must use jax.config.update
 (env vars are read too early to take effect here).
 """
 
-import jax
+import os
+
+# Older jax (< 0.5) has no jax_num_cpu_devices config option; the
+# XLA flag below is its spelling of the same request and is read at
+# backend init (first device query), which is still ahead of us.
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # XLA_FLAGS fallback above covers it
 
 import pytest  # noqa: E402
 

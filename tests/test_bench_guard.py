@@ -1,6 +1,8 @@
-"""bench.py's round-over-round regression floors (VERDICT r4 #4):
-BENCH_MODELS.json bar.floors are enforced by the bench harness — a
-deliberate 3% slowdown in any benchmarked model fails the run."""
+"""bench.py's round-over-round guards: the regression floors
+(VERDICT r4 #4 — BENCH_MODELS.json bar.floors fail the run on a
+deliberate 3% slowdown) and the embedded metrics snapshot (every bench
+JSON line must carry the condensed registry snapshot so BENCH_*
+trajectories stay schema-comparable on wire-bytes and cycle stats)."""
 
 import json
 import os
@@ -49,3 +51,38 @@ class TestRegressionFloor:
         assert bench.check_regression_floor("nosuch", 1.0, _ROOT) is None
         assert bench.check_regression_floor(
             "resnet50", 1.0, str(tmp_path)) is None
+
+
+class TestMetricsEmbedding:
+    """The bench JSON schema REQUIRES the embedded metrics snapshot —
+    future bench rounds must stay comparable on wire bytes and cycle
+    stats, not just img/s."""
+
+    def test_report_always_embeds_metrics(self, bench):
+        report = bench.build_report(metric="m", value=1.0, unit="u")
+        assert "metrics" in report
+        for key in bench.REQUIRED_METRIC_KEYS:
+            assert key in report["metrics"], key
+        # the report must stay a single JSON-serializable line
+        json.dumps(report)
+
+    def test_condensed_schema_shapes(self, bench):
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("hvtpu_wire_bytes_total").inc(4096)
+        reg.histogram("hvtpu_controller_cycle_seconds",
+                      buckets=[0.1]).observe(0.05)
+        out = bench.condense_metrics(reg.snapshot())
+        assert out["hvtpu_wire_bytes_total"] == 4096
+        cell = out["hvtpu_controller_cycle_seconds"]
+        assert cell["count"] == 1 and cell["sum"] == 0.05
+        # untouched required families appear as zeros, never missing
+        assert out["hvtpu_allreduce_total"] == 0
+        assert out["hvtpu_optimizer_steps_total"] == 0
+
+    def test_required_keys_cover_wire_and_cycles(self, bench):
+        required = set(bench.REQUIRED_METRIC_KEYS)
+        assert "hvtpu_wire_bytes_total" in required
+        assert "hvtpu_controller_cycle_seconds" in required
+        assert "hvtpu_optimizer_steps_total" in required

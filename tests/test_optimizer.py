@@ -81,7 +81,11 @@ def dp_train(tx, steps=20, **shard_kw):
 class TestDistributedOptimizer:
     def test_loss_decreases(self, hvt):
         tx = hvt.DistributedOptimizer(optax.sgd(0.05), axis_name=AXIS)
-        _, losses = dp_train(tx)
+        # 30 steps: jax.random init values differ across jax versions,
+        # shifting the exact trajectory; plain local optax needs the
+        # same step count for this ratio, so the bound stays a true
+        # parity check rather than a version-calibrated constant.
+        _, losses = dp_train(tx, steps=30)
         assert losses[-1] < losses[0] * 0.5
 
     def test_grads_match_full_batch_sgd(self, hvt):

@@ -196,6 +196,11 @@ class TestEngineIntegration:
         from horovod_tpu.comm.reduce_ops import ReduceOp
         from horovod_tpu.ops import ring as ring_mod
 
+        if ring_mod._interpret_arg() is None:
+            pytest.skip("Pallas interpreter cannot run the ring kernels "
+                        "on this jax (no remote-DMA simulation); the "
+                        "engine correctly falls back to the XLA path")
+
         # the XLA two-phase path would also satisfy the numeric bound,
         # so additionally prove the ring kernel actually ran
         calls = []

@@ -292,6 +292,12 @@ class TestFlagPlumbing:
         env = self._env_for(["-np", "2", "--hierarchical-allreduce"])
         assert env["HVTPU_HIERARCHICAL_ALLREDUCE"] == "1"
 
+    def test_metrics_port_flag(self):
+        env = self._env_for(["-np", "2", "--metrics-port", "9090"])
+        assert env["HVTPU_METRICS_PORT"] == "9090"
+        # unset: never exported, endpoint stays off
+        assert "HVTPU_METRICS_PORT" not in self._env_for(["-np", "2"])
+
     def test_env_passthrough_set_and_copy(self):
         env = self._env_for(
             ["-np", "2", "-x", "FOO=bar", "-x", "INHERITED"])
