@@ -51,6 +51,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import faults
 from ..core import state as core_state
 from ..core.topology import DCN_AXIS, ICI_AXIS, LDEV_AXIS, PROC_AXIS
 from ..obs import metrics as obs_metrics
@@ -60,14 +61,23 @@ from .compression import NoneCompressor
 from .reduce_ops import ReduceOp, normalize_op
 
 
-def _record_collective(kind: str, x, p: int, compression=None):
+def _record_collective(kind: str, x, p: int, compression=None,
+                       pset=None):
     """Registry bookkeeping for one eager collective: per-kind count,
     payload bytes before compression, and the bytes this rank actually
     contributes to the wire after compression/quantization (incl. the
     int8 path's fp32 block-scale sidecar).  P==1 worlds move nothing,
     so only the op count is recorded.  Covers the sync API and the
     async controller's execution (which dispatches through these same
-    functions).  Cost: a few dict updates, ~1 us."""
+    functions).  Cost: a few dict updates, ~1 us.
+
+    Also the ``collective.pre`` fault-injection site (core/faults.py):
+    every eager collective passes here before dispatch, so an armed
+    clause can delay/error/kill a rank right at the dispatch boundary
+    — the divergence class the stall watchdog exists to catch.  The
+    empty-spec cost is one module-attribute read."""
+    if faults.ACTIVE:
+        faults.inject("collective.pre", pset=pset, detail=kind)
     obs_metrics.op_counter(kind).inc()
     if p <= 1:
         return
@@ -618,7 +628,8 @@ def allreduce(
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
     p = mesh.devices.size
-    _record_collective("allreduce", x, p, compression)
+    _record_collective("allreduce", x, p, compression,
+                       pset=ps.process_set_id)
     t_dispatch = time.monotonic()
 
     timeline = st.timeline
@@ -771,7 +782,7 @@ def allgather(tensor, *, process_set=None, name: Optional[str] = None):
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
     p = mesh.devices.size
-    _record_collective("allgather", x, p)
+    _record_collective("allgather", x, p, pset=ps.process_set_id)
     if p == 1:
         # gather over one participant is identity — but callers are
         # promised a NEW tensor (frontend DLPack round-trips would
@@ -818,7 +829,8 @@ def broadcast(tensor, *, root_rank: int = 0, process_set=None,
     st, ps = _resolve_process_set(process_set)
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
-    _record_collective("broadcast", x, mesh.devices.size)
+    _record_collective("broadcast", x, mesh.devices.size,
+                       pset=ps.process_set_id)
     if mesh.devices.size == 1:
         return jnp.copy(x)  # new-tensor contract (see allgather)
     # root_rank is a *global* rank (reference semantics); translate to
@@ -866,7 +878,7 @@ def alltoall(tensor, splits=None, *, process_set=None,
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
     p = mesh.devices.size
-    _record_collective("alltoall", x, p)
+    _record_collective("alltoall", x, p, pset=ps.process_set_id)
     return_splits = splits is not None
     if splits is None:
         if x.shape[0] % p:
@@ -934,7 +946,7 @@ def reducescatter(tensor, *, op=None, process_set=None,
     st, ps = _resolve_process_set(process_set)
     x = jnp.asarray(tensor)
     p = ps.size
-    _record_collective("reducescatter", x, p)
+    _record_collective("reducescatter", x, p, pset=ps.process_set_id)
     if p == 1:
         return jnp.copy(x)  # new-tensor contract (see allgather)
     tname = name or f"reducescatter.{x.shape}.{x.dtype}"

@@ -69,6 +69,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..core import faults
 from ..core.exceptions import HorovodInternalError
 from ..obs import metrics as obs_metrics
 
@@ -128,8 +129,12 @@ def poison_exit_status() -> int:
     """Exit status for the hard-exit path: 0 when the process
     re-initialized into a NEWER generation after the poisoning (the
     wedged execution belongs to a previous session — e.g. elastic
-    recovery rolled back and the job went on to finish), 1 when the
-    stall abort is the terminal event."""
+    recovery rolled back and the job went on to finish).  Otherwise
+    the stall abort is the terminal event: an ELASTIC job exits with
+    ``RESET_EXIT_CODE`` so the driver feeds the death into its
+    recovery loop (rollback + relaunch) instead of scoring a crash
+    strike against a healthy host; a non-elastic job keeps the hard
+    abort (1)."""
     try:
         from ..core import state as _core_state
 
@@ -137,7 +142,37 @@ def poison_exit_status() -> int:
             return 0
     except Exception:
         pass
+    if _elastic_job():
+        from ..elastic.worker import RESET_EXIT_CODE
+
+        return RESET_EXIT_CODE
     return 1
+
+
+def _elastic_job() -> bool:
+    """True when this process belongs to an elastic job — from the live
+    config when initialized, else from the driver-exported env (the
+    atexit path runs after shutdown() cleared the config)."""
+    import os as _os
+
+    try:
+        from ..core import state as _core_state
+
+        cfg = _core_state.global_state().config
+        if cfg is not None:
+            return bool(cfg.elastic)
+    except Exception:
+        pass
+    return str(_os.environ.get("HVTPU_ELASTIC", "")).strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def _reset_poison() -> None:
+    """Clear the latch (test hook: the poison state is process-global
+    and would otherwise leak a hard-exit into unrelated tests)."""
+    global _poisoned, _poison_gen
+    _poisoned = False
+    _poison_gen = -1
 
 
 def _mismatch_msg(set_id, seq, rank, mine, peer, theirs) -> str:
@@ -499,6 +534,12 @@ class AmortizedStallInspector:
                 logger.debug("stall heartbeat error", exc_info=True)
 
     def _beat_once(self) -> None:
+        # Fault site ``heartbeat``: drop suppresses this beat entirely
+        # (peers see this rank going stale — a wedged heartbeat
+        # thread), delay lags it, error rides the _beat_loop catch,
+        # kill simulates dying between collectives.
+        if faults.ACTIVE and faults.inject("heartbeat"):
+            return
         with self._lock:
             now = time.monotonic()
             sets = {
@@ -669,6 +710,13 @@ def _make_inspector(st, cfg):
         client = _jd.global_state.client
     except Exception:
         client = None
+    if client is not None:
+        # Transient coordinator blips (or injected kv.* faults) retry
+        # with backoff instead of surfacing through the watchdog as an
+        # instant failure; see core/retry.py.
+        from ..core.retry import resilient_kv
+
+        client = resilient_kv(client, rank=st.rank)
     if client is None:
         st.sync_stall = False
         logger.warning(

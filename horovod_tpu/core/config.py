@@ -128,6 +128,21 @@ class Config:
     elastic: bool = False
     elastic_timeout: float = 600.0
     elastic_discovery_interval: float = 1.0
+    # restart budget: total relaunches the elastic driver may perform
+    # before declaring the workload crash-looping (-1 = unlimited);
+    # with restart_window_seconds > 0 the budget applies to a sliding
+    # window instead of the whole job
+    max_restarts: int = -1
+    restart_window_seconds: float = 0.0
+    # blacklist cooldown (seconds): first strike sidelines a host for
+    # this long, doubling per strike (exponential re-admission) up to
+    # blacklist_cooldown_max_seconds
+    blacklist_cooldown_seconds: float = 300.0
+    blacklist_cooldown_max_seconds: float = 3600.0
+
+    # --- fault injection (core/faults.py; docs/robustness.md) ---
+    fault_spec: Optional[str] = None
+    fault_seed: int = 0
 
     # --- CPU-simulation mode (localhost-as-cluster testing; set by
     # ``hvtpurun --cpu-devices N``): force the CPU platform with N XLA
@@ -186,5 +201,17 @@ class Config:
             elastic_discovery_interval=_env_float(
                 "ELASTIC_DISCOVERY_INTERVAL", 1.0
             ),
+            max_restarts=_env_int("MAX_RESTARTS", -1),
+            restart_window_seconds=_env_float(
+                "RESTART_WINDOW_SECONDS", 0.0
+            ),
+            blacklist_cooldown_seconds=_env_float(
+                "BLACKLIST_COOLDOWN_SECONDS", 300.0
+            ),
+            blacklist_cooldown_max_seconds=_env_float(
+                "BLACKLIST_COOLDOWN_MAX_SECONDS", 3600.0
+            ),
+            fault_spec=_env_str("FAULT_SPEC"),
+            fault_seed=_env_int("FAULT_SEED", 0),
             cpu_devices=_env_int("CPU_DEVICES", 0),
         )

@@ -1,0 +1,352 @@
+"""Deterministic, seeded fault injection for the coordination layer.
+
+The elastic stack exists to survive failures — dropped KV ops,
+suppressed heartbeats, workers dying mid-collective — yet none of those
+can be produced on purpose without this module: the recovery paths
+would only ever be exercised by accident.  "Demystifying NCCL"
+(PAPERS.md) documents hung/aborted collectives as the dominant
+large-job failure mode on GPU stacks; this registry lets CI reproduce
+that class of failure deterministically on localhost.
+
+Driven by ``HVTPU_FAULT_SPEC`` (mirrored by ``hvtpurun --fault-spec``).
+Grammar (full reference in docs/robustness.md)::
+
+    SPEC   := CLAUSE (";" CLAUSE)*
+    CLAUSE := SITE ":" ACTION ("@" SEL ("," SEL)*)?
+    SITE   := kv.get | kv.put | heartbeat | collective.pre | worker.step
+    ACTION := drop | delay(MS) | error | kill
+    SEL    := rank=R[|R...] | pset=ID | count=N | prob=P | times=K
+
+Examples::
+
+    worker.step:kill@rank=1,count=3      # rank 1 dies at its 3rd step
+    kv.put:error@prob=0.01               # 1% of KV writes fail (seeded)
+    heartbeat:drop@rank=0,count=5,times=20   # beats 5..24 suppressed
+    collective.pre:delay(250)@rank=2     # rank 2 lags every collective
+
+Selector semantics:
+
+- ``rank=R`` — only these ranks fire (``|``-separated list).
+- ``pset=ID`` — only operations on that process set (sites that carry
+  no process-set id never match a pset-selected clause).
+- ``count=N`` — fire from the Nth matching invocation on (1-based,
+  counted per process per clause).
+- ``prob=P`` — fire with probability P from a per-``(seed, rank,
+  clause)`` RNG, so a given seed reproduces the same fault schedule.
+- ``times=K`` — at most K firings (default: 1 for ``kill``, unlimited
+  otherwise).  Finite ``times`` persist across elastic incarnations
+  through a marker file under ``HVTPU_FAULT_STATE_DIR`` (defaulting to
+  the driver-provided ``HVTPU_ELASTIC_STATE_DIR``), so a relaunched
+  worker does not replay a one-shot kill forever.
+
+Zero overhead when no spec is installed: hot call sites guard on the
+module-level ``ACTIVE`` flag (one attribute read) and never call
+``inject`` — see ``comm/eager.py::_record_collective``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+logger = logging.getLogger("horovod_tpu")
+
+#: Sites the framework threads the harness through.  ``inject`` rejects
+#: unknown sites at parse time so a typo'd spec fails loudly at init.
+SITES = ("kv.get", "kv.put", "heartbeat", "collective.pre", "worker.step")
+
+ACTIONS = ("drop", "delay", "error", "kill")
+
+#: Module-level fast path: False means ``inject`` is never entered.
+ACTIVE = False
+
+_registry: Optional["FaultRegistry"] = None
+_lock = threading.Lock()
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``HVTPU_FAULT_SPEC`` / ``--fault-spec`` string."""
+
+
+class InjectedFault(RuntimeError):
+    """Raised by the ``error`` action.
+
+    The message carries the grpc-style ``UNAVAILABLE`` marker so the
+    coordination-KV retry policy (core/retry.py) classifies an injected
+    KV failure as transient — an ``error``-injected ``kv.put`` therefore
+    exercises the retry path end to end instead of instantly failing
+    the job.
+    """
+
+    def __init__(self, clause: "FaultClause", site: str):
+        super().__init__(
+            f"UNAVAILABLE (hvtpu injected fault: {clause.source} "
+            f"at site {site})")
+        self.clause = clause
+
+
+_DELAY_RE = re.compile(r"^delay\((\d+(?:\.\d+)?)\)$")
+
+
+class FaultClause:
+    """One parsed ``site:action[@selectors]`` clause."""
+
+    __slots__ = ("site", "action", "delay_ms", "ranks", "pset", "count",
+                 "prob", "times", "index", "source", "_fired", "_seen",
+                 "_rng")
+
+    def __init__(self, site: str, action: str, delay_ms: float,
+                 ranks: Optional[frozenset], pset: Optional[int],
+                 count: int, prob: Optional[float], times: int,
+                 index: int, source: str):
+        self.site = site
+        self.action = action
+        self.delay_ms = delay_ms
+        self.ranks = ranks          # None = all ranks
+        self.pset = pset            # None = any process set
+        self.count = count          # fire from the count-th match (1-based)
+        self.prob = prob            # None = always (subject to count)
+        self.times = times          # 0 = unlimited
+        self.index = index
+        self.source = source
+        self._fired = 0             # firings so far (this process + disk)
+        self._seen = 0              # matching invocations so far
+        self._rng: Optional[random.Random] = None
+
+    def bind(self, rank: int, seed: int, persisted_fired: int):
+        """Per-process arming: seed the clause RNG from (seed, rank,
+        clause index) so every rank draws an independent but
+        reproducible stream, and credit firings persisted by earlier
+        incarnations against the ``times`` budget."""
+        self._rng = random.Random(f"{seed}/{rank}/{self.index}")
+        self._fired = persisted_fired
+
+    def matches(self, rank: int, pset) -> bool:
+        if self.ranks is not None and rank not in self.ranks:
+            return False
+        if self.pset is not None and (pset is None or int(pset) != self.pset):
+            return False
+        return True
+
+    def should_fire(self) -> bool:
+        """Called only for matching invocations; owns the count/prob/
+        times bookkeeping (caller holds the registry lock)."""
+        if self.times and self._fired >= self.times:
+            return False
+        self._seen += 1
+        if self._seen < self.count:
+            return False
+        if self.prob is not None and self._rng.random() >= self.prob:
+            return False
+        self._fired += 1
+        return True
+
+
+def parse_spec(spec: str) -> List[FaultClause]:
+    """Parse a fault-spec string into clauses; raises
+    :class:`FaultSpecError` with the offending fragment on bad input."""
+    clauses: List[FaultClause] = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        if ":" not in raw:
+            raise FaultSpecError(
+                f"fault clause {raw!r}: expected 'site:action[@sel,...]'")
+        site, rest = raw.split(":", 1)
+        site = site.strip()
+        if site not in SITES:
+            raise FaultSpecError(
+                f"fault clause {raw!r}: unknown site {site!r} "
+                f"(known: {', '.join(SITES)})")
+        action_s, _, sel_s = rest.partition("@")
+        action_s = action_s.strip()
+        delay_ms = 0.0
+        m = _DELAY_RE.match(action_s)
+        if m:
+            action, delay_ms = "delay", float(m.group(1))
+        elif action_s in ("drop", "error", "kill"):
+            action = action_s
+        else:
+            raise FaultSpecError(
+                f"fault clause {raw!r}: unknown action {action_s!r} "
+                f"(known: drop, delay(MS), error, kill)")
+        ranks = pset = prob = None
+        count = 1
+        times = 1 if action == "kill" else 0
+        for sel in filter(None, (s.strip() for s in sel_s.split(","))):
+            if "=" not in sel:
+                raise FaultSpecError(
+                    f"fault clause {raw!r}: selector {sel!r} is not "
+                    "key=value")
+            k, v = (t.strip() for t in sel.split("=", 1))
+            try:
+                if k == "rank":
+                    ranks = frozenset(int(r) for r in v.split("|"))
+                elif k == "pset":
+                    pset = int(v)
+                elif k == "count":
+                    count = int(v)
+                    if count < 1:
+                        raise ValueError
+                elif k == "prob":
+                    prob = float(v)
+                    if not 0.0 <= prob <= 1.0:
+                        raise ValueError
+                elif k == "times":
+                    times = int(v)
+                    if times < 0:
+                        raise ValueError
+                else:
+                    raise FaultSpecError(
+                        f"fault clause {raw!r}: unknown selector {k!r} "
+                        "(known: rank, pset, count, prob, times)")
+            except FaultSpecError:
+                raise
+            except ValueError:
+                raise FaultSpecError(
+                    f"fault clause {raw!r}: bad selector value "
+                    f"{sel!r}") from None
+        clauses.append(FaultClause(
+            site, action, delay_ms, ranks, pset, count, prob, times,
+            index=len(clauses), source=raw))
+    return clauses
+
+
+class FaultRegistry:
+    """The armed per-process fault set.
+
+    ``inject(site)`` walks the (tiny) clause list for that site and
+    executes the first firing clause's action.  Returns True when the
+    operation should be DROPPED (the caller suppresses it), False
+    otherwise; ``error`` raises :class:`InjectedFault`; ``kill``
+    hard-exits the process.
+    """
+
+    def __init__(self, clauses: Sequence[FaultClause], rank: int = 0,
+                 seed: int = 0, state_dir: Optional[str] = None):
+        self.rank = rank
+        self.seed = seed
+        self.state_dir = state_dir
+        self._lock = threading.Lock()
+        self._by_site: Dict[str, List[FaultClause]] = {}
+        for c in clauses:
+            c.bind(rank, seed, self._load_fired(c))
+            self._by_site.setdefault(c.site, []).append(c)
+
+    # -- cross-incarnation persistence ---------------------------------
+    def _marker(self, clause: FaultClause) -> Optional[str]:
+        if not self.state_dir or not clause.times:
+            return None
+        return os.path.join(self.state_dir, "faults_fired",
+                            f"clause_{clause.index}")
+
+    def _load_fired(self, clause: FaultClause) -> int:
+        path = self._marker(clause)
+        if not path:
+            return 0
+        try:
+            with open(path) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def _persist_fired(self, clause: FaultClause) -> None:
+        path = self._marker(clause)
+        if not path:
+            return
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                f.write(str(clause._fired))
+        except OSError:
+            logger.warning("fault harness: could not persist firing "
+                           "count to %s", path, exc_info=True)
+
+    # -- the injection point -------------------------------------------
+    def inject(self, site: str, pset=None, detail: Optional[str] = None
+               ) -> bool:
+        fired: Optional[FaultClause] = None
+        with self._lock:
+            for clause in self._by_site.get(site, ()):
+                if clause.matches(self.rank, pset) and clause.should_fire():
+                    fired = clause
+                    break
+        if fired is None:
+            return False
+        # Persist BEFORE executing: a kill must be counted by the next
+        # incarnation even though this process never returns.
+        self._persist_fired(fired)
+        logger.warning(
+            "hvtpu fault injection: firing [%s] at site %s (rank %d%s)",
+            fired.source, site, self.rank,
+            f", op {detail}" if detail else "")
+        if fired.action == "delay":
+            time.sleep(fired.delay_ms / 1000.0)
+            return False
+        if fired.action == "drop":
+            return True
+        if fired.action == "error":
+            raise InjectedFault(fired, site)
+        # kill: flush and hard-exit — simulate a worker dying mid-op
+        # (exit 1 = crash, NOT the reset code: the driver must treat
+        # this as an unplanned death, exactly like a real one).
+        import sys
+
+        print(f"hvtpu fault injection: killing rank {self.rank} "
+              f"([{fired.source}] at {site})", file=sys.stderr, flush=True)
+        sys.stdout.flush()
+        os._exit(1)
+
+
+def install(spec: str, rank: int = 0, seed: int = 0,
+            state_dir: Optional[str] = None) -> Optional[FaultRegistry]:
+    """Arm the process-wide registry from a spec string (empty/None
+    uninstalls).  Called by ``core.state.init`` once the true rank is
+    known; idempotent re-install replaces the previous registry."""
+    global _registry, ACTIVE
+    with _lock:
+        if not spec or not spec.strip():
+            _registry, ACTIVE = None, False
+            return None
+        _registry = FaultRegistry(
+            parse_spec(spec), rank=rank, seed=seed, state_dir=state_dir)
+        ACTIVE = True
+        return _registry
+
+
+def install_from_config(cfg, rank: int) -> Optional[FaultRegistry]:
+    """Arm from a Config snapshot (HVTPU_FAULT_SPEC / HVTPU_FAULT_SEED);
+    the persistence dir falls back to the elastic state dir so one-shot
+    faults survive driver relaunches without extra wiring."""
+    spec = getattr(cfg, "fault_spec", None)
+    if not spec:
+        return None
+    state_dir = (os.environ.get("HVTPU_FAULT_STATE_DIR")
+                 or os.environ.get("HVTPU_ELASTIC_STATE_DIR"))
+    return install(spec, rank=rank,
+                   seed=int(getattr(cfg, "fault_seed", 0) or 0),
+                   state_dir=state_dir)
+
+
+def uninstall() -> None:
+    global _registry, ACTIVE
+    with _lock:
+        _registry, ACTIVE = None, False
+
+
+def inject(site: str, pset=None, detail: Optional[str] = None) -> bool:
+    """Fire any armed clause for ``site``.  Returns True when the
+    caller should DROP the operation; may sleep (delay), raise
+    :class:`InjectedFault` (error), or never return (kill).  A no-op
+    returning False when nothing is installed — but hot paths should
+    guard on ``faults.ACTIVE`` and skip the call entirely."""
+    reg = _registry
+    if reg is None:
+        return False
+    return reg.inject(site, pset=pset, detail=detail)

@@ -1,0 +1,319 @@
+"""Generic bounded retry with exponential backoff + full jitter.
+
+One policy engine for every "transiently unreachable" surface in the
+stack, replacing ad-hoc loops:
+
+- **coordination KV** (``ResilientKV``): the stall inspector's
+  heartbeat reads/writes and ``obs/metrics.aggregate``'s snapshot
+  exchange ride the JAX coordination service, whose gRPC channel can
+  blip (coordinator restart, DCN hiccup, injected fault).  Before this
+  module a single ``UNAVAILABLE`` turned into an instant
+  ``HorovodInternalError``/hang; now it retries with backoff and only
+  an exhausted budget surfaces.  Retries and exhaustions are counted in
+  the metrics registry (``hvtpu_kv_retries_total``,
+  ``hvtpu_kv_retry_exhausted_total``).
+- **gloo teardown races** (``GLOO_TEARDOWN``): jaxlib's gloo CPU
+  transport occasionally drops a connection under parallel localhost
+  load (a rank SIGSEGVs; peers report "Connection closed by peer").
+  That race lives below this framework; the bounded retry the tests
+  carried inline is now this named policy, reused from
+  ``tests/test_multiprocess.py`` and ``tests/test_launch_cli.py``.
+
+Backoff follows the AWS "full jitter" scheme: sleep is uniform in
+``[0, min(max_delay, base * 2**attempt)]`` — decorrelated retries so P
+ranks hammering a recovering coordinator don't re-collide in lockstep.
+
+Env knobs (docs/robustness.md):
+
+- ``HVTPU_KV_RETRY_ATTEMPTS``   (default 4)  total attempts per KV op
+- ``HVTPU_KV_RETRY_BASE_MS``    (default 50) first-retry backoff cap
+- ``HVTPU_KV_RETRY_MAX_MS``     (default 2000) per-sleep cap
+- ``HVTPU_KV_RETRY_DEADLINE_S`` (default 30) wall-clock budget per op
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from typing import Any, Callable, Optional, Tuple
+
+from ..obs import metrics as obs_metrics
+from . import faults
+
+_M_KV_RETRIES = obs_metrics.counter(
+    "hvtpu_kv_retries_total",
+    "Coordination-KV operations retried after a transient failure.")
+_M_KV_EXHAUSTED = obs_metrics.counter(
+    "hvtpu_kv_retry_exhausted_total",
+    "Coordination-KV operations that failed even after exhausting the "
+    "retry budget (the error then surfaces to the caller).")
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Immutable retry schedule + classification.
+
+    ``retryable`` classifies exceptions; ``retry_result`` (optional)
+    classifies RETURN VALUES that should be retried (subprocess results
+    carrying an infra-crash signature, say).  ``max_attempts`` counts
+    total attempts including the first; ``deadline_s`` bounds the whole
+    call in wall-clock time.  ``base_delay_s`` of 0 retries immediately
+    (the gloo policy: the race is gone on re-run, waiting buys nothing).
+    """
+
+    name: str
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    deadline_s: Optional[float] = None
+    retryable: Callable[[BaseException], bool] = lambda e: True
+    retry_result: Optional[Callable[[Any], bool]] = None
+
+    def backoff_s(self, attempt: int, rng: random.Random) -> float:
+        """Full-jitter sleep before retry ``attempt`` (1-based)."""
+        if self.base_delay_s <= 0:
+            return 0.0
+        cap = min(self.max_delay_s,
+                  self.base_delay_s * (2.0 ** (attempt - 1)))
+        return rng.uniform(0.0, cap)
+
+
+class RetryExhausted(Exception):
+    """Raised only for result-based exhaustion when the caller asked
+    for it; exception-based exhaustion re-raises the original error so
+    existing ``except`` clauses keep matching."""
+
+
+def call(policy: RetryPolicy, fn: Callable, *args,
+         on_retry: Optional[Callable[[int, Optional[BaseException]],
+                                     None]] = None,
+         rng: Optional[random.Random] = None, **kwargs):
+    """Run ``fn(*args, **kwargs)`` under ``policy``.
+
+    On a retryable exception: sleep (full jitter) and re-attempt until
+    ``max_attempts`` or ``deadline_s`` runs out, then re-raise the
+    LAST exception (no wrapper type — callers' handlers keep working).
+    With ``retry_result``, a True-classified return value is retried
+    the same way and the final value is returned once the budget is
+    spent.  ``on_retry(attempt, exc_or_None)`` fires before each sleep.
+    """
+    rng = rng or random.Random()
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            result = fn(*args, **kwargs)
+        except Exception as e:
+            budget_left = (
+                attempt < policy.max_attempts
+                and (policy.deadline_s is None
+                     or time.monotonic() - start < policy.deadline_s))
+            if not policy.retryable(e) or not budget_left:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            time.sleep(policy.backoff_s(attempt, rng))
+            continue
+        if (policy.retry_result is not None
+                and policy.retry_result(result)
+                and attempt < policy.max_attempts
+                and (policy.deadline_s is None
+                     or time.monotonic() - start < policy.deadline_s)):
+            if on_retry is not None:
+                on_retry(attempt, None)
+            time.sleep(policy.backoff_s(attempt, rng))
+            continue
+        return result
+
+
+def retrying(policy: RetryPolicy):
+    """Decorator form of :func:`call`."""
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return call(policy, fn, *args, **kwargs)
+        return wrapped
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# named policies
+# ---------------------------------------------------------------------------
+
+# Transient coordination-service failure signatures (grpc status names
+# + socket-level shapes).  NOT_FOUND is deliberately absent: a missing
+# key is a legitimate answer for try_get, not a failure to retry.
+_KV_TRANSIENT_MARKERS = (
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED",
+    "failed to connect", "Connection reset", "connection reset",
+    "Broken pipe", "Socket closed", "coordination service",
+)
+
+
+def kv_retryable(e: BaseException) -> bool:
+    if isinstance(e, TimeoutError):
+        return True
+    msg = str(e)
+    return any(m in msg for m in _KV_TRANSIENT_MARKERS)
+
+
+def kv_blocking_retryable(e: BaseException) -> bool:
+    """Blocking-get variant: a NOT_FOUND/timeout just means the peer
+    hasn't posted yet — poll again until the caller's deadline."""
+    return kv_retryable(e) or "NOT_FOUND" in str(e)
+
+
+def kv_policy(deadline_s: Optional[float] = None) -> RetryPolicy:
+    """The coordination-KV policy, env-tunable (module docstring)."""
+    return RetryPolicy(
+        name="kv",
+        max_attempts=int(os.environ.get("HVTPU_KV_RETRY_ATTEMPTS", "4")),
+        base_delay_s=float(
+            os.environ.get("HVTPU_KV_RETRY_BASE_MS", "50")) / 1000.0,
+        max_delay_s=float(
+            os.environ.get("HVTPU_KV_RETRY_MAX_MS", "2000")) / 1000.0,
+        deadline_s=(float(os.environ.get("HVTPU_KV_RETRY_DEADLINE_S",
+                                         "30"))
+                    if deadline_s is None else deadline_s),
+        retryable=kv_retryable,
+    )
+
+
+#: jaxlib/gloo CPU-transport teardown-race signatures (a rank SIGSEGVs
+#: mid-collective; peers see the torn socket).  Shared by the policy
+#: below and the test-suite launch retries.
+GLOO_INFRA_MARKERS: Tuple[str, ...] = (
+    "Connection closed by peer", "Socket closed",
+    "collective transport failure", "connection reset by peer",
+)
+
+
+def is_gloo_infra_error(text: str) -> bool:
+    """True when ``text`` (an exception string or a process's combined
+    output) carries a gloo teardown-race signature rather than a
+    framework failure."""
+    return any(m in text for m in GLOO_INFRA_MARKERS)
+
+
+def gloo_teardown_policy(max_attempts: int = 5,
+                         retry_result: Optional[Callable[[Any], bool]]
+                         = None) -> RetryPolicy:
+    """Bounded relaunch for the gloo CPU teardown race: immediate
+    re-run (the race is load-timing, not state), exception-classified
+    by :func:`is_gloo_infra_error`; pass ``retry_result`` to also
+    classify completed-subprocess results (rc + output blob)."""
+    return RetryPolicy(
+        name="gloo-teardown",
+        max_attempts=max_attempts,
+        base_delay_s=0.0,
+        retryable=lambda e: is_gloo_infra_error(str(e)),
+        retry_result=retry_result,
+    )
+
+
+GLOO_TEARDOWN = gloo_teardown_policy()
+
+
+# ---------------------------------------------------------------------------
+# resilient coordination-KV wrapper
+# ---------------------------------------------------------------------------
+
+
+class ResilientKV:
+    """Coordination-service client wrapper: fault injection (sites
+    ``kv.get`` / ``kv.put``) + bounded retry with backoff on transient
+    failures, counting into the metrics registry.
+
+    Dropped-op semantics (the ``drop`` fault action): a dropped read is
+    a miss (``KeyError`` for try_get — the same "no such key" contract
+    the raw client's error has, which every caller already treats as
+    absent; ``[]`` for dir_get; ``TimeoutError`` for blocking_get), a
+    dropped write/delete silently does nothing.  ``blocking_key_value_get``
+    is NOT retried here — its callers own a deadline loop already.
+
+    Attributes the wrapped client lacks stay missing (``key_value_dir_get``
+    presence is how comm/stall.py picks amortized vs strict mode), and
+    unknown attributes delegate, so the wrapper is drop-in.
+    """
+
+    def __init__(self, client, rank: int = 0,
+                 policy: Optional[RetryPolicy] = None):
+        self._kv = client
+        self._rank = rank
+        self._policy = policy or kv_policy()
+        self._rng = random.Random(0x6B76 + rank)
+        if hasattr(client, "key_value_dir_get"):
+            # instance attribute, so ``getattr(kv, "key_value_dir_get",
+            # None)`` stays None for clients without a dir get
+            self.key_value_dir_get = self._dir_get
+
+    def _on_retry(self, attempt: int, exc) -> None:
+        _M_KV_RETRIES.inc()
+
+    def _call(self, fn, *args):
+        try:
+            return call(self._policy, fn, *args,
+                        on_retry=self._on_retry, rng=self._rng)
+        except Exception as e:
+            if kv_retryable(e):
+                _M_KV_EXHAUSTED.inc()
+            raise
+
+    # Fault injection happens INSIDE the retried closures below, so an
+    # ``error``-injected op (whose message carries UNAVAILABLE) is
+    # retried exactly like a real coordinator blip — and heals once the
+    # clause's budget is spent.  ``drop`` never raises, so it is never
+    # retried: a dropped write stays dropped.
+
+    # -- mutations (site kv.put) ---------------------------------------
+    def key_value_set(self, key: str, value: str):
+        def _put():
+            if faults.ACTIVE and faults.inject("kv.put", detail=key):
+                return None
+            return self._kv.key_value_set(key, value)
+
+        return self._call(_put)
+
+    def key_value_delete(self, key: str):
+        if faults.ACTIVE and faults.inject("kv.put", detail=key):
+            return None
+        # best-effort by contract (callers swallow failures); one shot
+        return self._kv.key_value_delete(key)
+
+    # -- reads (site kv.get) -------------------------------------------
+    def key_value_try_get(self, key: str):
+        def _get():
+            if faults.ACTIVE and faults.inject("kv.get", detail=key):
+                raise KeyError(f"{key} (dropped by fault injection)")
+            return self._kv.key_value_try_get(key)
+
+        return self._call(_get)
+
+    def _dir_get(self, prefix: str):
+        def _get():
+            if faults.ACTIVE and faults.inject("kv.get", detail=prefix):
+                return []
+            return self._kv.key_value_dir_get(prefix)
+
+        return self._call(_get)
+
+    def blocking_key_value_get(self, key: str, timeout_ms: int):
+        if faults.ACTIVE and faults.inject("kv.get", detail=key):
+            raise TimeoutError(f"{key} (dropped by fault injection)")
+        return self._kv.blocking_key_value_get(key, timeout_ms)
+
+    def __getattr__(self, name):
+        return getattr(self._kv, name)
+
+
+def resilient_kv(client, rank: int = 0,
+                 policy: Optional[RetryPolicy] = None):
+    """Wrap ``client`` (idempotently) in :class:`ResilientKV`."""
+    if client is None or isinstance(client, ResilientKV):
+        return client
+    return ResilientKV(client, rank=rank, policy=policy)

@@ -182,6 +182,16 @@ def init(config: Optional[Config] = None) -> GlobalState:
         _state.rank = jax.process_index()
         _state.size = jax.process_count()
 
+        # Fault-injection harness (core/faults.py): armed once the true
+        # rank is known so rank-selected clauses bind correctly.  A
+        # malformed HVTPU_FAULT_SPEC fails init loudly (FaultSpecError)
+        # — a chaos run that silently tests nothing is worse than one
+        # that refuses to start.
+        if cfg.fault_spec:
+            from . import faults as _faults
+
+            _faults.install_from_config(cfg, _state.rank)
+
         # Below-WARNING levels need a real handler: Python's lastResort
         # handler only emits WARNING+, so an explicit HVTPU_LOG_LEVEL of
         # info/debug would otherwise be silently inert (the reference's

@@ -5,12 +5,23 @@ Parity surface: ``horovod/runner/elastic/discovery.py``
 prints the currently-available ``host:slots`` lines; the driver polls it
 on an interval and reacts to diffs, maintaining a blacklist of hosts
 that failed.
+
+Departure from upstream: the reference blacklist is PERMANENT (a host
+that strikes out never runs again, even after a reboot fixes it).
+Here blacklisting is a **cooldown** with exponential re-admission —
+strike ``k`` sidelines a host for ``base * 2**(k-1)`` seconds (capped),
+after which it is probed again; a successful incarnation decays its
+strike count.  A flaky-but-recovering host rejoins the world instead of
+shrinking it forever, while a persistently bad host backs off toward
+the cap and contributes almost no churn.
 """
 
 from __future__ import annotations
 
+import os
 import subprocess
-from typing import Dict, List, Optional, Set
+import time
+from typing import Dict, List, Optional
 
 from ..runner import hosts as hosts_mod
 
@@ -44,41 +55,108 @@ class HostDiscoveryScript:
         return slots
 
 
-class HostManager:
-    """Tracks current hosts, computes diffs, maintains the blacklist
-    (parity: HostManager + the blacklist in
-    horovod/runner/elastic/registration.py)."""
+class _BlacklistEntry:
+    __slots__ = ("strikes", "until")
 
-    def __init__(self, discovery: HostDiscoveryScript):
+    def __init__(self):
+        self.strikes = 0
+        self.until = 0.0
+
+
+class HostManager:
+    """Tracks current hosts, computes diffs, maintains the cooldown
+    blacklist (parity: HostManager + the blacklist in
+    horovod/runner/elastic/registration.py, with re-admission added —
+    see the module docstring)."""
+
+    def __init__(self, discovery: HostDiscoveryScript,
+                 cooldown_base_s: Optional[float] = None,
+                 cooldown_max_s: Optional[float] = None):
         self._discovery = discovery
         self.current: Dict[str, int] = {}
         self.last_found: Dict[str, int] = {}
-        self.blacklist: Set[str] = set()
+        self._blacklist: Dict[str, _BlacklistEntry] = {}
+        self.cooldown_base_s = (
+            float(os.environ.get("HVTPU_BLACKLIST_COOLDOWN_SECONDS",
+                                 "300"))
+            if cooldown_base_s is None else cooldown_base_s)
+        self.cooldown_max_s = (
+            float(os.environ.get("HVTPU_BLACKLIST_COOLDOWN_MAX_SECONDS",
+                                 "3600"))
+            if cooldown_max_s is None else cooldown_max_s)
 
-    def blacklist_host(self, hostname: str):
-        self.blacklist.add(hostname)
+    # -- blacklist ------------------------------------------------------
+    def blacklist_host(self, hostname: str,
+                       now: Optional[float] = None) -> float:
+        """Record a strike: sideline ``hostname`` for ``base *
+        2**(strikes-1)`` seconds (capped) before it is probed again.
+        Returns the cooldown applied."""
+        now = time.monotonic() if now is None else now
+        entry = self._blacklist.setdefault(hostname, _BlacklistEntry())
+        entry.strikes += 1
+        cooldown = min(
+            self.cooldown_max_s,
+            self.cooldown_base_s * (2.0 ** (entry.strikes - 1)))
+        entry.until = now + cooldown
+        return cooldown
 
-    def refresh(self) -> bool:
+    def record_success(self, hostname: str) -> None:
+        """Decay one strike after an incarnation where this host's
+        workers all exited cleanly (done or reset-requested); at zero
+        strikes the entry is forgotten entirely."""
+        entry = self._blacklist.get(hostname)
+        if entry is None:
+            return
+        entry.strikes -= 1
+        if entry.strikes <= 0:
+            del self._blacklist[hostname]
+
+    def blacklisted_now(self, now: Optional[float] = None) -> List[str]:
+        """Hosts currently inside a cooldown window."""
+        now = time.monotonic() if now is None else now
+        return sorted(h for h, e in self._blacklist.items()
+                      if e.until > now)
+
+    def strikes(self, hostname: str) -> int:
+        entry = self._blacklist.get(hostname)
+        return entry.strikes if entry is not None else 0
+
+    def next_readmission_s(self, now: Optional[float] = None
+                           ) -> Optional[float]:
+        """Seconds until the soonest cooldown expires, or None when no
+        host is currently sidelined."""
+        now = time.monotonic() if now is None else now
+        pending = [e.until - now for e in self._blacklist.values()
+                   if e.until > now]
+        return min(pending) if pending else None
+
+    # -- discovery ------------------------------------------------------
+    def refresh(self, now: Optional[float] = None) -> bool:
         """Poll discovery; returns True if the effective host set
-        changed (additions or removals, after blacklist filtering)."""
+        changed (additions, removals, or a cooldown expiring/engaging,
+        after blacklist filtering)."""
         found = self._discovery.find_available_hosts_and_slots()
         self.last_found = dict(found)
+        cooling = set(self.blacklisted_now(now))
         effective = {
-            h: s for h, s in found.items() if h not in self.blacklist
+            h: s for h, s in found.items() if h not in cooling
         }
         changed = effective != self.current
         self.current = effective
         return changed
 
-    def exhausted(self, min_np: int) -> bool:
+    def exhausted(self, min_np: int,
+                  now: Optional[float] = None) -> bool:
         """True when the last discovery succeeded yet EVERY discovered
-        host is blacklisted — hosts never leave the blacklist, so
-        unless discovery produces brand-new hosts the wait is hopeless
-        and the driver should fail fast instead of burning the full
-        elastic timeout."""
+        host is inside a cooldown window.  Unlike the old permanent
+        blacklist this is no longer hopeless — the driver consults
+        ``next_readmission_s`` to decide whether waiting out the
+        soonest cooldown fits its deadline."""
         del min_np  # reserved for smarter policies
-        return (bool(self.last_found)
-                and all(h in self.blacklist for h in self.last_found))
+        if not self.last_found:
+            return False
+        cooling = set(self.blacklisted_now(now))
+        return all(h in cooling for h in self.last_found)
 
     def available_slots(self) -> int:
         return sum(self.current.values())

@@ -41,6 +41,10 @@ from .worker import RESET_EXIT_CODE
 
 # A host is blacklisted after this many consecutive crashed (not
 # reset-requested) workers (parity: registration.py blacklist policy).
+# Blacklisting is a COOLDOWN, not a life sentence: see
+# discovery.HostManager (exponential re-admission) — upstream Horovod
+# never re-admits a blacklisted host; we probe it again after the
+# cooldown and decay strikes on successful incarnations.
 BLACKLIST_THRESHOLD = 3
 
 # Driver-side telemetry (obs/metrics.py): the driver process keeps its
@@ -58,6 +62,13 @@ _M_RENDEZVOUS_S = obs_metrics.histogram(
     "hvtpu_elastic_rendezvous_seconds",
     "Driver-side rendezvous: discovery reaching min_np through a "
     "launched worker set, per incarnation.")
+_M_BLACKLISTED = obs_metrics.gauge(
+    "hvtpu_elastic_blacklisted_hosts",
+    "Hosts currently sidelined by the cooldown blacklist.")
+_M_BUDGET_LEFT = obs_metrics.gauge(
+    "hvtpu_elastic_restart_budget_remaining",
+    "Relaunches left before the driver declares the workload "
+    "crash-looping and fails fast (-1 = unlimited).")
 
 _TERM_CODES = (-signal.SIGTERM, 128 + signal.SIGTERM)
 # SIGUSR1 arriving before the worker installed its handler kills the
@@ -80,14 +91,26 @@ class ElasticDriver:
         args: Optional[argparse.Namespace] = None,
         state_dir: Optional[str] = None,
         verbose: bool = False,
+        max_restarts: int = -1,
+        restart_window: float = 0.0,
+        blacklist_cooldown: Optional[float] = None,
     ):
         self.command = command
-        self.hosts = HostManager(discovery)
+        self.hosts = HostManager(discovery,
+                                 cooldown_base_s=blacklist_cooldown)
         self.min_np = min_np
         self.max_np = max_np
         self.interval = discovery_interval
         self.elastic_timeout = elastic_timeout
         self.args = args
+        # restart budget: total relaunches allowed (-1 = unlimited);
+        # with restart_window > 0 only relaunches inside the trailing
+        # window count, so a long job survives occasional preemptions
+        # while a tight crash loop still trips the budget.
+        self.max_restarts = max_restarts
+        self.restart_window = restart_window
+        self._restart_times: List[float] = []
+        self._last_crash_summary = ""
         # durable-commit location: explicit arg > caller's env (a user
         # pointing commits at a persistent/shared filesystem) > fresh
         # temp dir owned — and cleaned up on success — by this driver
@@ -126,13 +149,29 @@ class ElasticDriver:
         deadline = time.monotonic() + self.elastic_timeout
         while time.monotonic() < deadline:
             self._refresh_hosts()
+            _M_BLACKLISTED.set(len(self.hosts.blacklisted_now()))
             if self.hosts.available_slots() >= self.min_np:
                 return True
             if self.hosts.exhausted(self.min_np):
-                # every discovered host is blacklisted and blacklists
-                # are permanent: waiting cannot help
-                self._log("all discovered hosts blacklisted; giving up")
-                return False
+                # every discovered host is cooling down; wait out the
+                # soonest re-admission when it fits the deadline,
+                # otherwise fail fast instead of burning the timeout
+                readmit = self.hosts.next_readmission_s()
+                remaining = deadline - time.monotonic()
+                if readmit is None:
+                    pass  # raced with an expiry: re-poll immediately
+                elif readmit >= remaining:
+                    self._log(
+                        "all discovered hosts blacklisted and the "
+                        f"soonest re-admission is {readmit:.0f}s away "
+                        f"(> {remaining:.0f}s left); giving up")
+                    return False
+                else:
+                    self._log(
+                        "all discovered hosts blacklisted; probing "
+                        f"again in {readmit:.0f}s")
+                    time.sleep(min(readmit + 0.05, remaining))
+                continue
             time.sleep(self.interval)
         return False
 
@@ -181,6 +220,8 @@ class ElasticDriver:
 
     def run(self) -> int:
         """Main loop (parity: ElasticDriver.start + _run_elastic)."""
+        _M_BUDGET_LEFT.set(self.max_restarts
+                           if self.max_restarts >= 0 else -1)
         while True:
             t_rdv = time.monotonic()
             if not self._wait_for_min_hosts():
@@ -217,7 +258,41 @@ class ElasticDriver:
             if outcome == "failed":
                 return 1
             # outcome == "restart": loop around, re-discover, relaunch
+            # — unless the restart budget says this workload is
+            # crash-looping and relaunching forever helps nobody.
             _M_RESTARTS.inc()
+            if not self._restart_budget_ok():
+                return 1
+
+    def _restart_budget_ok(self) -> bool:
+        """Charge one relaunch against the budget; False (with a
+        diagnostic) when it is exhausted."""
+        now = time.monotonic()
+        self._restart_times.append(now)
+        if self.restart_window > 0:
+            self._restart_times = [
+                t for t in self._restart_times
+                if now - t <= self.restart_window]
+        used = len(self._restart_times)
+        if self.max_restarts < 0:
+            _M_BUDGET_LEFT.set(-1)
+            return True
+        remaining = self.max_restarts - used
+        _M_BUDGET_LEFT.set(max(remaining, 0))
+        if remaining >= 0:
+            return True
+        window = (f" within {self.restart_window:.0f}s"
+                  if self.restart_window > 0 else "")
+        crashes = self._last_crash_summary or "no crash details recorded"
+        print(
+            f"hvtpu.elastic: restart budget exhausted — {used} "
+            f"relaunches{window} > --max-restarts={self.max_restarts}; "
+            "the workload is crash-looping, not recovering. "
+            f"Last incarnation: {crashes}. Fix the failing rank (or "
+            "raise --max-restarts / HVTPU_MAX_RESTARTS) and relaunch.",
+            file=sys.stderr, flush=True,
+        )
+        return False
 
     def _supervise(self, workers, slots) -> str:
         """Watch one incarnation. Returns 'done' | 'restart' | 'failed'."""
@@ -262,6 +337,11 @@ class ElasticDriver:
 
     def _finish_incarnation(self, workers, slots, crashed) -> str:
         by_rank_host = {s.rank: s.hostname for s in slots}
+        self._last_crash_summary = "; ".join(
+            f"rank {w.rank} on {by_rank_host.get(w.rank, '?')} exited "
+            f"{code}" for w, code in crashed) or "no crashes (reset)"
+        crashed_hosts = {by_rank_host.get(w.rank, "?")
+                         for w, _code in crashed}
         for w, code in crashed:
             host = by_rank_host.get(w.rank, "?")
             self._crash_counts[host] = self._crash_counts.get(host, 0) + 1
@@ -270,8 +350,22 @@ class ElasticDriver:
                 f"({self._crash_counts[host]} strikes)"
             )
             if self._crash_counts[host] >= BLACKLIST_THRESHOLD:
-                self._log(f"blacklisting {host}")
-                self.hosts.blacklist_host(host)
+                cooldown = self.hosts.blacklist_host(host)
+                self._log(
+                    f"blacklisting {host} for {cooldown:.0f}s "
+                    f"(strike {self.hosts.strikes(host)})")
+                # a fresh threshold applies after re-admission; the
+                # cooldown's own strike count carries the history
+                self._crash_counts[host] = 0
+        # decay: hosts whose workers all exited cleanly this
+        # incarnation earn back a crash count and a blacklist strike —
+        # a recovered host must not stay one crash from the blacklist
+        # forever.
+        for host in {s.hostname for s in slots} - crashed_hosts:
+            if self._crash_counts.get(host, 0) > 0:
+                self._crash_counts[host] -= 1
+            self.hosts.record_success(host)
+        _M_BLACKLISTED.set(len(self.hosts.blacklisted_now()))
         # grace period for the rest to exit at a commit boundary
         self._notify_hosts_updated(workers)
         deadline = time.monotonic() + 30.0
@@ -296,6 +390,14 @@ def run_elastic_driver(args: argparse.Namespace
     collection) use this; the CLI wrapper below keeps the int
     contract."""
     discovery = HostDiscoveryScript(args.host_discovery_script)
+    max_restarts = getattr(args, "max_restarts", None)
+    if max_restarts is None:
+        max_restarts = int(os.environ.get("HVTPU_MAX_RESTARTS", "-1"))
+    restart_window = getattr(args, "restart_window", None)
+    if restart_window is None:
+        restart_window = float(
+            os.environ.get("HVTPU_RESTART_WINDOW_SECONDS", "0"))
+    blacklist_cooldown = getattr(args, "blacklist_cooldown", None)
     driver = ElasticDriver(
         command=args.command,
         discovery=discovery,
@@ -308,6 +410,9 @@ def run_elastic_driver(args: argparse.Namespace
         elastic_timeout=args.elastic_timeout or 600.0,
         args=args,
         verbose=args.verbose,
+        max_restarts=max_restarts,
+        restart_window=restart_window,
+        blacklist_cooldown=blacklist_cooldown,
     )
     return driver.run(), driver
 

@@ -93,6 +93,12 @@ class State:
         """Snapshot state (memory, and the durable dir per the commit
         policy) then check for host updates (parity: State.commit =
         save + check_host_updates)."""
+        # step boundary: the worker.step fault-injection site (a kill
+        # here dies BEFORE the snapshot, so recovery resumes from the
+        # previous commit — the realistic mid-step death)
+        from . import worker as _worker
+
+        _worker.note_step()
         self._commit_count += 1
         durable = self._commit_count % self._durable_every == 0
         if not durable and self._host_messages.flag \

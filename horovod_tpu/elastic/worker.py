@@ -22,10 +22,15 @@ import os
 import signal
 import sys
 
+import logging
+
+from ..core import faults
 from ..core import state as core_state
 from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..obs import metrics as obs_metrics
 from .state import State, _HostUpdateFlag
+
+logger = logging.getLogger("horovod_tpu")
 
 # Worker-side elastic telemetry (obs/metrics.py): reset requests by
 # cause — the driver's restart counter says HOW OFTEN the world was
@@ -34,6 +39,11 @@ _M_RESETS = obs_metrics.counter(
     "hvtpu_elastic_worker_resets_total",
     "World-reset requests issued by this worker, by reason "
     "(collective_failure | hosts_updated).")
+_M_SIGUSR1_FAILED = obs_metrics.counter(
+    "hvtpu_elastic_sigusr1_install_failed_total",
+    "Failed attempts to install the driver-notification (SIGUSR1) "
+    "handler; membership changes then surface as driver-initiated "
+    "restarts only.")
 
 # Exit code the driver interprets as "re-rendezvous requested" (worker
 # hit a recoverable elastic event); anything else non-zero is a crash.
@@ -51,8 +61,26 @@ def _install_sigusr1_handler():
         signal.signal(signal.SIGUSR1, handler)
     except ValueError:
         # non-main thread (e.g. tests importing under a runner thread):
-        # notifications degrade to driver-initiated restarts only.
-        pass
+        # notifications degrade to driver-initiated restarts only —
+        # a real elastic job losing this channel is worth knowing
+        # about, so say so instead of degrading silently.
+        _M_SIGUSR1_FAILED.inc()
+        logger.warning(
+            "could not install the SIGUSR1 host-update handler "
+            "(signal.signal outside the main thread); driver "
+            "membership notifications degrade to SIGUSR1-kill -> "
+            "restart instead of commit-boundary resets")
+
+
+def note_step() -> None:
+    """The ``worker.step`` fault-injection site (core/faults.py),
+    invoked by ``State.commit()`` at every commit boundary — the
+    canonical 'step' of an elastic loop.  A ``kill`` clause here
+    reproduces the worker-dies-mid-training scenario the driver's
+    recovery loop exists for; the empty-spec cost is one attribute
+    read."""
+    if faults.ACTIVE:
+        faults.inject("worker.step")
 
 
 def run(func):

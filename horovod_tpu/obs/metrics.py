@@ -537,40 +537,57 @@ def aggregate(process_set=None, timeout_s: float = 60.0,
             f"rank {st.rank} is not a member of process set "
             f"{ps.process_set_id}")
 
+    # One shared retry engine (core/retry.py) instead of the ad-hoc
+    # loop this function used to carry: the KV wrapper retries
+    # transient put failures with backoff (counted in
+    # hvtpu_kv_retries_total), and the per-peer blocking poll rides a
+    # deadline-bounded policy where NOT_FOUND/timeout just means "the
+    # peer hasn't posted yet".
+    from ..core import retry as core_retry
+
+    kv = core_retry.resilient_kv(client, rank=st.rank)
+
     with _agg_lock:
         key = (st.init_generation, ps.process_set_id)
         seq = _agg_seq.get(key, 0)
         _agg_seq[key] = seq + 1
     prefix = (f"{_AGG_NS}/{st.init_generation}/{ps.process_set_id}/"
               f"{seq}/")
-    client.key_value_set(prefix + str(st.rank), json.dumps(snap))
+    kv.key_value_set(prefix + str(st.rank), json.dumps(snap))
 
     per_rank: Dict[int, dict] = {st.rank: snap}
     deadline = time.monotonic() + timeout_s
+    poll_policy = core_retry.RetryPolicy(
+        name="metrics-aggregate",
+        max_attempts=1_000_000,  # the deadline is the real bound
+        base_delay_s=0.02, max_delay_s=0.25,
+        deadline_s=timeout_s,
+        retryable=core_retry.kv_blocking_retryable)
+
+    def _fetch(r: int) -> dict:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            # non-retryable by design: the GLOBAL deadline bounds the
+            # whole aggregate, not each peer's poll loop
+            raise RuntimeError("aggregate budget spent")
+        budget_ms = max(1, int(remaining * 1000))
+        return json.loads(kv.blocking_key_value_get(
+            prefix + str(r), min(budget_ms, 2000)))
+
     for r in sorted(members):
         if r == st.rank:
             continue
-        while True:
-            budget_ms = max(1, int((deadline - time.monotonic()) * 1000))
-            try:
-                val = client.blocking_key_value_get(
-                    prefix + str(r), min(budget_ms, 2000))
-                per_rank[r] = json.loads(val)
-                break
-            except Exception as e:
-                msg = str(e)
-                retryable = (isinstance(e, TimeoutError)
-                             or "DEADLINE_EXCEEDED" in msg
-                             or "NOT_FOUND" in msg)
-                if not retryable or time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"metrics snapshot from rank {r} not posted "
-                        f"within {timeout_s:.0f}s") from None
+        try:
+            per_rank[r] = core_retry.call(poll_policy, _fetch, r)
+        except Exception:
+            raise TimeoutError(
+                f"metrics snapshot from rank {r} not posted "
+                f"within {timeout_s:.0f}s") from None
     # rolling cleanup: every member posted seq, so nobody still needs
     # this rank's previous round (each rank deletes only its own key)
     if seq > 0:
         try:
-            client.key_value_delete(
+            kv.key_value_delete(
                 f"{_AGG_NS}/{st.init_generation}/{ps.process_set_id}/"
                 f"{seq - 1}/{st.rank}")
         except Exception:

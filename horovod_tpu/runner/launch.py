@@ -164,6 +164,29 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
     p.add_argument("--elastic-timeout", type=float, default=None)
+    p.add_argument("--max-restarts", type=int, default=None,
+                   help="elastic restart budget: relaunches allowed "
+                        "before the driver declares the workload "
+                        "crash-looping and exits with a diagnostic "
+                        "(default: unlimited; HVTPU_MAX_RESTARTS)")
+    p.add_argument("--restart-window", type=float, default=None,
+                   help="seconds: apply --max-restarts to a sliding "
+                        "window instead of the whole job "
+                        "(HVTPU_RESTART_WINDOW_SECONDS)")
+    p.add_argument("--blacklist-cooldown", type=float, default=None,
+                   help="seconds a host stays blacklisted after its "
+                        "first strike; doubles per strike "
+                        "(HVTPU_BLACKLIST_COOLDOWN_SECONDS, default 300)")
+    # fault injection (core/faults.py; docs/robustness.md)
+    p.add_argument("--fault-spec", default=None,
+                   help="deterministic fault-injection spec exported "
+                        "to workers as HVTPU_FAULT_SPEC, e.g. "
+                        "'worker.step:kill@rank=1,count=3' "
+                        "(docs/robustness.md for the grammar)")
+    p.add_argument("--fault-seed", type=int, default=None,
+                   help="seed for prob= fault selectors "
+                        "(HVTPU_FAULT_SEED; per-rank streams derive "
+                        "from it, so a seed reproduces a schedule)")
     # CPU-simulation mode (this sandbox / CI: N ranks on localhost CPU)
     p.add_argument("--cpu-devices", type=int, default=None,
                    help="force the CPU platform with this many XLA "
@@ -282,6 +305,8 @@ def build_worker_env(
             "HVTPU_STALL_HEARTBEAT_SECONDS": args.stall_heartbeat,
             "HVTPU_LOG_LEVEL": args.log_level,
             "HVTPU_CPU_DEVICES": args.cpu_devices,
+            "HVTPU_FAULT_SPEC": getattr(args, "fault_spec", None),
+            "HVTPU_FAULT_SEED": getattr(args, "fault_seed", None),
             "HVTPU_ELASTIC_TIMEOUT": args.elastic_timeout,
             "HVTPU_START_TIMEOUT": args.start_timeout,
             "HVTPU_AUTOTUNE_WARMUP_SAMPLES": args.autotune_warmup_samples,
@@ -490,6 +515,14 @@ def _run(args: argparse.Namespace) -> int:
         return 0
     if args.check_build:
         return _check_build()
+    if args.fault_spec:
+        from ..core.faults import FaultSpecError, parse_spec
+
+        try:
+            parse_spec(args.fault_spec)  # fail fast, before any spawn
+        except FaultSpecError as e:
+            print(f"hvtpurun: --fault-spec: {e}", file=sys.stderr)
+            return 2
     if args.host_discovery_script:
         from ..elastic.driver import run_elastic
 

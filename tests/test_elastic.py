@@ -244,3 +244,112 @@ class TestDiscovery:
         assert mgr.refresh() is True
         assert mgr.available_slots() == 1  # a filtered out
         assert mgr.host_spec() == "b:1"
+
+
+class TestCooldownBlacklist:
+    """The cooldown blacklist (ISSUE-2): exponential re-admission
+    replaces upstream's permanent blacklist, strikes decay on
+    successful incarnations, and the driver's wait loop can reason
+    about the soonest re-admission."""
+
+    def _mgr(self, tmp_path, spec="a:2\nb:2", base=10.0):
+        hosts_file = tmp_path / "hosts.txt"
+        hosts_file.write_text(spec + "\n")
+        script = tmp_path / "discover.sh"
+        script.write_text(f'#!/bin/sh\ncat "{hosts_file}"\n')
+        script.chmod(0o755)
+        return HostManager(HostDiscoveryScript(str(script)),
+                           cooldown_base_s=base)
+
+    def test_cooldown_doubles_per_strike(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        assert mgr.blacklist_host("a", now=100.0) == 10.0
+        assert mgr.blacklist_host("a", now=100.0) == 20.0
+        assert mgr.blacklist_host("a", now=100.0) == 40.0
+        assert mgr.strikes("a") == 3
+
+    def test_cooldown_is_capped(self, tmp_path):
+        mgr = self._mgr(tmp_path, base=10.0)
+        mgr.cooldown_max_s = 25.0
+        for _ in range(5):
+            cd = mgr.blacklist_host("a", now=0.0)
+        assert cd == 25.0
+
+    def test_readmission_after_cooldown(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        mgr.blacklist_host("a", now=100.0)  # until 110
+        assert mgr.refresh(now=105.0) is True
+        assert mgr.host_spec() == "b:2"
+        assert mgr.blacklisted_now(now=105.0) == ["a"]
+        # cooldown expired: the host is probed again
+        assert mgr.refresh(now=111.0) is True
+        assert mgr.host_spec() == "a:2,b:2"
+        assert mgr.blacklisted_now(now=111.0) == []
+
+    def test_success_decays_strikes(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        mgr.blacklist_host("a", now=0.0)
+        mgr.blacklist_host("a", now=0.0)
+        mgr.record_success("a")
+        assert mgr.strikes("a") == 1
+        mgr.record_success("a")
+        assert mgr.strikes("a") == 0
+        assert mgr.blacklisted_now(now=0.0) == []
+        mgr.record_success("a")  # decay below zero is a no-op
+        assert mgr.strikes("a") == 0
+        # the next strike starts over at the BASE cooldown
+        assert mgr.blacklist_host("a", now=0.0) == 10.0
+
+    def test_exhausted_and_next_readmission(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        mgr.refresh(now=100.0)
+        assert mgr.exhausted(2, now=100.0) is False
+        mgr.blacklist_host("a", now=100.0)   # until 110
+        mgr.blacklist_host("b", now=100.0)   # until 110
+        mgr.blacklist_host("b", now=100.0)   # until 120
+        mgr.refresh(now=105.0)
+        assert mgr.exhausted(2, now=105.0) is True
+        assert mgr.next_readmission_s(now=105.0) == 5.0
+        # one cooldown lapses: no longer exhausted
+        assert mgr.exhausted(2, now=115.0) is False
+
+
+class TestRestartBudget:
+    def _driver(self, tmp_path, **kw):
+        from horovod_tpu.elastic.driver import ElasticDriver
+
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho localhost:2\n")
+        script.chmod(0o755)
+        return ElasticDriver(
+            command=["true"],
+            discovery=HostDiscoveryScript(str(script)),
+            min_np=2, state_dir=str(tmp_path), **kw)
+
+    def test_unlimited_by_default(self, tmp_path):
+        d = self._driver(tmp_path)
+        assert all(d._restart_budget_ok() for _ in range(50))
+
+    def test_total_budget_trips(self, tmp_path, capsys):
+        d = self._driver(tmp_path, max_restarts=2)
+        assert d._restart_budget_ok() is True
+        assert d._restart_budget_ok() is True
+        assert d._restart_budget_ok() is False
+        assert "restart budget exhausted" in capsys.readouterr().err
+
+    def test_zero_budget_fails_on_first_restart(self, tmp_path, capsys):
+        d = self._driver(tmp_path, max_restarts=0)
+        d._last_crash_summary = "rank 1 on localhost exited 1"
+        assert d._restart_budget_ok() is False
+        err = capsys.readouterr().err
+        assert "restart budget exhausted" in err
+        assert "rank 1 on localhost exited 1" in err
+
+    def test_window_forgives_old_restarts(self, tmp_path):
+        d = self._driver(tmp_path, max_restarts=1,
+                         restart_window=1000.0)
+        assert d._restart_budget_ok() is True
+        # age the recorded restart past the window: budget refills
+        d._restart_times = [t - 2000.0 for t in d._restart_times]
+        assert d._restart_budget_ok() is True
+        assert d._restart_budget_ok() is False

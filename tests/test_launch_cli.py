@@ -18,27 +18,28 @@ _REPO = os.path.dirname(os.path.dirname(horovod_tpu.__file__))
 # jaxlib's gloo CPU transport occasionally drops a connection under
 # parallel localhost load (a rank SIGSEGVs; peers report "Connection
 # closed by peer").  That race lives below this framework — retry the
-# whole launch so the acceptance assertions still gate every example,
-# but an infra crash alone doesn't flake CI.
-_INFRA_MARKS = ("Connection closed by peer", "Socket closed",
-                "collective transport failure",
-                "connection reset by peer")
+# whole launch (core/retry.py's named gloo-teardown policy) so the
+# acceptance assertions still gate every example, but an infra crash
+# alone doesn't flake CI.
+from horovod_tpu.core import retry as core_retry
+
+
+def _gloo_race(res):
+    return (res.returncode != 0
+            and core_retry.is_gloo_infra_error(res.stdout + res.stderr))
 
 
 def _hvtpurun(args, timeout=300):
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-    for attempt in range(3):
-        res = subprocess.run(
+    return core_retry.call(
+        core_retry.gloo_teardown_policy(max_attempts=3,
+                                        retry_result=_gloo_race),
+        lambda: subprocess.run(
             [sys.executable, "-m", "horovod_tpu.runner"] + args,
             capture_output=True, text=True, timeout=timeout, env=env,
             cwd=_REPO,
-        )
-        blob = res.stdout + res.stderr
-        if res.returncode == 0 or not any(m in blob
-                                          for m in _INFRA_MARKS):
-            break
-    return res
+        ))
 
 
 def test_cli_jax_mnist_2proc():

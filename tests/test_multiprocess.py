@@ -1111,18 +1111,12 @@ def test_eager_collectives_8proc():
     # np=8 on localhost occasionally trips a jaxlib/gloo teardown race
     # (one rank SIGSEGVs mid-collective, code -11, and the peers report
     # "Connection closed by peer").  That race is in the gloo transport,
-    # not this engine — retry once so the semantic assertions below
-    # still gate every op, but an infra crash alone doesn't flake CI.
-    infra_marks = ("Connection closed by peer", "Socket closed",
-                   "collective transport failure",
-                   "connection reset by peer")
-    for attempt in range(5):
-        try:
-            results = _run(body, np=8)
-            break
-        except RunError as e:
-            if attempt == 4 or not any(m in str(e) for m in infra_marks):
-                raise
+    # not this engine — retry via the named gloo-teardown policy
+    # (core/retry.py) so the semantic assertions below still gate every
+    # op, but an infra crash alone doesn't flake CI.
+    from horovod_tpu.core import retry as core_retry
+
+    results = core_retry.call(core_retry.GLOO_TEARDOWN, _run, body, np=8)
     assert len(results) == 8
     for _, out in sorted(results):
         assert out["sum"] == 36.0

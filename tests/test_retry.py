@@ -1,0 +1,251 @@
+"""core/retry.py: the bounded-backoff engine, named policies, and the
+resilient coordination-KV wrapper (fault sites kv.get / kv.put)."""
+
+import threading
+
+import pytest
+
+from horovod_tpu.core import faults, retry
+from horovod_tpu.obs import metrics as obs_metrics
+
+
+class Flaky:
+    """Callable failing the first N calls with a given exception."""
+
+    def __init__(self, fails, exc):
+        self.fails = fails
+        self.exc = exc
+        self.calls = 0
+
+    def __call__(self, *a):
+        self.calls += 1
+        if self.calls <= self.fails:
+            raise self.exc
+        return "ok"
+
+
+def _fast(attempts=4, retryable=lambda e: True, **kw):
+    return retry.RetryPolicy(name="t", max_attempts=attempts,
+                             base_delay_s=0.0, retryable=retryable, **kw)
+
+
+class TestCall:
+    def test_succeeds_after_transient_failures(self):
+        fn = Flaky(2, TimeoutError("x"))
+        assert retry.call(_fast(), fn) == "ok"
+        assert fn.calls == 3
+
+    def test_exhaustion_reraises_original_error(self):
+        fn = Flaky(10, TimeoutError("boom"))
+        with pytest.raises(TimeoutError, match="boom"):
+            retry.call(_fast(attempts=3), fn)
+        assert fn.calls == 3
+
+    def test_non_retryable_raises_immediately(self):
+        fn = Flaky(10, ValueError("nope"))
+        policy = _fast(retryable=lambda e: isinstance(e, TimeoutError))
+        with pytest.raises(ValueError):
+            retry.call(policy, fn)
+        assert fn.calls == 1
+
+    def test_deadline_bounds_the_loop(self):
+        import time
+
+        fn = Flaky(10**6, TimeoutError("x"))
+        policy = retry.RetryPolicy(
+            name="t", max_attempts=10**6, base_delay_s=0.01,
+            max_delay_s=0.01, deadline_s=0.1, retryable=lambda e: True)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            retry.call(policy, fn)
+        assert time.monotonic() - t0 < 2.0
+
+    def test_result_based_retry(self):
+        seen = []
+
+        def fn():
+            seen.append(1)
+            return len(seen)
+
+        policy = retry.RetryPolicy(
+            name="t", max_attempts=5, base_delay_s=0.0,
+            retry_result=lambda r: r < 3)
+        assert retry.call(policy, fn) == 3
+
+    def test_result_retry_returns_final_value_on_exhaustion(self):
+        policy = retry.RetryPolicy(
+            name="t", max_attempts=2, base_delay_s=0.0,
+            retry_result=lambda r: True)
+        assert retry.call(policy, lambda: "still-bad") == "still-bad"
+
+    def test_on_retry_callback_counts(self):
+        hits = []
+        fn = Flaky(2, TimeoutError("x"))
+        retry.call(_fast(), fn,
+                   on_retry=lambda attempt, exc: hits.append(attempt))
+        assert hits == [1, 2]
+
+    def test_backoff_is_capped_full_jitter(self):
+        import random
+
+        policy = retry.RetryPolicy(name="t", max_attempts=10,
+                                   base_delay_s=0.1, max_delay_s=0.5)
+        rng = random.Random(7)
+        for attempt in range(1, 10):
+            s = policy.backoff_s(attempt, rng)
+            assert 0.0 <= s <= 0.5
+
+    def test_decorator_form(self):
+        calls = []
+
+        @retry.retrying(_fast())
+        def fn():
+            calls.append(1)
+            if len(calls) < 2:
+                raise TimeoutError("x")
+            return 42
+
+        assert fn() == 42
+
+
+class TestPolicies:
+    def test_kv_retryable_classification(self):
+        assert retry.kv_retryable(TimeoutError("t"))
+        assert retry.kv_retryable(RuntimeError("UNAVAILABLE: conn"))
+        assert retry.kv_retryable(RuntimeError("DEADLINE_EXCEEDED"))
+        # a missing key is an ANSWER, not a transient failure
+        assert not retry.kv_retryable(KeyError("NOT_FOUND: k"))
+        assert not retry.kv_retryable(ValueError("bad arg"))
+        # the blocking-get variant polls through NOT_FOUND
+        assert retry.kv_blocking_retryable(RuntimeError("NOT_FOUND: k"))
+
+    def test_kv_policy_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("HVTPU_KV_RETRY_ATTEMPTS", "7")
+        monkeypatch.setenv("HVTPU_KV_RETRY_BASE_MS", "10")
+        p = retry.kv_policy()
+        assert p.max_attempts == 7
+        assert p.base_delay_s == pytest.approx(0.01)
+
+    def test_gloo_policy_markers(self):
+        assert retry.is_gloo_infra_error("x Connection closed by peer y")
+        assert retry.is_gloo_infra_error("collective transport failure")
+        assert not retry.is_gloo_infra_error("assert 1 == 2")
+        assert retry.GLOO_TEARDOWN.max_attempts == 5
+        # injected faults say UNAVAILABLE — an infra retry must NOT
+        # swallow them (they are the thing under test in chaos runs)
+        assert not retry.is_gloo_infra_error("UNAVAILABLE (hvtpu "
+                                             "injected fault: ...)")
+
+
+class FlakyKV:
+    """Coordination-client fake whose ops fail transiently N times."""
+
+    def __init__(self, fails=0):
+        self.d = {}
+        self.fails = fails
+        self.lock = threading.Lock()
+
+    def _maybe_fail(self):
+        with self.lock:
+            if self.fails > 0:
+                self.fails -= 1
+                raise RuntimeError("UNAVAILABLE: coordinator blip")
+
+    def key_value_set(self, k, v):
+        self._maybe_fail()
+        self.d[k] = v
+
+    def key_value_try_get(self, k):
+        self._maybe_fail()
+        if k not in self.d:
+            raise KeyError(f"NOT_FOUND: {k}")
+        return self.d[k]
+
+    def key_value_dir_get(self, prefix):
+        self._maybe_fail()
+        return [(k, v) for k, v in self.d.items()
+                if k.startswith(prefix)]
+
+    def key_value_delete(self, k):
+        self.d.pop(k, None)
+
+
+class TestResilientKV:
+    def _kv(self, fails=0):
+        fake = FlakyKV(fails)
+        policy = retry.RetryPolicy(name="kv-test", max_attempts=4,
+                                   base_delay_s=0.0,
+                                   retryable=retry.kv_retryable)
+        return fake, retry.ResilientKV(fake, rank=0, policy=policy)
+
+    def test_put_survives_transient_unavailable(self):
+        fake, kv = self._kv(fails=2)
+        before = obs_metrics.REGISTRY.counter(
+            "hvtpu_kv_retries_total").value()
+        kv.key_value_set("a", "1")
+        assert fake.d == {"a": "1"}
+        after = obs_metrics.REGISTRY.counter(
+            "hvtpu_kv_retries_total").value()
+        assert after - before == 2
+
+    def test_exhaustion_counts_and_reraises(self):
+        fake, kv = self._kv(fails=50)
+        before = obs_metrics.REGISTRY.counter(
+            "hvtpu_kv_retry_exhausted_total").value()
+        with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+            kv.key_value_set("a", "1")
+        after = obs_metrics.REGISTRY.counter(
+            "hvtpu_kv_retry_exhausted_total").value()
+        assert after - before == 1
+
+    def test_miss_is_not_retried(self):
+        fake, kv = self._kv()
+        with pytest.raises(KeyError):
+            kv.key_value_try_get("missing")
+
+    def test_dir_get_presence_mirrors_client(self):
+        fake, kv = self._kv()
+        assert getattr(kv, "key_value_dir_get", None) is not None
+        kv.key_value_set("p/x", "1")
+        assert kv.key_value_dir_get("p/") == [("p/x", "1")]
+
+        class NoDir:
+            def key_value_set(self, k, v):
+                pass
+
+        bare = retry.ResilientKV(NoDir())
+        # comm/stall.py picks strict mode off this exact probe
+        assert getattr(bare, "key_value_dir_get", None) is None
+
+    def test_idempotent_wrap(self):
+        fake, kv = self._kv()
+        assert retry.resilient_kv(kv) is kv
+        assert retry.resilient_kv(None) is None
+
+    def test_injected_drop_semantics(self):
+        fake, kv = self._kv()
+        faults.install("kv.put:drop@count=1,times=1; "
+                       "kv.get:drop@count=1,times=1", rank=0)
+        try:
+            kv.key_value_set("a", "1")       # dropped
+            assert fake.d == {}
+            fake.d["b"] = "2"
+            with pytest.raises(KeyError):    # dropped read = miss
+                kv.key_value_try_get("b")
+            # budgets spent: subsequent ops flow normally
+            kv.key_value_set("c", "3")
+            assert fake.d["c"] == "3"
+            assert kv.key_value_try_get("b") == "2"
+        finally:
+            faults.uninstall()
+
+    def test_injected_error_is_retried_to_success(self):
+        """An error-injected KV op carries the UNAVAILABLE marker, so
+        the retry policy heals it — the self-healing loop end to end."""
+        fake, kv = self._kv()
+        faults.install("kv.put:error@count=1,times=1", rank=0)
+        try:
+            kv.key_value_set("a", "1")
+            assert fake.d == {"a": "1"}
+        finally:
+            faults.uninstall()

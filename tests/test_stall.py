@@ -701,3 +701,67 @@ def test_watchdog_transparent_on_healthy_path_2proc():
         assert g0 == 3
         assert b == [7.0, 7.0]
         assert rs == [[2.0, 2.0], [2.0, 2.0]]
+
+
+class TestPoisonLatch:
+    """The poison latch across re-init generations (ISSUE-2 satellite):
+    ``poison_exit_status`` must clear (0) ONLY once ``init_generation``
+    advances past the poisoning generation, and an elastic job's
+    terminal stall abort must feed the driver's recovery loop
+    (``RESET_EXIT_CODE``) instead of reading as a crash."""
+
+    @pytest.fixture()
+    def latched(self, monkeypatch):
+        from horovod_tpu.comm import stall
+        from horovod_tpu.core import state as core_state
+
+        st = core_state.global_state()
+        insp = AmortizedStallInspector(
+            FakeKV(), rank=0, warn_s=10, abort_s=0, heartbeat_s=60,
+            generation=st.init_generation)
+        monkeypatch.setattr(st, "sync_stall", insp)
+        monkeypatch.delenv("HVTPU_ELASTIC", raising=False)
+        stall._latch_poison(insp)
+        yield stall, st, insp
+        insp.stop()
+        stall._reset_poison()
+
+    def test_latch_requires_installed_inspector(self):
+        from horovod_tpu.comm import stall
+
+        stray = AmortizedStallInspector(
+            FakeKV(), rank=0, warn_s=10, abort_s=0, heartbeat_s=60)
+        try:
+            stall._latch_poison(stray)  # NOT the installed inspector
+            assert not stall.poisoned()
+        finally:
+            stray.stop()
+            stall._reset_poison()
+
+    def test_same_generation_is_terminal(self, latched):
+        stall, st, insp = latched
+        assert stall.poisoned()
+        assert stall.poison_exit_status() == 1
+
+    def test_clears_only_past_poisoning_generation(self, latched,
+                                                   monkeypatch):
+        stall, st, insp = latched
+        # re-init into the SAME generation: still terminal
+        assert stall.poison_exit_status() == 1
+        # generation advances PAST the poisoning one (elastic in-process
+        # resync completed): the wedged execution belongs to a dead
+        # session — exit clean
+        monkeypatch.setattr(st, "init_generation",
+                            insp.gen + 1)
+        assert stall.poison_exit_status() == 0
+
+    def test_elastic_terminal_stall_requests_reset(self, latched,
+                                                   monkeypatch):
+        stall, st, insp = latched
+        from horovod_tpu.elastic.worker import RESET_EXIT_CODE
+
+        monkeypatch.setenv("HVTPU_ELASTIC", "1")
+        assert stall.poison_exit_status() == RESET_EXIT_CODE
+        # ...but a completed recovery still wins: clean exit
+        monkeypatch.setattr(st, "init_generation", insp.gen + 1)
+        assert stall.poison_exit_status() == 0
