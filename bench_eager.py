@@ -17,6 +17,81 @@ import argparse
 import json
 import time
 
+# Schema of the torch DistributedOptimizer end-to-end step-time row
+# (enforced by tests/test_bench_guard.py so future rounds stay
+# comparable): one row per run, produced by build_torch_step_row.
+TORCH_STEP_KEYS = (
+    "bench", "np", "param_tensors", "param_bytes", "ms_per_step",
+    "steps_per_s",
+)
+
+
+def build_torch_step_row(np_, param_tensors, param_bytes, ms_per_step):
+    """One JSON row for the torch DistributedOptimizer step-time bench
+    (bench == "eager_torch_step")."""
+    return {
+        "bench": "eager_torch_step",
+        "np": int(np_),
+        "param_tensors": int(param_tensors),
+        "param_bytes": int(param_bytes),
+        "ms_per_step": round(float(ms_per_step), 3),
+        "steps_per_s": (round(1000.0 / ms_per_step, 3)
+                        if ms_per_step > 0 else 0.0),
+    }
+
+
+def run_torch_step(sizes_mb, iters, warmup=3):
+    """End-to-end torch ``DistributedOptimizer`` step time (the
+    measurement VERDICT r5 notes never existed): forward + backward +
+    per-parameter async allreduce through the eager controller +
+    step(), on a model with the many-same-shape-buckets structure real
+    training produces.  ``sizes_mb`` selects the total gradient
+    payload; run with --np 4 for the headline row."""
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    results = []
+    for mb in sizes_mb:
+        # 8 equal square layers -> 16 parameter tensors (8 weights +
+        # 8 biases): one async allreduce per tensor per step, the
+        # optimizer bucket pattern the controller's steady-state
+        # bypass + burst gate exist for.
+        n_layers = 8
+        dim = max(16, int((mb * (1 << 20) / 4 / n_layers) ** 0.5))
+        torch.manual_seed(0)  # identical init on every rank
+        model = torch.nn.Sequential(*[
+            torch.nn.Linear(dim, dim) for _ in range(n_layers)
+        ])
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=1e-3),
+            named_parameters=model.named_parameters(),
+        )
+        loss_fn = torch.nn.MSELoss()
+        x = torch.randn(32, dim)
+        y = torch.randn(32, dim)
+
+        def step():
+            opt.zero_grad()
+            loss_fn(model(x), y).backward()
+            opt.step()
+
+        for _ in range(warmup):
+            step()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            step()
+        dt = (time.perf_counter() - t0) / iters
+        params = list(model.parameters())
+        row = build_torch_step_row(
+            hvd.size(), len(params),
+            sum(p.numel() * 4 for p in params), dt * 1e3,
+        )
+        row["dim"] = dim
+        results.append(row)
+    return results
+
 
 def run_sweep(sizes_mb, iters, warmup=3):
     import numpy as np
@@ -63,6 +138,38 @@ def run_sweep(sizes_mb, iters, warmup=3):
         results.append({
             "bench": "eager_allreduce", "nbytes": total,
             "mode": "async_fused", "gbps": total / dt / 1e9,
+            "us_per_op": dt * 1e6 / k,
+        })
+
+        # pipelined async: iteration k+1's batch is enqueued BEFORE
+        # iteration k's handles synchronize (depth-2 software
+        # pipeline), so batch k+1's negotiation/KV exchange overlaps
+        # batch k's data-plane execution on the controller's executor
+        # thread — the overlap the async API exists for (a training
+        # step's early grads negotiate while later layers' backward
+        # still runs).  Two alternating name sets keep pending names
+        # unique; both are steady-state cache hits after warmup.
+        def batch(it):
+            return [hvd.allreduce_async(chunk, op=hvd.Sum,
+                                        name=f"ap.{n}.{it % 2}.{i}")
+                    for i in range(k)]
+        for it in range(2 * warmup):
+            for h in batch(it):
+                hvd.synchronize(h)
+        t0 = time.perf_counter()
+        prev = None
+        for it in range(iters):
+            hs = batch(it)
+            if prev is not None:
+                for h in prev:
+                    hvd.synchronize(h)
+            prev = hs
+        for h in prev:
+            hvd.synchronize(h)
+        dt = (time.perf_counter() - t0) / iters
+        results.append({
+            "bench": "eager_allreduce", "nbytes": total,
+            "mode": "async_fused_pipe", "gbps": total / dt / 1e9,
             "us_per_op": dt * 1e6 / k,
         })
     return results
@@ -166,10 +273,14 @@ def main():
     p.add_argument("--compression-ab", action="store_true",
                    help="A/B the sync wire across compression modes "
                         "(use with --np 4)")
+    p.add_argument("--torch-step", action="store_true",
+                   help="end-to-end torch DistributedOptimizer step "
+                        "time (use with --np 4)")
     args = p.parse_args()
     sizes = [float(s) for s in args.sizes_mb.split(",")]
 
-    sweep = (run_compression_ab if args.compression_ab
+    sweep = (run_torch_step if args.torch_step
+             else run_compression_ab if args.compression_ab
              else run_tf_graph_sweep if args.tf else run_sweep)
     if args.np == 1:
         if args.cpu_devices:
@@ -178,10 +289,21 @@ def main():
             force_cpu_devices(args.cpu_devices)
         results = sweep(sizes, args.iters)
     else:
+        from horovod_tpu.core import retry as core_retry
         from horovod_tpu.runner import run as hvt_run
 
-        per_rank = hvt_run(
-            sweep,
+        # np>1 on localhost occasionally trips the jaxlib/gloo CPU
+        # teardown race (a rank SIGSEGVs; docs/robustness.md): retry
+        # via the named policy, classifying the crash exit too.
+        policy = core_retry.gloo_teardown_policy()
+        per_rank = core_retry.call(
+            core_retry.RetryPolicy(
+                name=policy.name, max_attempts=policy.max_attempts,
+                base_delay_s=policy.base_delay_s,
+                retryable=lambda e: (core_retry.is_gloo_infra_error(str(e))
+                                     or "-11" in str(e)),
+            ),
+            hvt_run, sweep,
             args=(sizes, args.iters), np=args.np,
             cpu_devices=args.cpu_devices or 1, timeout=1800.0,
         )

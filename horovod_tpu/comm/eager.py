@@ -118,13 +118,33 @@ def _local_device(mesh: Mesh) -> jax.Device:
     raise RuntimeError("calling process is not a member of this process set")
 
 
+@functools.lru_cache(maxsize=None)
+def _mesh_plumbing(mesh: Mesh):
+    """Per-mesh staging artifacts for the flat stacker: (sharding,
+    local device).  Meshes are cached on their ProcessSet (and in
+    _jitted's key), so this is a handful of entries per world — but the
+    NamedSharding construction and the local-device scan over
+    mesh.devices.flat used to run on EVERY eager op, a measurable slice
+    of the small-op dispatch floor (the torch DistributedOptimizer
+    bucket pattern re-stages the same mesh every step)."""
+    spec = P(tuple(mesh.axis_names)) if len(mesh.axis_names) > 1 \
+        else P(mesh.axis_names[0])
+    return NamedSharding(mesh, spec), _local_device(mesh)
+
+
+@functools.lru_cache(maxsize=128)
+def _f32_scalar(value: float):
+    """Cached device scalar for pre/postscale factors (almost always
+    1.0): jnp.asarray per op is a host->device transfer on the
+    dispatch critical path."""
+    return jnp.asarray(value, jnp.float32)
+
+
 def _stack_global(x, mesh: Mesh):
     """Global (P, *shape) array, shard p = process p's tensor."""
     p = mesh.devices.size
-    spec = P(tuple(mesh.axis_names)) if len(mesh.axis_names) > 1 \
-        else P(mesh.axis_names[0])
-    sharding = NamedSharding(mesh, spec)
-    local = jax.device_put(x[None], _local_device(mesh))
+    sharding, local_dev = _mesh_plumbing(mesh)
+    local = jax.device_put(x[None], local_dev)
     return jax.make_array_from_single_device_arrays(
         (p,) + tuple(x.shape), sharding, [local]
     )
@@ -178,19 +198,30 @@ def _multidev_mesh_or_none(ps):
     return mesh
 
 
+@functools.lru_cache(maxsize=None)
+def _mesh_plumbing_md(mesh: Mesh):
+    """Per-mesh lane-stacking artifacts: (flat sharding, row-structured
+    sharding, p_count, d_count, local_row) — same memoization rationale
+    as :func:`_mesh_plumbing`."""
+    d_count = mesh.devices.shape[1]
+    pid = jax.process_index()
+    for r, row in enumerate(mesh.devices):
+        if row[0].process_index == pid:
+            return (NamedSharding(mesh, P(PROC_AXIS, LDEV_AXIS)),
+                    NamedSharding(mesh, P(PROC_AXIS, None, LDEV_AXIS)),
+                    mesh.devices.shape[0], d_count, r)
+    raise RuntimeError("process not a member of the multidev mesh")
+
+
 def _lane_layout(mesh: Mesh, inner: int):
     """Shared lane-stacking bookkeeping: (p_count, d_count, chunk,
     local_row) for this process, with ``chunk`` the ceil-div lane slice
     of ``inner`` elements.  One implementation so the flat and
     row-structured stackers can never disagree on membership or
     padding."""
-    d_count = mesh.devices.shape[1]
+    _, _, p_count, d_count, local_row = _mesh_plumbing_md(mesh)
     chunk = -(-inner // d_count)
-    pid = jax.process_index()
-    for r, row in enumerate(mesh.devices):
-        if row[0].process_index == pid:
-            return mesh.devices.shape[0], d_count, chunk, r
-    raise RuntimeError("process not a member of the multidev mesh")
+    return p_count, d_count, chunk, local_row
 
 
 def _stack_global_multidev(x, mesh: Mesh):
@@ -203,7 +234,7 @@ def _stack_global_multidev(x, mesh: Mesh):
     pad = chunk * d_count - size
     if pad:
         flat = jnp.pad(flat, (0, pad))
-    sharding = NamedSharding(mesh, P(PROC_AXIS, LDEV_AXIS))
+    sharding = _mesh_plumbing_md(mesh)[0]
     locals_ = [
         jax.device_put(
             flat[d * chunk:(d + 1) * chunk][None, None],
@@ -230,7 +261,7 @@ def _stack_global_multidev_rows(x, rows: int, mesh: Mesh):
     pad = chunk * d_count - inner
     if pad:
         flat = jnp.pad(flat, ((0, 0), (0, pad)))
-    sharding = NamedSharding(mesh, P(PROC_AXIS, None, LDEV_AXIS))
+    sharding = _mesh_plumbing_md(mesh)[1]
     locals_ = [
         jax.device_put(
             flat[:, d * chunk:(d + 1) * chunk][None, :, None, :],
@@ -596,6 +627,56 @@ def _fetch(global_out):
     return global_out.addressable_data(0)
 
 
+def _allreduce_plan(st, ps, shape, dtype, nbytes, rop, compression):
+    """Memoized allreduce routing: (route, mesh, jitted fn) per
+    (shape, dtype, op, compression) signature, cached on the ProcessSet
+    (like its meshes, so a shutdown/init cycle can never serve stale
+    device objects).  The repeated same-shape op — every step of the
+    torch ``DistributedOptimizer`` bucket pattern — used to re-derive
+    the hierarchical/multidev/int-average routing and re-enter the
+    _jitted lru on every call; in steady state this is now one dict
+    hit.  Routing inputs are all pure functions of the key plus
+    init-frozen config, so the cache cannot go stale within a world."""
+    cache = ps.__dict__.setdefault("_eager_ar_plans", {})
+    key = (shape, dtype, rop, compression)
+    plan = cache.get(key)
+    if plan is not None:
+        return plan
+    mesh = ps.proc_mesh()
+    p = mesh.devices.size
+    # integer AVERAGE floor-divides per stage, which differs from a
+    # single flat division — stays on the flat path.  Adasum rides the
+    # hierarchy only when the HOST count is a power of two (its
+    # recursive doubling runs across hosts).
+    int_avg = (rop == ReduceOp.AVERAGE
+               and jnp.issubdtype(dtype, jnp.integer))
+    hier = None if int_avg else _hierarchical_mesh_or_none(st, ps, p)
+    if (rop == ReduceOp.ADASUM and hier is not None
+            and st.cross_size & (st.cross_size - 1)):
+        hier = None
+    # int8 stays off the lane path: block-absmax quantization
+    # boundaries depend on the chunking, so per-lane chunks would
+    # change numerics vs the single-transport path
+    md = (None if (rop == ReduceOp.ADASUM or hier is not None
+                   or spmd._is_int8(compression)
+                   or nbytes < _MULTIDEV_MIN_BYTES)
+          else _multidev_mesh_or_none(ps))
+    if md is not None:
+        plan = ("md", md,
+                _jitted("allreduce_multidev", md, (rop, compression)))
+    elif hier is None:
+        plan = ("flat", mesh,
+                _jitted("allreduce", mesh, (rop, compression)))
+    elif rop == ReduceOp.ADASUM:
+        plan = ("hier", hier,
+                _jitted("allreduce_hier_adasum", hier, (compression,)))
+    else:
+        plan = ("hier", hier,
+                _jitted("allreduce_hier", hier, (rop, compression)))
+    cache[key] = plan
+    return plan
+
+
 # --------------------------------------------------------------------------
 # public eager ops
 # --------------------------------------------------------------------------
@@ -656,48 +737,24 @@ def allreduce(
             else:
                 out = jnp.copy(x)
         else:
-            # integer AVERAGE floor-divides per stage, which differs
-            # from a single flat division — stays on the flat path.
-            # Adasum rides the hierarchy only when the HOST count is a
-            # power of two (its recursive doubling runs across hosts).
-            int_avg = (rop == ReduceOp.AVERAGE
-                       and jnp.issubdtype(x.dtype, jnp.integer))
-            hier = (None if int_avg
-                    else _hierarchical_mesh_or_none(st, ps, p))
-            if (rop == ReduceOp.ADASUM and hier is not None
-                    and st.cross_size & (st.cross_size - 1)):
-                hier = None
-            # int8 stays off the lane path: block-absmax quantization
-            # boundaries depend on the chunking, so per-lane chunks
-            # would change numerics vs the single-transport path
-            md = (None if (rop == ReduceOp.ADASUM or hier is not None
-                           or spmd._is_int8(compression)
-                           or x.nbytes < _MULTIDEV_MIN_BYTES)
-                  else _multidev_mesh_or_none(ps))
+            route, rmesh, fn = _allreduce_plan(
+                st, ps, tuple(x.shape), x.dtype, x.nbytes, rop,
+                compression,
+            )
             postprocess = None
-            if md is not None:
-                stacked, flat_size = _stack_global_multidev(x, md)
-                fn = _jitted("allreduce_multidev", md,
-                             (rop, compression))
+            if route == "md":
+                stacked, flat_size = _stack_global_multidev(x, rmesh)
                 postprocess = (
                     lambda o: o[:flat_size].reshape(x.shape)
                 )
-            elif hier is None:
-                stacked = _stack_global(x, mesh)
-                fn = _jitted("allreduce", mesh, (rop, compression))
-            elif rop == ReduceOp.ADASUM:
-                stacked = _stack_global(x, hier)
-                fn = _jitted("allreduce_hier_adasum", hier,
-                             (compression,))
             else:
-                stacked = _stack_global(x, hier)
-                fn = _jitted("allreduce_hier", hier, (rop, compression))
+                stacked = _stack_global(x, rmesh)
             out = _fetch(
                 stall.dispatch(
                     st, ps, fn, (
                         stacked,
-                        jnp.asarray(prescale_factor, jnp.float32),
-                        jnp.asarray(postscale_factor, jnp.float32),
+                        _f32_scalar(prescale_factor),
+                        _f32_scalar(postscale_factor),
                     ), desc=sdesc)
             )
             if postprocess is not None:

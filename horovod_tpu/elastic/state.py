@@ -137,6 +137,17 @@ class State:
     def sync(self):
         raise NotImplementedError
 
+    def rebroadcast(self):
+        """Re-broadcast tracked state from rank 0 WITHOUT touching the
+        durable commit.  Called after reset callbacks run in a
+        relaunched incarnation: a rank-dependent callback (lr schedules
+        derived from rank/world, say) would otherwise leave tracked
+        attributes silently diverged across ranks — the reference
+        avoids this by ordering callbacks before its sync; here sync
+        must come first (it restores the committed payload the
+        callbacks read), so the divergence window is closed by a
+        second, broadcast-only pass.  Base State tracks nothing."""
+
 
 class _HostUpdateFlag:
     """Process-wide flag set by the elastic worker signal handler
@@ -219,6 +230,18 @@ class ObjectState(State):
         self._apply(payload)
         self.save_to_memory()
         self._synced = True
+
+    def rebroadcast(self):
+        """Broadcast-only re-sync of tracked attributes from rank 0
+        (no disk load, ``_synced`` untouched) — see State.rebroadcast."""
+        from ..api import functions as api_functions
+
+        core_state.require_init("elastic state rebroadcast")
+        payload = api_functions.broadcast_object(
+            self._capture(), root_rank=0
+        )
+        self._apply(payload)
+        self.save_to_memory()
 
     # -- disk representation hooks (subclasses with non-picklable
     #    payloads override these) --
@@ -400,3 +423,16 @@ class ShardedJaxState(JaxState):
             self._apply(payload)
         self.save_to_memory()
         self._synced = True
+
+    def rebroadcast(self):
+        """Plain-attribute broadcast only: global jax.Arrays are not
+        picklable across processes, and a reset callback that rebuilt
+        one did so through the SPMD collectives — identical by
+        construction."""
+        from ..api import functions as api_functions
+
+        core_state.require_init("elastic state rebroadcast")
+        _, rest = self._split(self._capture())
+        payload = api_functions.broadcast_object(rest, root_rank=0)
+        self._apply(payload)
+        self.save_to_memory()

@@ -123,6 +123,12 @@ def run(func):
                 # between reset() and the next sync — same net order
                 # (callbacks see the new world, then training resumes).
                 state.on_reset()
+                # Callbacks may be rank-dependent (anything derived
+                # from hvd.rank()); a broadcast-only re-sync makes the
+                # tracked attributes identical again before training
+                # resumes — the reference achieves the same by running
+                # callbacks before its sync.
+                state.rebroadcast()
             return func(state, *args, **kwargs)
         except HorovodInternalError:
             # Peer loss mid-collective: roll back so the durable commit

@@ -26,19 +26,27 @@ def native_available() -> bool:
 
 def make_controller(rank: int, size: int, fusion_threshold: int,
                     cache_capacity: int = 1024, stall_warn_s: float = 60.0,
-                    stall_abort_s: float = 0.0):
+                    stall_abort_s: float = 0.0,
+                    resync_every: int = None):
     """Controller factory: native if buildable, else Python fallback.
     ``HVTPU_FORCE_PY_CONTROLLER=1`` forces the fallback (tests use this
-    to cross-check both)."""
+    to cross-check both).  ``resync_every`` is the steady-state bypass
+    cadence (every Nth all-cache-hit cycle sends a full resync blob; 0
+    disables bypass); defaults to ``HVTPU_CACHE_RESYNC_EVERY`` or 64.
+    Every rank must agree on the value — it shapes the wire traffic
+    pattern, not the decisions, so the launcher env is the natural
+    distribution channel."""
+    if resync_every is None:
+        resync_every = int(os.environ.get("HVTPU_CACHE_RESYNC_EVERY", "64"))
     if (not os.environ.get("HVTPU_FORCE_PY_CONTROLLER")
             and core.available()):
         return core.NativeController(
             rank, size, fusion_threshold, cache_capacity,
-            stall_warn_s, stall_abort_s,
+            stall_warn_s, stall_abort_s, resync_every=resync_every,
         )
     return fallback.PyController(
         rank, size, fusion_threshold, cache_capacity,
-        stall_warn_s, stall_abort_s,
+        stall_warn_s, stall_abort_s, resync_every=resync_every,
     )
 
 

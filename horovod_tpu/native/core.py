@@ -71,6 +71,17 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.c_void_p, c.c_int64, c.c_int32
     ]
     lib.hvt_controller_set_shutdown.argtypes = [c.c_void_p]
+    lib.hvt_controller_set_resync_every.argtypes = [c.c_void_p, c.c_int64]
+    lib.hvt_controller_predict_responses.restype = c.c_int64
+    lib.hvt_controller_predict_responses.argtypes = [
+        c.c_void_p, c.POINTER(c.c_uint32), c.c_int64,
+        c.POINTER(c.c_uint8), c.c_int64,
+    ]
+    lib.hvt_controller_finish_names.restype = c.c_int64
+    lib.hvt_controller_finish_names.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int64,
+        c.POINTER(c.c_uint64), c.c_int64,
+    ]
     lib.hvt_controller_drain_requests.restype = c.c_int64
     lib.hvt_controller_drain_requests.argtypes = [
         c.c_void_p, c.POINTER(c.c_uint8), c.c_int64,
@@ -148,7 +159,7 @@ def load() -> Optional[ctypes.CDLL]:
         _lib = _configure(ctypes.CDLL(path))
     except (OSError, AttributeError):
         return None
-    if _lib.hvt_abi_version() != 2:
+    if _lib.hvt_abi_version() != 3:
         _lib = None
     return _lib
 
@@ -167,7 +178,7 @@ class NativeController:
 
     def __init__(self, rank: int, size: int, fusion_threshold: int,
                  cache_capacity: int = 1024, stall_warn_s: float = 60.0,
-                 stall_abort_s: float = 0.0):
+                 stall_abort_s: float = 0.0, resync_every: int = 64):
         lib = load()
         if lib is None:
             raise RuntimeError("native core unavailable; use fallback")
@@ -179,6 +190,9 @@ class NativeController:
         self.rank = rank
         self.size = size
         self.fusion_threshold = fusion_threshold
+        self.resync_every = resync_every
+        if resync_every != 64:
+            lib.hvt_controller_set_resync_every(self._ptr, resync_every)
 
     def close(self):
         if self._ptr:
@@ -266,6 +280,35 @@ class NativeController:
     def set_shutdown(self):
         """Announce this rank wants to shut down (next DrainRequests)."""
         self._lib.hvt_controller_set_shutdown(self._ptr)
+
+    def set_resync_every(self, n: int):
+        """Bypass cadence: every Nth all-cache-hit cycle sends a full
+        resync blob (0 disables the bypass fast path entirely)."""
+        self.resync_every = int(n)
+        self._lib.hvt_controller_set_resync_every(self._ptr, int(n))
+
+    def predict_responses(self, bits: Sequence[int]) -> Optional[bytes]:
+        """Predicted steady-state ResponseList for a pure bypass cycle
+        of exactly ``bits`` (see fallback.PyController); None when a
+        bit is unknown."""
+        arr = (ctypes.c_uint32 * len(bits))(*bits)
+        n = self._lib.hvt_controller_predict_responses(
+            self._ptr, arr, len(bits), None, 0)
+        if n == 0:
+            return None
+        buf = bytearray(n)
+        self._lib.hvt_controller_predict_responses(
+            self._ptr, arr, len(bits), _as_u8(buf), n)
+        return bytes(buf)
+
+    def finish(self, names: Sequence[str],
+               max_finished: int = 65536) -> List[int]:
+        """Eagerly retire predicted-executed in-flight entries."""
+        joined = "\n".join(names).encode()
+        out = (ctypes.c_uint64 * max_finished)()
+        n = self._lib.hvt_controller_finish_names(
+            self._ptr, joined, len(joined), out, max_finished)
+        return list(out[: min(n, max_finished)])
 
     def check_stalls(self) -> List[dict]:
         n = int(self._lib.hvt_controller_check_stalls(self._ptr, None, 0))

@@ -68,12 +68,13 @@ class PyController:
 
     def __init__(self, rank: int, size: int, fusion_threshold: int,
                  cache_capacity: int = 1024, stall_warn_s: float = 60.0,
-                 stall_abort_s: float = 0.0):
+                 stall_abort_s: float = 0.0, resync_every: int = 64):
         self.rank = rank
         self.size = size
         self.fusion_threshold = fusion_threshold
         self.stall_warn_s = stall_warn_s
         self.stall_abort_s = stall_abort_s
+        self.resync_every = resync_every
         self._lock = threading.Lock()
         self._pending: List[wire.Entry] = []
         self._pending_names: Set[str] = set()
@@ -82,6 +83,9 @@ class PyController:
         self._groups: Dict[int, int] = {}
         self._joined = False
         self._shutdown = False
+        # steady-state bypass bookkeeping (see drain_requests)
+        self._bypass_streak = 0
+        self._resync_flush = False
         # coordinator state
         self._message_table: Dict[str, dict] = {}
         self._joined_ranks: Set[int] = set()
@@ -89,6 +93,7 @@ class PyController:
         self._tuned_threshold = -1
         self._tuned_cycle_us = -1
         self._shutdown_ranks: Set[int] = set()
+        self._resync_needed = False
         self._process_sets: Dict[int, List[int]] = {0: list(range(size))}
 
     # ---- rank-local side ----
@@ -130,24 +135,64 @@ class PyController:
         """Announce this rank wants to shut down (next drain_requests)."""
         self._shutdown = True
 
+    def set_resync_every(self, n: int):
+        self.resync_every = int(n)
+
     def drain_requests(self) -> bytes:
         with self._lock:
             rl = wire.RequestList(rank=self.rank, joined=self._joined,
                                   shutdown=self._shutdown)
-            for e in self._pending:
+            resync_flush = self._resync_flush
+            self._resync_flush = False
+            # In-flight ops BEFORE this drain: re-announced on a
+            # coordinator-requested resync (their first announcement
+            # may have hit an unexpandable cache bit there).
+            prior_in_flight = (
+                sorted(self._in_flight.values(),
+                       key=lambda e: self._table_key(e))
+                if resync_flush else [])
+            entries = list(self._pending)
+            self._pending.clear()
+            bits: List[int] = []
+            for e in entries:
                 self._in_flight[e.name] = e
                 self._pending_names.discard(e.name)
-                bit = self._cache.lookup(e.signature())
+                bits.append(self._cache.lookup(e.signature()))
+            all_hit = bool(entries) and all(b >= 0 for b in bits)
+            # derive from the captured flags so the blob is internally
+            # consistent even if set_joined/set_shutdown race the drain
+            membership = rl.joined or rl.shutdown
+            # Steady-state bypass: every drained op is a cache hit, no
+            # membership change in flight, and the periodic full-resync
+            # cycle is not due — the whole drain travels as one compact
+            # bit vector (parity: the coordinated cache bitvector of
+            # Controller::CoordinateCacheAndState).
+            if (all_hit and not membership and not resync_flush
+                    and self.resync_every > 0
+                    and self._bypass_streak + 1 < self.resync_every):
+                self._bypass_streak += 1
+                rl.cache_bypass = True
+                rl.cache_bits = wire.bits_to_words(sorted(bits))
+                return wire.serialize_request_list(rl)
+            self._bypass_streak = 0
+            # Periodic resync (streak exhausted) or coordinator-forced
+            # flush: full entries keep the coordinator's message table
+            # and stall inspector authoritative even if caches diverge.
+            resync = resync_flush or (all_hit and not membership)
+            rl.cache_resync = resync
+            for e, bit in zip(entries, bits):
                 rq = wire.Request(rank=self.rank)
                 if bit >= 0:
+                    rl.cache_hits.append(bit)
+                if bit >= 0 and not resync:
                     rq.cached = True
                     rq.cache_bit = bit
                     rq.entry = wire.Entry(seq=e.seq, name=e.name)
-                    rl.cache_hits.append(bit)
                 else:
                     rq.entry = e
                 rl.requests.append(rq)
-            self._pending.clear()
+            for e in prior_in_flight:
+                rl.requests.append(wire.Request(rank=self.rank, entry=e))
             return wire.serialize_request_list(rl)
 
     def apply_responses(self, blob: bytes) -> List[int]:
@@ -170,6 +215,12 @@ class PyController:
                     e = self._in_flight.pop(name, None)
                     if e is not None:
                         finished.append(e.seq)
+            if rl.cache_resync_needed:
+                # Coordinator failed to expand a bypass bit: next drain
+                # is a full resync re-announcing whatever is still
+                # outstanding (set AFTER the pops above, so completed
+                # ops are not re-announced).
+                self._resync_flush = True
             if rl.join_last_rank >= 0:
                 self._joined = False
         return finished
@@ -192,6 +243,27 @@ class PyController:
                 self._last_joined_rank = rl.rank
             if rl.shutdown:
                 self._shutdown_ranks.add(rl.rank)
+            if rl.cache_bypass:
+                # Expand the rank's cache-bit vector through the
+                # coordinator's own (identical) cache.  An unknown bit
+                # means the caches diverged (e.g. elastic generations
+                # mixing): request a full resync from every rank.
+                for bit in wire.words_to_bits(rl.cache_bits):
+                    cached = self._cache.entry_for_bit(bit)
+                    if cached is None:
+                        self._resync_needed = True
+                        continue
+                    e = wire.Entry(**{**cached.__dict__, "seq": 0})
+                    key = self._table_key(e)
+                    pc = self._message_table.get(key)
+                    if pc is None:
+                        self._message_table[key] = {
+                            "entry": e, "ranks": {rl.rank},
+                            "first_seen": now,
+                        }
+                    else:
+                        pc["ranks"].add(rl.rank)
+                return
             for rq in rl.requests:
                 e = rq.entry
                 if rq.cached:
@@ -228,6 +300,8 @@ class PyController:
                 tuned_fusion_threshold=self._tuned_threshold,
                 tuned_cycle_time_us=self._tuned_cycle_us,
             )
+            out.cache_resync_needed = self._resync_needed
+            self._resync_needed = False
             # deterministic (psid, name) order == std::map iteration
             ready = [
                 key for key in sorted(self._message_table)
@@ -319,26 +393,77 @@ class PyController:
             return wire.serialize_response_list(out)
 
     def _fuse(self, responses: List[wire.Response]) -> List[wire.Response]:
+        """Compatibility-GROUP fusion: every fusible response merges
+        into the open group for its (type, red_op, dtype, process set)
+        key — not just adjacent ones — so an unrelated response
+        (another process set's release landing in the same compute)
+        cannot split an otherwise-stable fusion group.  That
+        order-independence is what makes steady-state schedule
+        prediction sound (see predict_responses).  Output order is
+        group-opening order; a group that would exceed the fusion
+        threshold closes and a new one opens at the end."""
         fused: List[wire.Response] = []
+        open_group: Dict[Tuple[int, int, int, int], int] = {}
         for r in responses:
             can_fuse = r.type in (wire.ALLREDUCE, wire.ADASUM) and not r.error
-            if fused and can_fuse:
-                prev = fused[-1]
-                compatible = (
-                    prev.type == r.type and prev.red_op == r.red_op
-                    and prev.dtype == r.dtype
-                    and prev.process_set_id == r.process_set_id
-                    and not prev.error
-                )
-                if (compatible and
-                        prev.total_bytes + r.total_bytes
+            if can_fuse:
+                key = (r.type, r.red_op, r.dtype, r.process_set_id)
+                gi = open_group.get(key)
+                if (gi is not None
+                        and fused[gi].total_bytes + r.total_bytes
                         <= self.fusion_threshold):
-                    prev.tensor_names.extend(r.tensor_names)
-                    prev.tensor_shapes.extend(r.tensor_shapes)
-                    prev.total_bytes += r.total_bytes
+                    g = fused[gi]
+                    g.tensor_names.extend(r.tensor_names)
+                    g.tensor_shapes.extend(r.tensor_shapes)
+                    g.total_bytes += r.total_bytes
                     continue
+                open_group[key] = len(fused)
             fused.append(r)
         return fused
+
+    # ---- steady-state schedule prediction ----
+    def predict_responses(self, bits: Sequence[int]) -> Optional[bytes]:
+        """The ResponseList the coordinator WILL emit for a pure
+        bypass cycle carrying exactly ``bits`` — a deterministic
+        function of the (replicated) response cache and the fusion
+        threshold, so a rank in steady state can execute without
+        waiting for the round trip.  Returns None when any bit is
+        unknown.  Only sound under the caller's gating (never-tuned
+        threshold, no interleaved unscheduled work, additive ops);
+        see eager/controller.py."""
+        with self._lock:
+            entries = []
+            for b in bits:
+                e = self._cache.entry_for_bit(b)
+                if e is None:
+                    return None
+                entries.append(e)
+            entries.sort(key=self._table_key)
+            out = wire.ResponseList()
+            out.responses = self._fuse([
+                wire.Response(
+                    type=e.type, red_op=e.red_op, dtype=e.dtype,
+                    process_set_id=e.process_set_id,
+                    root_rank=e.root_rank,
+                    tensor_names=[e.name],
+                    tensor_shapes=[tuple(e.shape)],
+                    total_bytes=e.nbytes,
+                ) for e in entries
+            ])
+            return wire.serialize_response_list(out)
+
+    def finish(self, names: Sequence[str]) -> List[int]:
+        """Eagerly retire in-flight entries executed from a PREDICTED
+        schedule, so re-enqueues of the same tensor name don't trip
+        the duplicate-name guard before the real (matching) response
+        streams in."""
+        with self._lock:
+            out = []
+            for n in names:
+                e = self._in_flight.pop(n, None)
+                if e is not None:
+                    out.append(e.seq)
+            return out
 
     # ---- introspection ----
     @property

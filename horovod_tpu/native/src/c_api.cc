@@ -27,6 +27,7 @@ struct ControllerHandle {
   Controller ctrl;
   std::vector<uint8_t> staged_requests;
   std::vector<uint8_t> staged_responses;
+  std::vector<uint8_t> staged_predict;
   std::vector<uint8_t> staged_stalls;
   template <typename... A>
   explicit ControllerHandle(A&&... a) : ctrl(std::forward<A>(a)...) {}
@@ -56,7 +57,9 @@ int64_t Staged(std::vector<uint8_t>* staged, uint8_t* buf, int64_t cap,
 extern "C" {
 
 // ---- versioning ----------------------------------------------------------
-int hvt_abi_version() { return 2; }  // v2: + hvt_gp_* (gaussian_process.cc)
+// v2: + hvt_gp_* (gaussian_process.cc)
+// v3: wire v3 cache_bits bypass frame + hvt_controller_set_resync_every
+int hvt_abi_version() { return 3; }
 
 // ---- controller ----------------------------------------------------------
 void* hvt_controller_new(int rank, int size, int64_t fusion_threshold,
@@ -156,6 +159,42 @@ void hvt_controller_set_tuned(void* c, int64_t fusion_threshold,
 }
 
 void hvt_controller_set_shutdown(void* c) { Ctrl(c)->SetShutdown(); }
+
+void hvt_controller_set_resync_every(void* c, int64_t n) {
+  Ctrl(c)->SetResyncEvery(n);
+}
+
+// Steady-state schedule prediction (two-call size-probe protocol like
+// drain/compute).  Returns 0 when a bit is unknown (caller must not
+// predict); a real empty ResponseList still serializes to >0 bytes.
+int64_t hvt_controller_predict_responses(void* c, const uint32_t* bits,
+                                         int64_t n, uint8_t* buf,
+                                         int64_t cap) {
+  return Staged(&Handle(c)->staged_predict, buf, cap, [c, bits, n] {
+    return Ctrl(c)->PredictResponses(
+        std::vector<uint32_t>(bits, bits + n));
+  });
+}
+
+// Eagerly retire predicted-executed in-flight entries; `names` is a
+// '\n'-joined list.  Writes up to `cap` finished seqs; returns count.
+int64_t hvt_controller_finish_names(void* c, const char* names,
+                                    int64_t len, uint64_t* out_seqs,
+                                    int64_t cap) {
+  std::vector<std::string> parts;
+  const char* p = names;
+  const char* end = names + len;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (nl == nullptr) nl = end;
+    parts.emplace_back(p, nl - p);
+    p = nl + 1;
+  }
+  std::vector<uint64_t> fin = Ctrl(c)->FinishNames(parts);
+  int64_t n = static_cast<int64_t>(fin.size());
+  for (int64_t i = 0; i < n && i < cap; ++i) out_seqs[i] = fin[i];
+  return n;
+}
 
 // JSON stall report (parity: stall_inspector.cc warning text, but
 // machine-readable): [{"name":..,"waiting_s":..,"present":[..],

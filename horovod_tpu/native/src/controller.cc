@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <chrono>
 #include <sstream>
+#include <tuple>
 
 namespace hvt {
 
@@ -49,6 +50,14 @@ std::vector<uint64_t> TensorQueue::Finish(
     }
   }
   return seqs;
+}
+
+std::vector<Entry> TensorQueue::InFlightSnapshot() const {
+  std::lock_guard<std::mutex> g(mu_);
+  std::vector<Entry> out;
+  out.reserve(in_flight_.size());
+  for (const auto& kv : in_flight_) out.push_back(kv.second);
+  return out;
 }
 
 int64_t TensorQueue::pending_count() const {
@@ -175,23 +184,75 @@ std::vector<uint8_t> Controller::DrainRequests() {
   rl.rank = rank_;
   rl.joined = joined_;
   rl.shutdown = shutdown_;
-  for (Entry& e : queue_.Drain()) {
-    std::string sig = ResponseCache::Signature(e);
-    int64_t bit = cache_.Lookup(sig);
+  bool resync_flush = resync_flush_;
+  resync_flush_ = false;
+  // In-flight ops BEFORE this drain: re-announced on a coordinator-
+  // requested resync (their first announcement may have hit an
+  // unexpandable cache bit at the coordinator).
+  std::vector<Entry> prior_in_flight;
+  if (resync_flush) {
+    prior_in_flight = queue_.InFlightSnapshot();
+    std::sort(prior_in_flight.begin(), prior_in_flight.end(),
+              [](const Entry& a, const Entry& b) {
+                return TableKey(a) < TableKey(b);
+              });
+  }
+  std::vector<Entry> entries = queue_.Drain();
+  std::vector<int64_t> bits;
+  bits.reserve(entries.size());
+  bool all_hit = !entries.empty();
+  for (const Entry& e : entries) {
+    int64_t bit = cache_.Lookup(ResponseCache::Signature(e));
+    bits.push_back(bit);
+    if (bit < 0) all_hit = false;
+  }
+  // derive from the captured flags so the blob is internally
+  // consistent even if SetJoined/SetShutdown race the drain
+  bool membership = rl.joined || rl.shutdown;
+  // Steady-state bypass: every drained op is a cache hit, no
+  // membership change in flight, and the periodic full-resync cycle is
+  // not due — the whole drain travels as one compact bit vector
+  // (parity: the coordinated cache bitvector of
+  // Controller::CoordinateCacheAndState).
+  if (all_hit && !membership && !resync_flush && resync_every_ > 0 &&
+      bypass_streak_ + 1 < resync_every_) {
+    bypass_streak_++;
+    rl.cache_bypass = true;
+    std::vector<uint32_t> sorted_bits;
+    sorted_bits.reserve(bits.size());
+    for (int64_t b : bits) sorted_bits.push_back(static_cast<uint32_t>(b));
+    std::sort(sorted_bits.begin(), sorted_bits.end());
+    rl.cache_bits = PackBits(sorted_bits);
+    return SerializeRequestList(rl);
+  }
+  bypass_streak_ = 0;
+  // Periodic resync (streak exhausted) or coordinator-forced flush:
+  // full entries keep the coordinator's message table and stall
+  // inspector authoritative even if caches diverge.
+  bool resync = resync_flush || (all_hit && !membership);
+  rl.cache_resync = resync;
+  for (size_t i = 0; i < entries.size(); ++i) {
+    Entry& e = entries[i];
+    int64_t bit = bits[i];
     Request rq;
     rq.rank = rank_;
-    if (bit >= 0) {
-      // Steady state: transmit the bit id + seq only; the coordinator
-      // expands the bit via its own (identical) cache (parity: the
-      // cache bit-vector exchange in Controller::ComputeResponseList).
+    if (bit >= 0) rl.cache_hits.push_back(static_cast<uint32_t>(bit));
+    if (bit >= 0 && !resync) {
+      // Mixed cycle: transmit the bit id + seq only; the coordinator
+      // expands the bit via its own (identical) cache.
       rq.cached = true;
       rq.cache_bit = static_cast<uint32_t>(bit);
       rq.entry.seq = e.seq;
       rq.entry.name = e.name;  // kept for local Finish() + debuggability
-      rl.cache_hits.push_back(rq.cache_bit);
     } else {
       rq.entry = std::move(e);
     }
+    rl.requests.push_back(std::move(rq));
+  }
+  for (Entry& e : prior_in_flight) {
+    Request rq;
+    rq.rank = rank_;
+    rq.entry = std::move(e);
     rl.requests.push_back(std::move(rq));
   }
   return SerializeRequestList(rl);
@@ -217,6 +278,32 @@ void Controller::Ingest(const uint8_t* data, size_t len) {
     last_joined_rank_ = rl.rank;
   }
   if (rl.shutdown) shutdown_ranks_.insert(rl.rank);
+  if (rl.cache_bypass) {
+    // Expand the rank's cache-bit vector through the coordinator's own
+    // (identical) cache.  An unknown bit means the caches diverged
+    // (e.g. elastic generations mixing): request a full resync from
+    // every rank via the next ResponseList.
+    for (uint32_t bit : UnpackBits(rl.cache_bits)) {
+      Entry cached;
+      if (!cache_.GetEntryForBit(bit, &cached)) {
+        resync_needed_ = true;
+        continue;
+      }
+      cached.seq = 0;
+      std::string key = TableKey(cached);
+      auto it = message_table_.find(key);
+      if (it == message_table_.end()) {
+        PendingCoordination pc;
+        pc.entry = std::move(cached);
+        pc.first_seen_s = now;
+        pc.ranks.insert(rl.rank);
+        message_table_.emplace(std::move(key), std::move(pc));
+      } else {
+        it->second.ranks.insert(rl.rank);
+      }
+    }
+    return;
+  }
   for (const Request& rq : rl.requests) {
     Entry e = rq.entry;
     if (rq.cached) {
@@ -260,6 +347,8 @@ ResponseList Controller::BuildResponseList() {
   ResponseList out;
   out.tuned_fusion_threshold = tuned_threshold_;
   out.tuned_cycle_time_us = tuned_cycle_us_;
+  out.cache_resync_needed = resync_needed_;
+  resync_needed_ = false;
 
   // 1. collect globally-ready keys (every member rank reported, or is
   //    joined).  message_table_ is a std::map → deterministic
@@ -389,36 +478,90 @@ ResponseList Controller::BuildResponseList() {
 }
 
 void Controller::FuseResponses(std::vector<Response>* responses) const {
-  // Parity: Controller::FuseResponses — adjacent compatible allreduce
-  // responses merge while under the fusion threshold.  Compatibility:
-  // same op type, reduction, dtype, process set; allreduce/adasum only
-  // (allgather fusion needs size tables; single responses there).
+  // Compatibility-GROUP fusion (parity: Controller::FuseResponses,
+  // strengthened): every fusible response merges into the open group
+  // for its (type, red_op, dtype, process set) key — not just
+  // adjacent ones — so an unrelated response (another process set's
+  // release landing in the same compute) cannot split an otherwise-
+  // stable fusion group.  That order-independence is what makes
+  // steady-state schedule prediction sound (see PredictResponses).
+  // Output order is group-opening order; a group that would exceed
+  // the fusion threshold closes and a new one opens at the end.
+  // Allreduce/adasum only (allgather fusion needs size tables).
   std::vector<Response> fused;
+  std::map<std::tuple<int, int, int, int32_t>, size_t> open_group;
   for (Response& r : *responses) {
     bool can_fuse =
         (r.type == OpType::kAllreduce || r.type == OpType::kAdasum) &&
         r.error.empty();
-    if (!fused.empty() && can_fuse) {
-      Response& prev = fused.back();
-      bool compatible = prev.type == r.type && prev.red_op == r.red_op &&
-                        prev.dtype == r.dtype &&
-                        prev.process_set_id == r.process_set_id &&
-                        prev.error.empty();
-      if (compatible &&
-          prev.total_bytes + r.total_bytes <= fusion_threshold_) {
-        prev.tensor_names.insert(prev.tensor_names.end(),
-                                 r.tensor_names.begin(),
-                                 r.tensor_names.end());
-        prev.tensor_shapes.insert(prev.tensor_shapes.end(),
-                                  r.tensor_shapes.begin(),
-                                  r.tensor_shapes.end());
-        prev.total_bytes += r.total_bytes;
+    if (can_fuse) {
+      auto key = std::make_tuple(static_cast<int>(r.type),
+                                 static_cast<int>(r.red_op),
+                                 static_cast<int>(r.dtype),
+                                 r.process_set_id);
+      auto it = open_group.find(key);
+      if (it != open_group.end() &&
+          fused[it->second].total_bytes + r.total_bytes <=
+              fusion_threshold_) {
+        Response& g = fused[it->second];
+        g.tensor_names.insert(g.tensor_names.end(),
+                              r.tensor_names.begin(),
+                              r.tensor_names.end());
+        g.tensor_shapes.insert(g.tensor_shapes.end(),
+                               r.tensor_shapes.begin(),
+                               r.tensor_shapes.end());
+        g.total_bytes += r.total_bytes;
         continue;
       }
+      open_group[key] = fused.size();
     }
     fused.push_back(std::move(r));
   }
   *responses = std::move(fused);
+}
+
+std::vector<uint8_t> Controller::PredictResponses(
+    const std::vector<uint32_t>& bits) {
+  // The ResponseList the coordinator WILL emit for a pure bypass
+  // cycle carrying exactly `bits` — a deterministic function of the
+  // (replicated) response cache and the fusion threshold.  Empty
+  // result = unknown bit (caller must not predict).  Only sound under
+  // the Python controller's gating; see eager/controller.py.
+  std::lock_guard<std::mutex> g(mu_);
+  std::vector<Entry> entries;
+  entries.reserve(bits.size());
+  for (uint32_t b : bits) {
+    Entry e;
+    if (!cache_.GetEntryForBit(b, &e)) return {};
+    entries.push_back(std::move(e));
+  }
+  std::sort(entries.begin(), entries.end(),
+            [](const Entry& a, const Entry& b) {
+              return TableKey(a) < TableKey(b);
+            });
+  ResponseList out;
+  for (const Entry& e : entries) {
+    Response rs;
+    rs.type = e.type;
+    rs.red_op = e.red_op;
+    rs.dtype = e.dtype;
+    rs.process_set_id = e.process_set_id;
+    rs.root_rank = e.root_rank;
+    rs.tensor_names.push_back(e.name);
+    rs.tensor_shapes.push_back(e.shape);
+    rs.total_bytes = e.nbytes();
+    out.responses.push_back(std::move(rs));
+  }
+  FuseResponses(&out.responses);
+  return SerializeResponseList(out);
+}
+
+std::vector<uint64_t> Controller::FinishNames(
+    const std::vector<std::string>& names) {
+  // Eagerly retire in-flight entries executed from a PREDICTED
+  // schedule (duplicate-name guard would otherwise trip on the next
+  // step's re-enqueue before the real response streams in).
+  return queue_.Finish(names);
 }
 
 std::vector<uint8_t> Controller::ComputeResponses() {
@@ -448,6 +591,12 @@ ResponseList Controller::ApplyResponses(const uint8_t* data, size_t len,
     }
     std::vector<uint64_t> seqs = queue_.Finish(rs.tensor_names);
     out_finished->insert(out_finished->end(), seqs.begin(), seqs.end());
+  }
+  if (rl.cache_resync_needed) {
+    // Coordinator failed to expand a bypass bit: next drain is a full
+    // resync re-announcing whatever is still outstanding (set AFTER
+    // the Finish pops above, so completed ops are not re-announced).
+    resync_flush_ = true;
   }
   if (rl.join_last_rank >= 0) joined_ = false;
   return rl;

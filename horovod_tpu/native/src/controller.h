@@ -49,6 +49,9 @@ class TensorQueue {
   // Remove finished entries by name; returns their seq ids (parity:
   // GetTensorEntriesFromResponse + PopMessagesFromQueue bookkeeping).
   std::vector<uint64_t> Finish(const std::vector<std::string>& names);
+  // Copies of the entries currently in flight (drained but not yet
+  // answered) — re-announced on a coordinator-requested cache resync.
+  std::vector<Entry> InFlightSnapshot() const;
   int64_t pending_count() const;
   int64_t pending_bytes() const;
 
@@ -152,12 +155,24 @@ class Controller {
     tuned_threshold_ = fusion_threshold;
     tuned_cycle_us_ = cycle_time_us;
   }
+  // Steady-state bypass cadence: every Nth all-cache-hit cycle sends a
+  // full-resync request blob instead of the compact bit vector (0
+  // disables bypass entirely).  Cycle-thread + init-time only.
+  void SetResyncEvery(int64_t n) { resync_every_ = n; }
   // Serialize this cycle's RequestList (drains the queue into in-flight).
   std::vector<uint8_t> DrainRequests();
   // Apply an agreed ResponseList: update cache + queue; out_finished gets
   // the seq ids completed by this response list, in response order.
   ResponseList ApplyResponses(const uint8_t* data, size_t len,
                               std::vector<uint64_t>* out_finished);
+
+  // Steady-state schedule prediction: the ResponseList the
+  // coordinator will emit for a pure bypass cycle of exactly `bits`
+  // (deterministic in the replicated cache + fusion threshold).
+  // Empty vector when a bit is unknown.
+  std::vector<uint8_t> PredictResponses(const std::vector<uint32_t>& bits);
+  // Eagerly retire predicted-executed in-flight entries by name.
+  std::vector<uint64_t> FinishNames(const std::vector<std::string>& names);
 
   // ---- coordinator side (rank 0; parity: MessageTable at rank 0) ----
   void Ingest(const uint8_t* data, size_t len);
@@ -201,7 +216,14 @@ class Controller {
   std::atomic<bool> joined_{false};
   std::atomic<bool> shutdown_{false};
 
+  // cycle-thread-only bypass bookkeeping (drain/apply both run on the
+  // Python cycle loop's thread)
+  int64_t resync_every_ = 64;
+  int64_t bypass_streak_ = 0;
+  bool resync_flush_ = false;
+
   // coordinator state
+  bool resync_needed_ = false;
   int64_t tuned_threshold_ = -1;
   int32_t tuned_cycle_us_ = -1;
   std::map<std::string, PendingCoordination> message_table_;  // by name (ordered for determinism)

@@ -32,6 +32,29 @@ static Entry ReadEntry(Reader& r) {
   return e;
 }
 
+std::vector<uint64_t> PackBits(const std::vector<uint32_t>& bits) {
+  std::vector<uint64_t> words;
+  for (uint32_t b : bits) {
+    size_t w = b >> 6;
+    if (words.size() <= w) words.resize(w + 1, 0);
+    words[w] |= (uint64_t(1) << (b & 63));
+  }
+  return words;
+}
+
+std::vector<uint32_t> UnpackBits(const std::vector<uint64_t>& words) {
+  std::vector<uint32_t> bits;
+  for (size_t w = 0; w < words.size(); ++w) {
+    uint64_t word = words[w];
+    while (word) {
+      int o = __builtin_ctzll(word);
+      bits.push_back(static_cast<uint32_t>((w << 6) + o));
+      word &= word - 1;
+    }
+  }
+  return bits;
+}
+
 std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
   Writer w;
   w.u32(kRequestMagic);
@@ -39,6 +62,9 @@ std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
   w.i32(rl.rank);
   w.u8(rl.joined ? 1 : 0);
   w.u8(rl.shutdown ? 1 : 0);
+  w.u8((rl.cache_bypass ? 1 : 0) | (rl.cache_resync ? 2 : 0));
+  w.u32(static_cast<uint32_t>(rl.cache_bits.size()));
+  for (uint64_t word : rl.cache_bits) w.u64(word);
   w.u32(static_cast<uint32_t>(rl.cache_hits.size()));
   for (uint32_t b : rl.cache_hits) w.u32(b);
   w.u32(static_cast<uint32_t>(rl.requests.size()));
@@ -59,6 +85,12 @@ RequestList ParseRequestList(const uint8_t* data, size_t len) {
   rl.rank = r.i32();
   rl.joined = r.u8() != 0;
   rl.shutdown = r.u8() != 0;
+  uint8_t flags = r.u8();
+  rl.cache_bypass = (flags & 1) != 0;
+  rl.cache_resync = (flags & 2) != 0;
+  uint32_t nwords = r.u32();
+  rl.cache_bits.resize(nwords);
+  for (uint32_t i = 0; i < nwords; ++i) rl.cache_bits[i] = r.u64();
   uint32_t nhits = r.u32();
   rl.cache_hits.resize(nhits);
   for (uint32_t i = 0; i < nhits; ++i) rl.cache_hits[i] = r.u32();
@@ -79,6 +111,7 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
   w.u32(kWireVersion);
   w.i32(rl.join_last_rank);
   w.u8(rl.shutdown ? 1 : 0);
+  w.u8(rl.cache_resync_needed ? 1 : 0);
   w.i64(rl.tuned_fusion_threshold);
   w.i32(rl.tuned_cycle_time_us);
   w.u32(static_cast<uint32_t>(rl.responses.size()));
@@ -107,6 +140,7 @@ ResponseList ParseResponseList(const uint8_t* data, size_t len) {
   ResponseList rl;
   rl.join_last_rank = r.i32();
   rl.shutdown = r.u8() != 0;
+  rl.cache_resync_needed = r.u8() != 0;
   rl.tuned_fusion_threshold = r.i64();
   rl.tuned_cycle_time_us = r.i32();
   uint32_t n = r.u32();

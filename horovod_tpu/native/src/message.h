@@ -19,7 +19,11 @@ constexpr uint32_t kRequestMagic = 0x52545648;   // "HVTR"
 constexpr uint32_t kResponseMagic = 0x50545648;  // "HVTP"
 // v2: ResponseList carries coordinator-tuned (fusion threshold, cycle
 // time) so every rank applies identical autotuned parameters.
-constexpr uint32_t kWireVersion = 2;
+// v3: RequestList grows the steady-state `cache_bits` frame (bypass
+// cycles send a per-rank cache-bit vector instead of serialized
+// requests) + bypass/resync flags; ResponseList carries
+// `cache_resync_needed` to force full-request cycles on divergence.
+constexpr uint32_t kWireVersion = 3;
 
 // A request as sent rank -> coordinator. Parity: message.h Request.
 struct Request {
@@ -37,7 +41,21 @@ struct RequestList {
   std::vector<uint32_t> cache_hits;  // bit ids of cached pending requests
   bool joined = false;
   bool shutdown = false;
+  // Steady-state bypass cycle: `requests` is empty and the drained ops
+  // travel as set bits in `cache_bits` (u64 words, little-endian bit
+  // order within a word).
+  bool cache_bypass = false;
+  // Periodic full resync: requests carry FULL entries so the
+  // coordinator's message table / stall inspector re-anchor on truth.
+  bool cache_resync = false;
+  std::vector<uint64_t> cache_bits;
 };
+
+// Pack ascending bit ids into a u64-word bitvector / back.  The byte
+// layout (and therefore the bit order produced by UnpackBits) must
+// match wire.py's bits_to_words/words_to_bits exactly.
+std::vector<uint64_t> PackBits(const std::vector<uint32_t>& bits);
+std::vector<uint32_t> UnpackBits(const std::vector<uint64_t>& words);
 
 // Coordinator decision for one fused batch. Parity: message.h Response:
 // one Response may carry many tensor names that execute as a single
@@ -64,6 +82,10 @@ struct ResponseList {
   std::vector<Response> responses;
   int32_t join_last_rank = -1;  // >=0 once every rank joined
   bool shutdown = false;
+  // Coordinator could not expand a bypass cache bit: every rank must
+  // send a full-resync request blob next cycle (re-announcing its
+  // in-flight ops) so the message table heals.
+  bool cache_resync_needed = false;
   // coordinator-tuned parameters (-1 = unset)
   int64_t tuned_fusion_threshold = -1;
   int32_t tuned_cycle_time_us = -1;

@@ -18,7 +18,13 @@ RESPONSE_MAGIC = 0x50545648  # "HVTP"
 # v2: ResponseList carries coordinator-tuned (fusion threshold, cycle
 # time) so every rank applies identical autotuned parameters (parity:
 # ParameterManager broadcasting tuned params from the coordinator).
-WIRE_VERSION = 2
+# v3: RequestList grows the steady-state `cache_bits` frame (bypass
+# cycles negotiate via a per-rank cache-bit vector instead of
+# serialized requests; parity: the coordinated cache bitvector of
+# Controller::CoordinateCacheAndState) plus bypass/resync flags, and
+# ResponseList carries `cache_resync_needed` so the coordinator can
+# force every rank back to a full-request cycle.
+WIRE_VERSION = 3
 
 # OpType (native/src/common.h)
 ALLREDUCE, ALLGATHER, BROADCAST, ALLTOALL, REDUCESCATTER, ADASUM, BARRIER, JOIN = range(8)
@@ -78,6 +84,38 @@ class RequestList:
     cache_hits: List[int] = dataclasses.field(default_factory=list)
     joined: bool = False
     shutdown: bool = False
+    # Steady-state bypass cycle: ``requests`` is empty and the drained
+    # ops travel as set bits in ``cache_bits`` (u64 words, bit b set =>
+    # this rank drained a request whose signature holds cache bit b).
+    cache_bypass: bool = False
+    # This blob is a periodic full resync: requests carry FULL entries
+    # (no per-request bit compression) so the coordinator's message
+    # table and stall inspector re-anchor on ground truth.
+    cache_resync: bool = False
+    cache_bits: List[int] = dataclasses.field(default_factory=list)
+
+
+def bits_to_words(bits: List[int]) -> List[int]:
+    """Pack bit ids into a little-endian u64-word bitvector."""
+    words: List[int] = []
+    for b in bits:
+        w, o = b >> 6, b & 63
+        while len(words) <= w:
+            words.append(0)
+        words[w] |= 1 << o
+    return words
+
+
+def words_to_bits(words: List[int]) -> List[int]:
+    """Unpack a u64-word bitvector into ascending bit ids."""
+    bits: List[int] = []
+    for w, word in enumerate(words):
+        base = w << 6
+        while word:
+            o = (word & -word).bit_length() - 1
+            bits.append(base + o)
+            word &= word - 1
+    return bits
 
 
 @dataclasses.dataclass
@@ -98,6 +136,10 @@ class ResponseList:
     responses: List[Response] = dataclasses.field(default_factory=list)
     join_last_rank: int = -1
     shutdown: bool = False
+    # Coordinator could not expand a bypass cache bit (cache divergence,
+    # e.g. an elastic restart mixing generations): every rank must send
+    # a full-resync request blob next cycle, re-announcing in-flight ops.
+    cache_resync_needed: bool = False
     # coordinator-tuned parameters (-1 = unset)
     tuned_fusion_threshold: int = -1
     tuned_cycle_time_us: int = -1
@@ -181,6 +223,10 @@ def serialize_request_list(rl: RequestList) -> bytes:
     w.i32(rl.rank)
     w.u8(1 if rl.joined else 0)
     w.u8(1 if rl.shutdown else 0)
+    w.u8((1 if rl.cache_bypass else 0) | (2 if rl.cache_resync else 0))
+    w.u32(len(rl.cache_bits))
+    for word in rl.cache_bits:
+        w.u64(word)
     w.u32(len(rl.cache_hits))
     for b in rl.cache_hits:
         w.u32(b)
@@ -203,6 +249,10 @@ def parse_request_list(data: bytes) -> RequestList:
     rl.rank = r.i32()
     rl.joined = r.u8() != 0
     rl.shutdown = r.u8() != 0
+    flags = r.u8()
+    rl.cache_bypass = bool(flags & 1)
+    rl.cache_resync = bool(flags & 2)
+    rl.cache_bits = [r.u64() for _ in range(r.u32())]
     rl.cache_hits = [r.u32() for _ in range(r.u32())]
     n = r.u32()
     for _ in range(n):
@@ -221,6 +271,7 @@ def serialize_response_list(rl: ResponseList) -> bytes:
     w.u32(WIRE_VERSION)
     w.i32(rl.join_last_rank)
     w.u8(1 if rl.shutdown else 0)
+    w.u8(1 if rl.cache_resync_needed else 0)
     w.i64(rl.tuned_fusion_threshold)
     w.i32(rl.tuned_cycle_time_us)
     w.u32(len(rl.responses))
@@ -251,6 +302,7 @@ def parse_response_list(data: bytes) -> ResponseList:
     rl = ResponseList()
     rl.join_last_rank = r.i32()
     rl.shutdown = r.u8() != 0
+    rl.cache_resync_needed = r.u8() != 0
     rl.tuned_fusion_threshold = r.i64()
     rl.tuned_cycle_time_us = r.i32()
     n = r.u32()

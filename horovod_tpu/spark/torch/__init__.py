@@ -330,6 +330,26 @@ class TorchEstimator(HorovodEstimator):
                         "must accept (output, label, sample_weight) "
                         "with the third parameter required or named "
                         "like a weight")
+                third = positional[2]
+                if (third.default is third.empty
+                        and third.name.lower() not in weight_names):
+                    # A required third arg passes the gate, but a
+                    # non-weight-looking name (focal's `gamma`, say)
+                    # probably means the weight batch is about to bind
+                    # to a hyperparameter and train silently wrong —
+                    # say so, naming the parameter.
+                    import warnings
+
+                    warnings.warn(
+                        f"sample_weight_col is set and loss "
+                        f"{getattr(fn, '__name__', fn)!r} will receive "
+                        f"the per-sample weight batch as its third "
+                        f"positional argument {third.name!r}, which "
+                        "does not look like a weight parameter — if "
+                        f"{third.name!r} is a hyperparameter, bind it "
+                        "with functools.partial and accept "
+                        "(output, label, sample_weight) instead",
+                        stacklevel=2)
         lw = self.getLossWeights()
         if lw is not None:
             loss = self.getLoss()

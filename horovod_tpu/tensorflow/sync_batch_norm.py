@@ -88,7 +88,13 @@ class SyncBatchNormalization(keras.layers.BatchNormalization):
             process_set=self._process_set)
         g_sum = packed[:c]
         g_sqsum = packed[c:2 * c]
-        g_count = packed[2 * c]
+        # Every rank can legitimately see an empty batch on the same
+        # step (ragged tail of a small dataset): g_count == 0 would
+        # turn mean/variance into NaN and permanently poison the
+        # moving statistics.  Clamping degrades the step to zero
+        # moments instead (sums are zero too), matching the base
+        # layer's no-op behavior on empty input.
+        g_count = tf.maximum(packed[2 * c], 1.0)
         mean = g_sum / g_count
         # E[x^2]-E[x]^2 can go fractionally negative via float32
         # cancellation when |mean| >> std — rsqrt(var+eps) would then

@@ -86,3 +86,34 @@ class TestMetricsEmbedding:
         assert "hvtpu_wire_bytes_total" in required
         assert "hvtpu_controller_cycle_seconds" in required
         assert "hvtpu_optimizer_steps_total" in required
+
+
+class TestTorchStepSchema:
+    """bench_eager's torch DistributedOptimizer step-time row: the
+    schema is enforced so future rounds stay comparable, and
+    BENCH_EAGER.json must actually carry a recorded P=4 row."""
+
+    @pytest.fixture
+    def bench_eager(self):
+        import importlib
+
+        import bench_eager as mod
+
+        return importlib.reload(mod)
+
+    def test_row_builder_schema(self, bench_eager):
+        row = bench_eager.build_torch_step_row(4, 16, 1 << 20, 2.5)
+        assert set(bench_eager.TORCH_STEP_KEYS) <= set(row)
+        assert row["bench"] == "eager_torch_step"
+        assert row["np"] == 4
+        assert row["steps_per_s"] == pytest.approx(400.0)
+        json.dumps(row)  # single JSON-serializable line
+
+    def test_recorded_bench_has_torch_step_row(self, bench_eager):
+        with open(os.path.join(_ROOT, "BENCH_EAGER.json")) as f:
+            data = json.load(f)
+        row = data["torch_step"]
+        assert row["np"] == 4
+        for key in bench_eager.TORCH_STEP_KEYS:
+            assert key in row, key
+        assert row["ms_per_step"] > 0

@@ -301,6 +301,102 @@ class TestMultiRankNegotiation:
         assert by_ps[2].tensor_names == ["x"]
         assert by_ps[2].tensor_shapes == [(5,)]
 
+    def test_steady_state_bypass_observable_and_bit_identical(self, hvt):
+        """Acceptance: a same-shape allreduce loop reports
+        hvtpu_controller_bypass_cycles_total > 0, and the results of
+        bypass cycles are bit-identical to full cycles (resync_every=0
+        disables the fast path entirely)."""
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        bypass_ctr = obs_metrics.counter(
+            "hvtpu_controller_bypass_cycles_total")
+
+        def run_loop(disable_bypass):
+            ctrls = make_world(2)
+            if disable_bypass:
+                for c in ctrls:
+                    c._ctrl.set_resync_every(0)
+            outs = []
+            try:
+                for step in range(5):
+                    futs = []
+                    for c in ctrls:
+                        for i in range(3):
+                            futs.append(c.enqueue(
+                                "allreduce",
+                                jnp.full((8,), float(step * 3 + i)),
+                                name=f"bp/{i}", op=ReduceOp.SUM,
+                            ))
+                    outs.extend(np.asarray(f.result(timeout=20))
+                                for f in futs)
+            finally:
+                stop_world(ctrls)
+            return np.stack(outs)
+
+        base = bypass_ctr.value()
+        with_bypass = run_loop(disable_bypass=False)
+        assert bypass_ctr.value() > base
+        mid = bypass_ctr.value()
+        without = run_loop(disable_bypass=True)
+        assert bypass_ctr.value() == mid  # fast path really was off
+        np.testing.assert_array_equal(with_bypass, without)
+
+    def test_predicted_fast_path_opt_in(self, hvt, monkeypatch):
+        """HVTPU_EAGER_PREDICT=1 (experimental): a steady same-shape
+        loop eventually executes predicted schedules without waiting
+        for the coordinator round trip, with correct results."""
+        import numpy as np
+
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        monkeypatch.setenv("HVTPU_EAGER_PREDICT", "1")
+        pred = obs_metrics.counter(
+            "hvtpu_controller_predicted_cycles_total")
+        base = pred.value()
+        ctrls = make_world(2)
+        try:
+            for step in range(30):
+                futs = [c.enqueue("allreduce",
+                                  jnp.full((4,), float(step)),
+                                  name=f"pr/{i}")
+                        for c in ctrls for i in range(2)]
+                for f in futs:
+                    np.testing.assert_allclose(
+                        np.asarray(f.result(timeout=20)), float(step))
+                if pred.value() > base:
+                    break
+        finally:
+            stop_world(ctrls)
+        assert pred.value() > base
+
+    @pytest.mark.chaos
+    def test_kv_faults_during_bypass_cycles_recover(self, hvt):
+        """Chaos: seeded error-injected KV writes during steady-state
+        bypass cycles are retried by the transport (UNAVAILABLE is
+        transient) and every future still resolves."""
+        from horovod_tpu.core import faults as core_faults
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        bypass_ctr = obs_metrics.counter(
+            "hvtpu_controller_bypass_cycles_total")
+        base = bypass_ctr.value()
+        core_faults.install("kv.put:error@prob=0.2,times=12", rank=0,
+                            seed=11)
+        try:
+            ctrls = make_world(2)
+            try:
+                for step in range(8):
+                    futs = [c.enqueue("allreduce", jnp.ones(4),
+                                      name=f"ch/{step % 2}")
+                            for c in ctrls]
+                    for f in futs:
+                        f.result(timeout=30)
+            finally:
+                stop_world(ctrls)
+        finally:
+            core_faults.uninstall()
+        assert bypass_ctr.value() > base  # faults hit the fast path
+
     def test_steady_state_cache_and_fusion(self, hvt):
         ctrls = make_world(2, fusion_threshold=1 << 20)
         try:

@@ -69,6 +69,28 @@ class TestObjectState:
         train(state)
         assert events == ["sync", "train"]
 
+    def test_reset_callbacks_rebroadcast_after(self, hvt, monkeypatch):
+        """ADVICE r5 ordering divergence: callbacks run after sync, so
+        a rank-dependent callback could desync tracked state — the
+        wrapper must re-broadcast tracked attributes afterwards."""
+        events = []
+        state = elastic.ObjectState(val=7)
+        orig = state.rebroadcast
+        state.rebroadcast = \
+            lambda: (events.append("rebroadcast"), orig())[1]
+        state.register_reset_callbacks([lambda: events.append("cb")])
+
+        @elastic.run
+        def train(st):
+            events.append("train")
+
+        monkeypatch.setenv("HVTPU_ELASTIC_GENERATION", "1")
+        train(state)
+        assert events == ["cb", "rebroadcast", "train"]
+        # single-rank rebroadcast is an identity round-trip that also
+        # refreshes the rollback snapshot
+        assert state.val == 7 and state._saved == {"val": 7}
+
     def test_commit_persists_to_state_dir(self, hvt, tmp_path,
                                           monkeypatch):
         monkeypatch.setenv("HVTPU_ELASTIC_STATE_DIR", str(tmp_path))

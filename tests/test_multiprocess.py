@@ -404,6 +404,35 @@ def test_join_uneven_batches_2proc():
             assert out["b2"] == 4.0   # (8 + 0) / 2: zeros count in avg
 
 
+@pytest.mark.slow
+def test_elastic_reset_callback_rebroadcast_2proc():
+    """ADVICE r5 regression: a RANK-DEPENDENT reset callback in a
+    relaunched incarnation runs after sync; without the wrapper's
+    re-broadcast the tracked attributes silently diverge across
+    ranks.  Both ranks must come out with rank 0's values."""
+
+    def body():
+        import os
+
+        import horovod_tpu as hvt
+        from horovod_tpu import elastic
+
+        os.environ["HVTPU_ELASTIC_GENERATION"] = "1"
+        hvt.init()
+        state = elastic.ObjectState(lr=0.0, epoch=3)
+        state.register_reset_callbacks(
+            [lambda: setattr(state, "lr", 100.0 + hvt.rank())])
+
+        @elastic.run
+        def train(st):
+            return (st.lr, st.epoch)
+
+        return train(state)
+
+    results = _run(body, np=2)
+    assert results[0] == results[1] == (100.0, 3)
+
+
 def test_hierarchical_allreduce_4proc():
     """HVTPU_HIERARCHICAL_ALLREDUCE over a 2-host x 2-slot layout
     (both 'hosts' are loopback names, so everything spawns locally but

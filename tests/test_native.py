@@ -108,11 +108,15 @@ class TestControllerProtocol:
             blobs = [c.drain_requests() for c in (c0, c1)]
             parsed = [wire.parse_request_list(b) for b in blobs]
             if step == 0:
+                assert not parsed[0].cache_bypass
                 assert not parsed[0].requests[0].cached
             else:
-                # steady state: bit-only requests, much smaller blob
-                assert parsed[0].requests[0].cached
-                assert parsed[1].requests[0].cached
+                # steady state: the whole drain rides the cache-bit
+                # vector (no serialized requests at all)
+                for p in parsed:
+                    assert p.cache_bypass
+                    assert p.requests == []
+                    assert wire.words_to_bits(p.cache_bits) == [0]
             for b in blobs:
                 c0.ingest(b)
             resp = c0.compute_responses()
@@ -193,6 +197,217 @@ class TestControllerProtocol:
         assert c.pending_bytes == 40
         c.drain_requests()
         assert c.pending_count == 0
+
+    def test_group_fusion_merges_non_adjacent(self, impl):
+        """Compatibility-group fusion: an incompatible response landing
+        between two compatible ones (table-key order a < m < z) must
+        not split their fusion group."""
+        c0, c1 = make_pair(impl, fusion=1 << 20)
+        for c in (c0, c1):
+            c.enqueue(1, "a", wire.ALLREDUCE, wire.RED_SUM, 6, (4,))
+            c.enqueue(2, "m", wire.ALLREDUCE, wire.RED_SUM, 5, (4,))  # bf16
+            c.enqueue(3, "z", wire.ALLREDUCE, wire.RED_SUM, 6, (4,))
+        resp, _ = run_cycle([c0, c1])
+        rl = wire.parse_response_list(resp)
+        assert [r.tensor_names for r in rl.responses] == [["a", "z"], ["m"]]
+
+    def test_predict_responses_matches_coordinator(self, impl):
+        """Steady-state schedule prediction: predict_responses(bits)
+        must be byte-identical to what the coordinator computes for
+        the same bypass cycle, None for unknown bits; finish() retires
+        in-flight names so re-enqueues pass the duplicate guard."""
+        c0, c1 = make_pair(impl, fusion=1 << 20)
+        for step in range(2):
+            for c in (c0, c1):
+                c.enqueue(step * 4 + 1, "p/a", wire.ALLREDUCE,
+                          wire.RED_SUM, 6, (8,))
+                c.enqueue(step * 4 + 2, "p/b", wire.ALLREDUCE,
+                          wire.RED_SUM, 6, (8,))
+            run_cycle([c0, c1])
+        # third (steady) cycle: predict BEFORE the exchange, then run
+        # the real negotiation and compare bytes
+        for c in (c0, c1):
+            c.enqueue(90 + c.rank, "p/a", wire.ALLREDUCE, wire.RED_SUM,
+                      6, (8,))
+            c.enqueue(95 + c.rank, "p/b", wire.ALLREDUCE, wire.RED_SUM,
+                      6, (8,))
+        predicted = c1.predict_responses([0, 1])
+        assert predicted is not None
+        blobs = [c.drain_requests() for c in (c0, c1)]
+        assert wire.parse_request_list(blobs[0]).cache_bypass
+        for b in blobs:
+            c0.ingest(b)
+        real = c0.compute_responses()
+        assert predicted == real
+        rl = wire.parse_response_list(predicted)
+        assert [r.tensor_names for r in rl.responses] == [["p/a", "p/b"]]
+        assert rl.responses[0].tensor_shapes == [(8,), (8,)]
+        # unknown bit: no prediction
+        assert c0.predict_responses([0, 9]) is None
+        # finish() retires rank 1's in-flight entries eagerly
+        assert sorted(c1.finish(["p/a", "p/b"])) == [91, 96]
+        assert c1.enqueue(200, "p/a", wire.ALLREDUCE, wire.RED_SUM,
+                          6, (8,))
+
+    def test_bypass_streak_and_periodic_resync(self, impl):
+        """Steady state cycles between bypass blobs and the periodic
+        full resync: with resync_every=4 the cadence is miss, 3×
+        bypass, resync, 3× bypass, resync, ..."""
+        c0, c1 = make_pair(impl, resync_every=4)
+        for step in range(9):
+            for c in (c0, c1):
+                c.enqueue(step * 2 + c.rank + 1, "g",
+                          wire.ALLREDUCE, wire.RED_SUM, 6, (8,))
+            blobs = [c.drain_requests() for c in (c0, c1)]
+            parsed = wire.parse_request_list(blobs[0])
+            if step == 0:
+                assert not parsed.cache_bypass and not parsed.cache_resync
+                assert not parsed.requests[0].cached
+            elif step % 4 == 0:
+                # periodic resync: FULL entries (not bit-compressed),
+                # flagged, hits still counted for the metrics frame
+                assert parsed.cache_resync and not parsed.cache_bypass
+                assert not parsed.requests[0].cached
+                assert parsed.requests[0].entry.shape == (8,)
+                assert parsed.cache_hits == [0]
+            else:
+                assert parsed.cache_bypass and not parsed.cache_resync
+                assert parsed.requests == []
+                assert wire.words_to_bits(parsed.cache_bits) == [0]
+            for b in blobs:
+                c0.ingest(b)
+            resp = c0.compute_responses()
+            fins = [c.apply_responses(resp) for c in (c0, c1)]
+            rl = wire.parse_response_list(resp)
+            assert [r.tensor_names for r in rl.responses] == [["g"]]
+            assert rl.responses[0].tensor_shapes == [(8,)]
+            assert fins[0] == [step * 2 + 1]
+
+    def test_miss_exits_bypass_and_rejoins(self, impl):
+        """A novel tensor mid-steady-state (miss) drops the cycle back
+        to the full wire (hits bit-compressed, the miss full), then the
+        next all-hit cycle resumes bypassing."""
+        c0, c1 = make_pair(impl)
+        seq = iter(range(1, 100))
+
+        def cycle(names):
+            for c in (c0, c1):
+                for nm in names:
+                    c.enqueue(next(seq), nm, wire.ALLREDUCE,
+                              wire.RED_SUM, 6, (4,))
+            blobs = [c.drain_requests() for c in (c0, c1)]
+            for b in blobs:
+                c0.ingest(b)
+            resp = c0.compute_responses()
+            for c in (c0, c1):
+                c.apply_responses(resp)
+            return wire.parse_request_list(blobs[0])
+
+        cycle(["a"])                    # miss: full
+        assert cycle(["a"]).cache_bypass        # steady state
+        mixed = cycle(["a", "b"])       # miss on b: full cycle
+        assert not mixed.cache_bypass
+        assert mixed.requests[0].cached          # a rides its bit
+        assert not mixed.requests[1].cached      # b full
+        rejoin = cycle(["a", "b"])      # both hit again
+        assert rejoin.cache_bypass
+        assert wire.words_to_bits(rejoin.cache_bits) == [0, 1]
+
+    def test_membership_change_forces_full_cycle(self, impl):
+        c0, c1 = make_pair(impl)
+        for c in (c0, c1):
+            c.enqueue(1, "g", wire.ALLREDUCE, wire.RED_SUM, 6, (4,))
+        run_cycle([c0, c1])
+        c0.set_joined()
+        c0.enqueue(2, "g", wire.ALLREDUCE, wire.RED_SUM, 6, (4,))
+        parsed = wire.parse_request_list(c0.drain_requests())
+        # a joined/shutdown announcement must never ride a bypass blob
+        assert parsed.joined and not parsed.cache_bypass
+
+    def test_unknown_bypass_bit_requests_resync(self, impl):
+        """Cache divergence recovery: an unexpandable bypass bit makes
+        the coordinator broadcast cache_resync_needed (one-shot), and a
+        rank applying it re-announces its in-flight ops as full
+        entries so the op completes."""
+        c0, c1 = make_pair(impl)
+        # rank 1's op goes in flight (drained, never answered)
+        c1.enqueue(1, "x", wire.ALLREDUCE, wire.RED_SUM, 6, (4,))
+        c0.ingest(c0.drain_requests())
+        c0.ingest(c1.drain_requests())
+        resp = c0.compute_responses()
+        for c in (c0, c1):
+            c.apply_responses(resp)
+        # corrupt scenario: a bypass blob referencing a bit the
+        # coordinator never created
+        rogue = wire.RequestList(rank=1, cache_bypass=True,
+                                 cache_bits=wire.bits_to_words([7]))
+        c0.ingest(wire.serialize_request_list(rogue))
+        rl = wire.parse_response_list(c0.compute_responses())
+        assert rl.cache_resync_needed
+        # one-shot: the next ResponseList is clean
+        rl2 = wire.parse_response_list(c0.compute_responses())
+        assert not rl2.cache_resync_needed
+        # a rank that applies the resync response re-announces its
+        # in-flight op with the FULL entry
+        c1.apply_responses(wire.serialize_response_list(
+            wire.ResponseList(cache_resync_needed=True)))
+        parsed = wire.parse_request_list(c1.drain_requests())
+        assert parsed.cache_resync
+        assert [rq.entry.name for rq in parsed.requests] == ["x"]
+        assert not parsed.requests[0].cached
+        assert parsed.requests[0].entry.shape == (4,)
+        # the re-announcement completes the op once rank 0 reports too
+        c0.enqueue(2, "x", wire.ALLREDUCE, wire.RED_SUM, 6, (4,))
+        resp, fins = run_cycle([c0, c1])
+        names = [n for r in wire.parse_response_list(resp).responses
+                 for n in r.tensor_names]
+        assert names == ["x"]
+        assert fins[1] == [1]
+
+
+class TestCacheBitsWire:
+    """The v3 cache_bits frame: packing helpers + blob round-trips."""
+
+    def test_bits_words_roundtrip(self):
+        for bits in ([], [0], [63], [64], [0, 1, 63, 64, 65, 127, 128],
+                     [5, 200, 1023]):
+            words = wire.bits_to_words(bits)
+            assert wire.words_to_bits(words) == sorted(bits)
+
+    def test_request_list_bypass_roundtrip(self):
+        rl = wire.RequestList(rank=3, cache_bypass=True,
+                              cache_bits=wire.bits_to_words([0, 2, 70]))
+        out = wire.parse_request_list(wire.serialize_request_list(rl))
+        assert out.rank == 3
+        assert out.cache_bypass and not out.cache_resync
+        assert out.requests == []
+        assert wire.words_to_bits(out.cache_bits) == [0, 2, 70]
+
+    def test_request_list_resync_flag_roundtrip(self):
+        rl = wire.RequestList(rank=1, cache_resync=True)
+        out = wire.parse_request_list(wire.serialize_request_list(rl))
+        assert out.cache_resync and not out.cache_bypass
+
+    def test_response_list_resync_needed_roundtrip(self):
+        rl = wire.ResponseList(cache_resync_needed=True)
+        out = wire.parse_response_list(wire.serialize_response_list(rl))
+        assert out.cache_resync_needed
+
+    def test_bypass_blob_is_much_smaller(self):
+        """The point of the fast path: a steady-state drain of many ops
+        is a handful of bytes, not O(requests)."""
+        full = wire.RequestList(rank=0)
+        for i in range(32):
+            full.requests.append(wire.Request(rank=0, entry=wire.Entry(
+                seq=i, name=f"grad/layer{i}/kernel", dtype=6,
+                shape=(128, 128))))
+        bypass = wire.RequestList(
+            rank=0, cache_bypass=True,
+            cache_bits=wire.bits_to_words(list(range(32))))
+        nfull = len(wire.serialize_request_list(full))
+        nbyp = len(wire.serialize_request_list(bypass))
+        assert nbyp < nfull / 20
+        assert nbyp < 40
 
 
 @pytest.mark.skipif(not NATIVE, reason="no C++ toolchain")
@@ -328,6 +543,67 @@ class TestNativePythonAgreement:
         py_resp = py[0].compute_responses()
         assert nat_resp == py_resp
         assert wire.parse_response_list(py_resp).shutdown
+
+    def test_bypass_and_resync_cycle_bytes_identical(self):
+        """The v3 steady-state protocol — bypass bit-vector blobs, the
+        periodic full-resync cadence, and the responses they produce —
+        must agree byte-for-byte between the C++ and Python
+        controllers across enough cycles to cover every phase."""
+        nat = make_pair(ncore.NativeController, size=2, fusion=1 << 10,
+                        resync_every=3)
+        py = make_pair(fallback.PyController, size=2, fusion=1 << 10,
+                       resync_every=3)
+        saw_bypass = saw_resync = False
+        for step in range(8):
+            for pair in (nat, py):
+                for c in pair:
+                    c.enqueue(step * 10 + c.rank + 1, "w/kernel",
+                              wire.ALLREDUCE, wire.RED_AVERAGE, 6,
+                              (64, 64))
+                    c.enqueue(step * 10 + c.rank + 5, "w/bias",
+                              wire.ALLREDUCE, wire.RED_AVERAGE, 6,
+                              (64,))
+            nat_blobs = [c.drain_requests() for c in nat]
+            py_blobs = [c.drain_requests() for c in py]
+            assert nat_blobs == py_blobs, f"step {step}"
+            parsed = wire.parse_request_list(py_blobs[0])
+            saw_bypass |= parsed.cache_bypass
+            saw_resync |= parsed.cache_resync
+            for b in nat_blobs:
+                nat[0].ingest(b)
+            for b in py_blobs:
+                py[0].ingest(b)
+            nat_resp = nat[0].compute_responses()
+            py_resp = py[0].compute_responses()
+            assert nat_resp == py_resp, f"step {step}"
+            nat_fins = [c.apply_responses(nat_resp) for c in nat]
+            py_fins = [c.apply_responses(py_resp) for c in py]
+            assert nat_fins == py_fins
+        assert saw_bypass and saw_resync
+
+    def test_resync_needed_recovery_bytes_identical(self):
+        """The unknown-bit -> cache_resync_needed -> in-flight
+        re-announcement path produces identical bytes in both impls."""
+        rogue = wire.serialize_request_list(wire.RequestList(
+            rank=1, cache_bypass=True,
+            cache_bits=wire.bits_to_words([9])))
+        force = wire.serialize_response_list(wire.ResponseList(
+            cache_resync_needed=True))
+        outs = []
+        for cls in (ncore.NativeController, fallback.PyController):
+            c0, c1 = make_pair(cls, size=2)
+            c1.enqueue(4, "x", wire.ALLREDUCE, wire.RED_SUM, 6, (2, 3))
+            c1.drain_requests()          # x now in flight at rank 1
+            c0.ingest(rogue)
+            resp = c0.compute_responses()
+            c1.apply_responses(force)
+            reann = c1.drain_requests()
+            outs.append((resp, reann))
+        assert outs[0] == outs[1]
+        assert wire.parse_response_list(outs[0][0]).cache_resync_needed
+        parsed = wire.parse_request_list(outs[0][1])
+        assert parsed.cache_resync
+        assert [rq.entry.name for rq in parsed.requests] == ["x"]
 
     def test_cross_impl_fleet(self):
         """Rank 0 native + rank 1 Python coordinate successfully."""
@@ -502,4 +778,4 @@ class TestWheelBuild:
         zipfile.ZipFile(whl).extractall(site)
         lib = ctypes.CDLL(str(site / "horovod_tpu/native/libhvt_core.so"))
         lib.hvt_abi_version.restype = ctypes.c_int
-        assert lib.hvt_abi_version() == 2
+        assert lib.hvt_abi_version() == 3

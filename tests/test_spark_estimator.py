@@ -338,6 +338,43 @@ class TestTorchEstimator:
                 sample_weight_col="w",
                 transformation_fn=lambda f, l: (f, l)).fit(df)
 
+    def test_sample_weight_nonweight_third_arg_warns(self, tmp_path):
+        """ADVICE r5: a REQUIRED third positional that doesn't look
+        like a weight (focal's `gamma`) passes the arity gate but gets
+        a warning naming the parameter — the weight batch is about to
+        bind to a hyperparameter and train silently wrong."""
+        import warnings
+
+        import torch
+        import torch.nn as nn
+
+        from horovod_tpu.spark import TorchEstimator
+
+        model = nn.Sequential(nn.Linear(4, 1))
+
+        def est(loss):
+            return TorchEstimator(
+                model=model,
+                optimizer=torch.optim.SGD(model.parameters(), lr=0.1),
+                feature_cols=["features"], label_cols=["label"],
+                batch_size=32, epochs=1, num_proc=2,
+                store=LocalStore(str(tmp_path)),
+                loss=loss, sample_weight_col="w")
+
+        def focal(output, target, gamma):
+            return ((output - target) ** 2 * gamma).mean()
+
+        with pytest.warns(UserWarning, match="'gamma'"):
+            est(focal)._check_params()
+
+        # a weight-named third arg stays silent
+        def weighted(output, target, sample_weight):
+            return ((output - target) ** 2 * sample_weight).mean()
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            est(weighted)._check_params()
+
     def test_lightning_shim_raises_with_guidance(self):
         from horovod_tpu.spark.lightning import LightningEstimator
 

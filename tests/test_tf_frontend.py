@@ -528,6 +528,30 @@ class TestSyncBatchNormalization:
         grads = tape.gradient(loss, sbn.trainable_variables)
         assert len(grads) == 2 and all(g is not None for g in grads)
 
+    def test_all_ranks_empty_batch_degrades_to_zeros(self, hvt,
+                                                     monkeypatch):
+        """ADVICE r5: a step where EVERY rank sees an empty batch
+        (g_count == 0) must degrade to zero moments instead of
+        poisoning the moving statistics with NaN."""
+        from horovod_tpu.core import process_set as ps_mod
+
+        sbn = hvd_tf.SyncBatchNormalization(momentum=0.5)
+        sbn.build((None, 3))
+        # simulate a 2-rank world whose fused stats allreduce returns
+        # the packed sums unchanged (every rank contributed zero rows)
+        monkeypatch.setattr(ps_mod, "participant_count", lambda ps: 2)
+        monkeypatch.setattr(
+            "horovod_tpu.tensorflow.mpi_ops.allreduce",
+            lambda t, **kw: t)
+        mean, variance = sbn._moments(tf.zeros((0, 3), tf.float32),
+                                      None)
+        assert np.all(mean.numpy() == 0.0)
+        assert np.all(variance.numpy() == 0.0)
+        y = sbn(tf.zeros((0, 3), tf.float32), training=True)
+        assert y.shape == (0, 3)
+        assert np.isfinite(sbn.moving_mean.numpy()).all()
+        assert np.isfinite(sbn.moving_variance.numpy()).all()
+
     def test_config_roundtrips_process_set_id(self, hvt):
         sbn = hvd_tf.SyncBatchNormalization(
             momentum=0.8, process_set=hvd_tf.global_process_set)
