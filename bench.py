@@ -109,6 +109,13 @@ REQUIRED_METRIC_KEYS = (
     "hvtpu_wire_bytes_total",
     "hvtpu_controller_cycles_total",
     "hvtpu_controller_cycle_seconds",
+    # integrity layer (PR 4): cross-rank mismatch diagnostics, the
+    # coordinated non-finite guard, and the divergence audit — all 0
+    # on a healthy run, which is exactly what the trajectory proves.
+    "hvtpu_controller_mismatch_errors_total",
+    "hvtpu_optimizer_nonfinite_skips_total",
+    "hvtpu_audit_runs_total",
+    "hvtpu_audit_divergences_total",
 )
 
 

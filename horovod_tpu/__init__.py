@@ -63,6 +63,8 @@ from .core import (
     HorovodInternalError,
     HorovodTpuError,
     HostsUpdatedInterrupt,
+    HvtpuDivergenceError,
+    HvtpuMismatchError,
     ProcessSet,
     add_process_set,
     remove_process_set,
@@ -545,7 +547,7 @@ __all__ = [
     "Product",
     "ProcessSet", "add_process_set", "remove_process_set",
     "Config", "HorovodTpuError", "HorovodInternalError",
-    "HostsUpdatedInterrupt",
+    "HostsUpdatedInterrupt", "HvtpuMismatchError", "HvtpuDivergenceError",
     "spmd", "comm", "core",
     "mpi_enabled", "mpi_built", "mpi_threads_supported", "gloo_enabled",
     "gloo_built", "nccl_built", "ddl_built", "ccl_built", "cuda_built",

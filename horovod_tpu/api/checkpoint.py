@@ -125,16 +125,34 @@ class Checkpointer:
                 if self.use_orbax:
                     self._ocp.save(target, payload, force=True)
                     self._ocp.wait_until_finished()
-                else:  # pragma: no cover - fallback
+                else:
                     import shutil
 
+                    # Stage into a FRESH .tmp: a leftover from a killed
+                    # worker would otherwise leak its stale files into
+                    # the final checkpoint (os.replace moves the whole
+                    # directory, garbage included).
                     tmp = target + ".tmp"
-                    os.makedirs(tmp, exist_ok=True)
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    os.makedirs(tmp)
                     with open(os.path.join(tmp, "state.pkl"), "wb") as f:
                         pickle.dump(payload, f)
-                    # match orbax force=True overwrite semantics
-                    shutil.rmtree(target, ignore_errors=True)
-                    os.replace(tmp, target)
+                    # Overwrite semantics (orbax force=True parity)
+                    # WITHOUT the lose-both window: os.replace of a
+                    # directory onto an existing non-empty one raises
+                    # ENOTEMPTY, and rmtree-then-replace leaves NO
+                    # checkpoint if the process dies in between.
+                    # Rotate the old step aside, promote the staged
+                    # one, then drop the rotated copy — a crash at any
+                    # point leaves a loadable step_N or step_N.old.
+                    if os.path.exists(target):
+                        old = target + ".old"
+                        shutil.rmtree(old, ignore_errors=True)
+                        os.replace(target, old)
+                        os.replace(tmp, target)
+                        shutil.rmtree(old, ignore_errors=True)
+                    else:
+                        os.replace(tmp, target)
                 self._gc()
             except BaseException as e:  # surfaced at wait()/next save
                 self._error = e
@@ -185,6 +203,11 @@ class Checkpointer:
             if template is not None:
                 return self._ocp.restore(target, template)
             return self._ocp.restore(target)
+        if not os.path.isdir(target) and os.path.isdir(target + ".old"):
+            # a save died between rotating the old step aside and
+            # promoting the staged one — the rotated copy is the last
+            # durable state; put it back
+            os.replace(target + ".old", target)
         with open(os.path.join(target, "state.pkl"), "rb") as f:
             return pickle.load(f)
 

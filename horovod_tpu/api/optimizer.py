@@ -22,6 +22,7 @@ outside it falls back to the eager process-level data plane.
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -33,7 +34,65 @@ from ..comm.compression import NoneCompressor
 from ..comm.fusion import fused_tree_allreduce, plan_buckets
 from ..comm.reduce_ops import ReduceOp, normalize_op
 from ..core import state as core_state
+from ..core.exceptions import HorovodInternalError
 from ..obs import metrics as obs_metrics
+
+_M_NONFINITE = obs_metrics.counter(
+    "hvtpu_optimizer_nonfinite_skips_total",
+    "Optimizer updates guarded because the REDUCED gradients carried "
+    "non-finite values (coordinated across ranks: every rank sees the "
+    "same reduced tensors, so every rank skips/zeros/aborts together).")
+
+
+def _nonfinite_action() -> str:
+    """``HVTPU_NONFINITE_ACTION``: what every rank does, together, when
+    the reduced gradients carry NaN/inf — skip (default) | zero |
+    abort | off.
+
+    The decision is *piggybacked on the gradient allreduce*: IEEE
+    non-finites propagate through sum/average reduction, so checking
+    the REDUCED gradients is a coordinated test — all ranks see the
+    identical reduced tensors and reach the identical verdict with no
+    extra collective.  This is what prevents the classic desync where
+    one rank's local overflow makes it skip a step its peers apply."""
+    v = os.environ.get("HVTPU_NONFINITE_ACTION", "skip").strip().lower()
+    if v in ("", "skip"):
+        return "skip"
+    if v in ("off", "none", "disable", "disabled"):
+        return "off"
+    if v in ("zero", "abort"):
+        return v
+    raise ValueError(
+        "HVTPU_NONFINITE_ACTION must be one of skip|zero|abort|off, "
+        f"got {v!r}")
+
+
+def _tree_finite(tree):
+    """Scalar all-leaves-finite flag (traced-safe; integer leaves are
+    finite by construction and skipped)."""
+    flags = [
+        jnp.all(jnp.isfinite(leaf))
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact)
+    ]
+    if not flags:
+        return jnp.asarray(True)
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_and(out, f)
+    return out
+
+
+def _zero_nonfinite(tree):
+    """Replace non-finite elements with zeros (float leaves only)."""
+    return jax.tree_util.tree_map(
+        lambda leaf: (
+            jnp.where(jnp.isfinite(leaf), leaf, jnp.zeros_like(leaf))
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact)
+            else leaf
+        ),
+        tree,
+    )
 
 
 def allreduce_gradients(
@@ -310,6 +369,49 @@ def DistributedOptimizer(
             process_set=process_set,
         )
 
+    nonfinite = _nonfinite_action()
+
+    def guarded_update(reduced, inner_state, params, extra):
+        """Run the wrapped optimizer under the coordinated non-finite
+        guard: the verdict is computed on the REDUCED gradients (the
+        allreduce already propagated any rank's NaN/inf to every
+        rank), so all ranks skip/zero/abort the step together."""
+        if nonfinite == "off":
+            return optimizer.update(reduced, inner_state, params, **extra)
+        if axis_name is None:
+            # Eager path: concrete arrays, Python control flow.
+            if not bool(_tree_finite(reduced)):
+                _M_NONFINITE.inc()
+                if nonfinite == "abort":
+                    raise HorovodInternalError(
+                        "non-finite reduced gradients; aborting the "
+                        "step on every rank "
+                        "(HVTPU_NONFINITE_ACTION=abort)")
+                if nonfinite == "skip":
+                    return (
+                        jax.tree_util.tree_map(jnp.zeros_like, reduced),
+                        inner_state,
+                    )
+                reduced = _zero_nonfinite(reduced)
+            return optimizer.update(reduced, inner_state, params, **extra)
+        # In-jit the flag is traced: skip rides lax.cond.  abort cannot
+        # raise from compiled code and degrades to a coordinated skip,
+        # and the counter only advances on the eager path — both
+        # documented in docs/robustness.md.
+        if nonfinite == "zero":
+            return optimizer.update(
+                _zero_nonfinite(reduced), inner_state, params, **extra)
+        finite = _tree_finite(reduced)
+
+        def _apply(_):
+            return optimizer.update(reduced, inner_state, params, **extra)
+
+        def _skip(_):
+            return (jax.tree_util.tree_map(jnp.zeros_like, reduced),
+                    inner_state)
+
+        return jax.lax.cond(finite, _apply, _skip, None)
+
     if backward_passes_per_step == 1:
 
         def init_fn(params):
@@ -317,7 +419,7 @@ def DistributedOptimizer(
 
         def update_fn(grads, state, params=None, **extra):
             reduced = reduce_tree(grads)
-            return optimizer.update(reduced, state, params, **extra)
+            return guarded_update(reduced, state, params, extra)
 
         return optax.GradientTransformation(init_fn, update_fn)
 
@@ -339,7 +441,10 @@ def DistributedOptimizer(
             if average_aggregated_gradients:
                 g = jax.tree_util.tree_map(lambda t: t / n_acc, g)
             reduced = reduce_tree(g)
-            upd, inner = optimizer.update(reduced, state.inner, params, **extra)
+            # Guarded: a skipped boundary still clears the accumulator
+            # (the poisoned aggregation is discarded identically on
+            # every rank; the inner state stays untouched).
+            upd, inner = guarded_update(reduced, state.inner, params, extra)
             zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc)
             return upd, _DistOptState(inner, zeroed, jnp.zeros((), jnp.int32))
 

@@ -74,13 +74,17 @@ def _record_collective(kind: str, x, p: int, compression=None,
     Also the ``collective.pre`` fault-injection site (core/faults.py):
     every eager collective passes here before dispatch, so an armed
     clause can delay/error/kill a rank right at the dispatch boundary
-    — the divergence class the stall watchdog exists to catch.  The
-    empty-spec cost is one module-attribute read."""
+    — the divergence class the stall watchdog exists to catch — or
+    ``corrupt`` this rank's INPUT tensor (NaN-poison rides the wire to
+    every peer, exercising the optimizer's coordinated non-finite
+    guard).  Returns the (possibly poisoned) tensor.  The empty-spec
+    cost is one module-attribute read."""
     if faults.ACTIVE:
-        faults.inject("collective.pre", pset=pset, detail=kind)
+        x = faults.inject_tensor("collective.pre", x, pset=pset,
+                                 detail=kind)
     obs_metrics.op_counter(kind).inc()
     if p <= 1:
-        return
+        return x
     nbytes = int(x.nbytes)
     obs_metrics.TENSOR_BYTES.inc(nbytes)
     wire_nbytes = nbytes
@@ -96,6 +100,18 @@ def _record_collective(kind: str, x, p: int, compression=None,
         except Exception:
             pass
     obs_metrics.WIRE_BYTES.inc(wire_nbytes)
+    return x
+
+
+def _post_collective(kind: str, out, pset=None):
+    """The ``collective.post`` fault-injection site: fires after the
+    collective completed, so a ``corrupt`` clause poisons THIS RANK'S
+    RESULT only — manufacturing exactly the silent cross-rank
+    divergence the parameter audit (core/audit.py) exists to catch."""
+    if faults.ACTIVE:
+        return faults.inject_tensor("collective.post", out, pset=pset,
+                                    detail=kind)
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -709,8 +725,8 @@ def allreduce(
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
     p = mesh.devices.size
-    _record_collective("allreduce", x, p, compression,
-                       pset=ps.process_set_id)
+    x = _record_collective("allreduce", x, p, compression,
+                           pset=ps.process_set_id)
     t_dispatch = time.monotonic()
 
     timeline = st.timeline
@@ -771,7 +787,8 @@ def allreduce(
             jax.block_until_ready(out)
         obs_metrics.ALLREDUCE_LATENCY.observe(
             time.monotonic() - t_dispatch)
-        return out
+        return _post_collective("allreduce", out,
+                                pset=ps.process_set_id)
     finally:
         if timeline is not None:
             timeline.end(tname)
@@ -839,7 +856,7 @@ def allgather(tensor, *, process_set=None, name: Optional[str] = None):
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
     p = mesh.devices.size
-    _record_collective("allgather", x, p, pset=ps.process_set_id)
+    x = _record_collective("allgather", x, p, pset=ps.process_set_id)
     if p == 1:
         # gather over one participant is identity — but callers are
         # promised a NEW tensor (frontend DLPack round-trips would
@@ -878,7 +895,9 @@ def allgather(tensor, *, process_set=None, name: Optional[str] = None):
     else:
         parts = [gathered[r, : int(sizes[r])] for r in range(p)]
         out = jnp.concatenate(parts, axis=0)
-    return stall.finish(st, ps, out, sdesc)
+    return _post_collective("allgather",
+                            stall.finish(st, ps, out, sdesc),
+                            pset=ps.process_set_id)
 
 
 def broadcast(tensor, *, root_rank: int = 0, process_set=None,
@@ -886,8 +905,8 @@ def broadcast(tensor, *, root_rank: int = 0, process_set=None,
     st, ps = _resolve_process_set(process_set)
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
-    _record_collective("broadcast", x, mesh.devices.size,
-                       pset=ps.process_set_id)
+    x = _record_collective("broadcast", x, mesh.devices.size,
+                           pset=ps.process_set_id)
     if mesh.devices.size == 1:
         return jnp.copy(x)  # new-tensor contract (see allgather)
     # root_rank is a *global* rank (reference semantics); translate to
@@ -909,13 +928,17 @@ def broadcast(tensor, *, root_rank: int = 0, process_set=None,
         out = _fetch(stall.dispatch(
             st, ps, _jitted("broadcast_multidev", md, (root_in_set,)),
             (stacked,), desc=sdesc))
-        return stall.finish(st, ps, out[:flat_size].reshape(x.shape),
-                            sdesc)
+        return _post_collective(
+            "broadcast",
+            stall.finish(st, ps, out[:flat_size].reshape(x.shape), sdesc),
+            pset=ps.process_set_id)
     stacked = _stack_global(x, mesh)
     out = stall.dispatch(
         st, ps, _jitted("broadcast", mesh, (root_in_set,)), (stacked,),
         desc=sdesc)
-    return stall.finish(st, ps, _fetch(out), sdesc)
+    return _post_collective("broadcast",
+                            stall.finish(st, ps, _fetch(out), sdesc),
+                            pset=ps.process_set_id)
 
 
 def alltoall(tensor, splits=None, *, process_set=None,
@@ -935,7 +958,7 @@ def alltoall(tensor, splits=None, *, process_set=None,
     x = jnp.asarray(tensor)
     mesh = ps.proc_mesh()
     p = mesh.devices.size
-    _record_collective("alltoall", x, p, pset=ps.process_set_id)
+    x = _record_collective("alltoall", x, p, pset=ps.process_set_id)
     return_splits = splits is not None
     if splits is None:
         if x.shape[0] % p:
@@ -1003,7 +1026,7 @@ def reducescatter(tensor, *, op=None, process_set=None,
     st, ps = _resolve_process_set(process_set)
     x = jnp.asarray(tensor)
     p = ps.size
-    _record_collective("reducescatter", x, p, pset=ps.process_set_id)
+    x = _record_collective("reducescatter", x, p, pset=ps.process_set_id)
     if p == 1:
         return jnp.copy(x)  # new-tensor contract (see allgather)
     tname = name or f"reducescatter.{x.shape}.{x.dtype}"
@@ -1025,14 +1048,20 @@ def reducescatter(tensor, *, op=None, process_set=None,
             out = _fetch(stall.dispatch(
                 st, ps, _jitted("reducescatter_multidev", md, (rop,)),
                 (stacked,), desc=sdesc))
-            return stall.finish(
-                st, ps, out[0][:inner].reshape((q,) + x.shape[1:]), sdesc)
+            return _post_collective(
+                "reducescatter",
+                stall.finish(
+                    st, ps, out[0][:inner].reshape((q,) + x.shape[1:]),
+                    sdesc),
+                pset=ps.process_set_id)
         mesh = ps.proc_mesh()
         stacked = _stack_global(x, mesh)
         out = _fetch(stall.dispatch(
             st, ps, _jitted("reducescatter", mesh, (rop,)), (stacked,),
             desc=sdesc))[0]
-        return stall.finish(st, ps, out, sdesc)
+        return _post_collective("reducescatter",
+                                stall.finish(st, ps, out, sdesc),
+                                pset=ps.process_set_id)
     reduced = allreduce(x, op=rop, process_set=ps)
     r = ps.rank_in_set(st.rank)
     base, extra = divmod(x.shape[0], p)

@@ -3,6 +3,8 @@ from .exceptions import (
     HorovodInternalError,
     HorovodTpuError,
     HostsUpdatedInterrupt,
+    HvtpuDivergenceError,
+    HvtpuMismatchError,
     NotInitializedError,
     StallError,
 )
@@ -24,6 +26,8 @@ __all__ = [
     "HorovodInternalError",
     "HorovodTpuError",
     "HostsUpdatedInterrupt",
+    "HvtpuDivergenceError",
+    "HvtpuMismatchError",
     "NotInitializedError",
     "StallError",
     "ProcessSet",

@@ -18,6 +18,29 @@ class HorovodInternalError(HorovodTpuError):
     """
 
 
+class HvtpuMismatchError(HorovodInternalError):
+    """Ranks submitted conflicting metadata for the same tensor name.
+
+    The coordinator detected that member ranks announced different
+    (op type, reduction op, dtype, shape, root rank) for one tensor
+    name — the cross-rank disagreement class that silently mis-fuses
+    or hangs a collective stack.  The error text names each offending
+    rank and what it submitted; every member rank raises it instead
+    of stalling (parity: the reference controller's "Mismatched ..."
+    error responses).
+    """
+
+
+class HvtpuDivergenceError(HorovodInternalError):
+    """The parameter divergence audit found replicas that differ.
+
+    Raised by ``core/audit.py`` under ``HVTPU_AUDIT_ACTION=abort``.
+    Subclasses :class:`HorovodInternalError` so an elastic training
+    loop rolls back to the last commit and the driver relaunches the
+    world from verified-identical state.
+    """
+
+
 class HostsUpdatedInterrupt(HorovodTpuError):
     """The set of participating hosts/slices changed (elastic membership).
 

@@ -13,8 +13,10 @@ Grammar (full reference in docs/robustness.md)::
 
     SPEC   := CLAUSE (";" CLAUSE)*
     CLAUSE := SITE ":" ACTION ("@" SEL ("," SEL)*)?
-    SITE   := kv.get | kv.put | heartbeat | collective.pre | worker.step
+    SITE   := kv.get | kv.put | heartbeat | collective.pre
+            | collective.post | worker.step
     ACTION := drop | delay(MS) | error | kill
+            | corrupt | corrupt(nan) | corrupt(bitflip)
     SEL    := rank=R[|R...] | pset=ID | count=N | prob=P | times=K
 
 Examples::
@@ -58,9 +60,13 @@ logger = logging.getLogger("horovod_tpu")
 
 #: Sites the framework threads the harness through.  ``inject`` rejects
 #: unknown sites at parse time so a typo'd spec fails loudly at init.
-SITES = ("kv.get", "kv.put", "heartbeat", "collective.pre", "worker.step")
+#: ``collective.pre``/``collective.post`` are TENSOR sites: ``corrupt``
+#: clauses there poison the collective's input/result on the selected
+#: ranks (exercising the non-finite guard and the divergence audit).
+SITES = ("kv.get", "kv.put", "heartbeat", "collective.pre",
+         "collective.post", "worker.step")
 
-ACTIONS = ("drop", "delay", "error", "kill")
+ACTIONS = ("drop", "delay", "error", "kill", "corrupt")
 
 #: Module-level fast path: False means ``inject`` is never entered.
 ACTIVE = False
@@ -91,22 +97,24 @@ class InjectedFault(RuntimeError):
 
 
 _DELAY_RE = re.compile(r"^delay\((\d+(?:\.\d+)?)\)$")
+_CORRUPT_RE = re.compile(r"^corrupt(?:\((nan|bitflip)\))?$")
 
 
 class FaultClause:
     """One parsed ``site:action[@selectors]`` clause."""
 
-    __slots__ = ("site", "action", "delay_ms", "ranks", "pset", "count",
-                 "prob", "times", "index", "source", "_fired", "_seen",
-                 "_rng")
+    __slots__ = ("site", "action", "delay_ms", "corrupt_mode", "ranks",
+                 "pset", "count", "prob", "times", "index", "source",
+                 "_fired", "_seen", "_rng")
 
     def __init__(self, site: str, action: str, delay_ms: float,
                  ranks: Optional[frozenset], pset: Optional[int],
                  count: int, prob: Optional[float], times: int,
-                 index: int, source: str):
+                 index: int, source: str, corrupt_mode: str = "nan"):
         self.site = site
         self.action = action
         self.delay_ms = delay_ms
+        self.corrupt_mode = corrupt_mode
         self.ranks = ranks          # None = all ranks
         self.pset = pset            # None = any process set
         self.count = count          # fire from the count-th match (1-based)
@@ -167,15 +175,20 @@ def parse_spec(spec: str) -> List[FaultClause]:
         action_s, _, sel_s = rest.partition("@")
         action_s = action_s.strip()
         delay_ms = 0.0
+        corrupt_mode = "nan"
         m = _DELAY_RE.match(action_s)
+        mc = _CORRUPT_RE.match(action_s)
         if m:
             action, delay_ms = "delay", float(m.group(1))
+        elif mc:
+            action, corrupt_mode = "corrupt", mc.group(1) or "nan"
         elif action_s in ("drop", "error", "kill"):
             action = action_s
         else:
             raise FaultSpecError(
                 f"fault clause {raw!r}: unknown action {action_s!r} "
-                f"(known: drop, delay(MS), error, kill)")
+                "(known: drop, delay(MS), error, kill, "
+                "corrupt[(nan|bitflip)])")
         ranks = pset = prob = None
         count = 1
         times = 1 if action == "kill" else 0
@@ -214,7 +227,7 @@ def parse_spec(spec: str) -> List[FaultClause]:
                     f"{sel!r}") from None
         clauses.append(FaultClause(
             site, action, delay_ms, ranks, pset, count, prob, times,
-            index=len(clauses), source=raw))
+            index=len(clauses), source=raw, corrupt_mode=corrupt_mode))
     return clauses
 
 
@@ -269,16 +282,24 @@ class FaultRegistry:
                            "count to %s", path, exc_info=True)
 
     # -- the injection point -------------------------------------------
-    def inject(self, site: str, pset=None, detail: Optional[str] = None
-               ) -> bool:
-        fired: Optional[FaultClause] = None
+    def _select(self, site: str, pset, tensor_site: bool
+                ) -> Optional[FaultClause]:
+        """First firing clause for ``site``.  ``corrupt`` clauses only
+        fire at tensor sites (``inject_tensor``) — plain ``inject``
+        call sites carry no data to poison, and silently consuming the
+        firing there would make the clause look like a no-op."""
         with self._lock:
             for clause in self._by_site.get(site, ()):
+                if clause.action == "corrupt" and not tensor_site:
+                    continue
                 if clause.matches(self.rank, pset) and clause.should_fire():
-                    fired = clause
-                    break
-        if fired is None:
-            return False
+                    return clause
+        return None
+
+    def _execute(self, fired: FaultClause, site: str,
+                 detail: Optional[str]) -> bool:
+        """Run a fired clause's non-tensor action; returns True for
+        ``drop`` (caller suppresses the operation)."""
         # Persist BEFORE executing: a kill must be counted by the next
         # incarnation even though this process never returns.
         self._persist_fired(fired)
@@ -302,6 +323,57 @@ class FaultRegistry:
               f"([{fired.source}] at {site})", file=sys.stderr, flush=True)
         sys.stdout.flush()
         os._exit(1)
+
+    def inject(self, site: str, pset=None, detail: Optional[str] = None
+               ) -> bool:
+        fired = self._select(site, pset, tensor_site=False)
+        if fired is None:
+            return False
+        return self._execute(fired, site, detail)
+
+    def inject_tensor(self, site: str, tensor, pset=None,
+                      detail: Optional[str] = None):
+        """Tensor-site injection point: like :meth:`inject`, but the
+        operation carries data, so ``corrupt`` clauses can poison it
+        (NaN in element 0, or a flipped sign bit for ``bitflip``/
+        non-float dtypes).  Returns the (possibly poisoned) tensor;
+        ``drop`` is a no-op here — a collective cannot be suppressed
+        without desyncing its peers."""
+        fired = self._select(site, pset, tensor_site=True)
+        if fired is None:
+            return tensor
+        if fired.action != "corrupt":
+            self._execute(fired, site, detail)
+            return tensor
+        self._persist_fired(fired)
+        logger.warning(
+            "hvtpu fault injection: corrupting (%s) [%s] at site %s "
+            "(rank %d%s)", fired.corrupt_mode, fired.source, site,
+            self.rank, f", op {detail}" if detail else "")
+        return _poison(tensor, fired.corrupt_mode)
+
+
+def _poison(tensor, mode: str):
+    """Poison one element of ``tensor``: NaN for float dtypes in
+    ``nan`` mode, a flipped top bit of byte 0 otherwise.  Host
+    round-trip is fine — injection is never a hot path."""
+    import numpy as np
+
+    x = np.array(tensor)  # contiguous host copy
+    if x.size == 0:
+        return tensor
+    flat = x.reshape(-1)
+    if mode == "nan" and np.issubdtype(x.dtype, np.floating):
+        flat[0] = np.nan
+    else:
+        raw = flat.view(np.uint8)
+        raw[x.dtype.itemsize - 1] ^= 0x80
+    try:
+        import jax.numpy as jnp
+
+        return jnp.asarray(x)
+    except ImportError:  # pragma: no cover - jax is baked in
+        return x
 
 
 def install(spec: str, rank: int = 0, seed: int = 0,
@@ -350,3 +422,15 @@ def inject(site: str, pset=None, detail: Optional[str] = None) -> bool:
     if reg is None:
         return False
     return reg.inject(site, pset=pset, detail=detail)
+
+
+def inject_tensor(site: str, tensor, pset=None,
+                  detail: Optional[str] = None):
+    """Tensor-site variant of :func:`inject`: returns the (possibly
+    ``corrupt``-poisoned) tensor; other actions behave as in
+    :func:`inject` except ``drop``, which is a no-op at tensor sites.
+    Hot paths guard on ``faults.ACTIVE`` before calling."""
+    reg = _registry
+    if reg is None:
+        return tensor
+    return reg.inject_tensor(site, tensor, pset=pset, detail=detail)

@@ -46,7 +46,7 @@ from ..comm.packing import pack_flat, unpack_flat
 from ..comm.reduce_ops import ReduceOp
 from ..core import faults
 from ..core import retry as core_retry
-from ..core.exceptions import HorovodInternalError
+from ..core.exceptions import HorovodInternalError, HvtpuMismatchError
 from ..obs import metrics as obs_metrics
 
 logger = logging.getLogger("horovod_tpu.eager")
@@ -84,6 +84,16 @@ _M_PREDICTED = obs_metrics.counter(
     "Steady-state bypass cycles whose agreed schedule was predicted "
     "locally from the replicated response cache and executed without "
     "waiting for the coordinator round trip.")
+_M_MISMATCH = obs_metrics.counter(
+    "hvtpu_controller_mismatch_errors_total",
+    "Error responses for cross-rank tensor-metadata disagreement "
+    "(mismatched type/red_op/dtype/shape/root for one tensor name), "
+    "surfaced as HvtpuMismatchError on every member rank.")
+
+#: Error-text marker the controllers (C++ and Python twin, byte-
+#: identical) emit for cross-rank metadata disagreement; used to raise
+#: the typed error instead of the generic internal one.
+_MISMATCH_MARKER = "cross-rank tensor mismatch"
 
 _RED_TO_WIRE = {
     ReduceOp.SUM: wire.RED_SUM,
@@ -1508,11 +1518,23 @@ class EagerController:
         """Fail futures for an ERROR response.  Only payloads this rank
         actually has are failed — error responses (e.g. 'rank N has
         shut down') legitimately reach member ranks that never enqueued
-        the tensor, which must not be treated as protocol corruption."""
+        the tensor, which must not be treated as protocol corruption.
+
+        Cross-rank mismatch errors (the coordinator's named-rank
+        diagnostics) surface as the typed
+        :class:`~horovod_tpu.core.exceptions.HvtpuMismatchError` so
+        callers can distinguish "my ranks disagree" (a program bug to
+        fix) from transient internal failures an elastic loop should
+        absorb-and-restart."""
+        err_cls = HorovodInternalError
+        if rs.error.startswith(_MISMATCH_MARKER):
+            err_cls = HvtpuMismatchError
+            _M_MISMATCH.inc()
+            logger.error("coordinator mismatch diagnostics: %s", rs.error)
         for p in self._take_payloads(rs, strict=False):
             if self._timeline is not None:
                 self._timeline.end(p.name)
-            p.future.set_error(HorovodInternalError(rs.error))
+            p.future.set_error(err_cls(rs.error))
 
     def _execute(self, rl: wire.ResponseList, finished: List[int]):
         for rs in rl.responses:

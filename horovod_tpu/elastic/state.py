@@ -113,6 +113,17 @@ class State:
             self.save()
         else:
             self.save_to_memory()
+        # Periodic cross-rank divergence audit (core/audit.py): the
+        # commit boundary is the one point every rank reaches in
+        # lockstep (the elastic contract), so the audit's collective
+        # digest exchange is safe here.  The commit count advances
+        # identically on every rank — a wall-clock cadence would
+        # desync the exchange.  Off unless HVTPU_AUDIT_EVERY > 0.
+        from ..core import audit as core_audit
+
+        n = core_audit.audit_every()
+        if n > 0 and self._commit_count % n == 0:
+            self.audit("elastic.commit")
         self.check_host_updates()
 
     def check_host_updates(self):
@@ -147,6 +158,17 @@ class State:
         must come first (it restores the committed payload the
         callbacks read), so the divergence window is closed by a
         second, broadcast-only pass.  Base State tracks nothing."""
+
+    def audit(self, label: str = "elastic.state") -> Optional[dict]:
+        """Verify this state is identical on every rank via the
+        parameter divergence audit (core/audit.py).  Gated on
+        ``HVTPU_AUDIT_EVERY`` > 0: the elastic run wrapper calls this
+        after every sync/rebroadcast so each incarnation STARTS from
+        verified-identical state; on divergence the configured
+        ``HVTPU_AUDIT_ACTION`` applies (abort raises
+        ``HvtpuDivergenceError`` → restore + driver relaunch from the
+        last commit).  Base State tracks nothing → no-op."""
+        return None
 
 
 class _HostUpdateFlag:
@@ -242,6 +264,17 @@ class ObjectState(State):
         )
         self._apply(payload)
         self.save_to_memory()
+
+    def audit(self, label: str = "elastic.state") -> Optional[dict]:
+        """Cross-rank digest audit of the tracked attributes (see
+        State.audit); collective when it runs, so the gating env var
+        must agree on every rank (the launcher distributes it)."""
+        from ..core import audit as core_audit
+
+        if core_audit.audit_every() <= 0:
+            return None
+        return core_audit.verify(
+            {k: getattr(self, k) for k in self._tracked}, label)
 
     # -- disk representation hooks (subclasses with non-picklable
     #    payloads override these) --
@@ -436,3 +469,16 @@ class ShardedJaxState(JaxState):
         payload = api_functions.broadcast_object(rest, root_rank=0)
         self._apply(payload)
         self.save_to_memory()
+
+    def audit(self, label: str = "elastic.state") -> Optional[dict]:
+        """Audit the REPLICATED half only: global arrays are sharded —
+        each rank legitimately holds a different piece (and pulling a
+        non-addressable array to host raises), so cross-rank digests of
+        shards would be a false divergence.  Plain attributes must
+        still agree everywhere."""
+        from ..core import audit as core_audit
+
+        if core_audit.audit_every() <= 0:
+            return None
+        _, rest = self._split(self._capture())
+        return core_audit.verify(rest, label)

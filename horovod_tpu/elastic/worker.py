@@ -129,6 +129,13 @@ def run(func):
                 # resumes — the reference achieves the same by running
                 # callbacks before its sync.
                 state.rebroadcast()
+            # Verified-identical incarnation start: with the parameter
+            # divergence audit enabled (HVTPU_AUDIT_EVERY > 0), prove
+            # every rank resumed from the same bytes BEFORE training
+            # touches them — a divergence here aborts into the
+            # restore/relaunch path below instead of training on
+            # silently split replicas (core/audit.py).
+            state.audit("elastic.sync")
             return func(state, *args, **kwargs)
         except HorovodInternalError:
             # Peer loss mid-collective: roll back so the durable commit

@@ -159,7 +159,7 @@ def load() -> Optional[ctypes.CDLL]:
         _lib = _configure(ctypes.CDLL(path))
     except (OSError, AttributeError):
         return None
-    if _lib.hvt_abi_version() != 3:
+    if _lib.hvt_abi_version() != 4:
         _lib = None
     return _lib
 

@@ -233,6 +233,50 @@ class PyController:
         sorted() on these strings == std::map byte order."""
         return f"{e.process_set_id}\x01{e.name}"
 
+    @staticmethod
+    def _same_params(a: wire.Entry, b: wire.Entry) -> bool:
+        """The cross-rank agreement surface: every member rank must
+        submit identical (type, red_op, dtype, shape, root) or the
+        collective would mis-fuse / corrupt data.  Exclusions, which
+        must match Controller::SameParams exactly: group_id (rank-local
+        bookkeeping; ranks may number groups differently) and DIM 0
+        for allgather/alltoall (ragged gathers and variable splits are
+        legitimately per-rank; trailing dims and rank-count must still
+        agree — reference parity: controller.cc only checks
+        non-first dimensions for allgather)."""
+        if (a.type != b.type or a.red_op != b.red_op
+                or a.dtype != b.dtype or a.root_rank != b.root_rank):
+            return False
+        sa, sb = tuple(a.shape), tuple(b.shape)
+        if a.type in (wire.ALLGATHER, wire.ALLTOALL):
+            return len(sa) == len(sb) and sa[1:] == sb[1:]
+        return sa == sb
+
+    @staticmethod
+    def _entry_desc(e: wire.Entry) -> str:
+        """Human-readable submission summary for mismatch diagnostics;
+        must match Controller::EntryDesc byte-for-byte."""
+        dims = ",".join(str(int(d)) for d in e.shape)
+        return (f"op={e.type} red_op={e.red_op} dtype={e.dtype} "
+                f"shape=[{dims}] root_rank={e.root_rank}")
+
+    def _table_add(self, e: wire.Entry, rank: int, now: float):
+        """Record one rank's announcement in the message table,
+        tracking conflicting submissions per rank (must match
+        Controller::TableAdd)."""
+        key = self._table_key(e)
+        pc = self._message_table.get(key)
+        if pc is None:
+            self._message_table[key] = {
+                "entry": e, "ranks": {rank}, "first_seen": now,
+                "first_rank": rank, "mismatch": {},
+            }
+            return
+        pc["ranks"].add(rank)
+        if (rank != pc["first_rank"] and rank not in pc["mismatch"]
+                and not self._same_params(e, pc["entry"])):
+            pc["mismatch"][rank] = e
+
     def ingest(self, blob: bytes):
         rl = wire.parse_request_list(blob)
         now = time.monotonic()
@@ -254,15 +298,7 @@ class PyController:
                         self._resync_needed = True
                         continue
                     e = wire.Entry(**{**cached.__dict__, "seq": 0})
-                    key = self._table_key(e)
-                    pc = self._message_table.get(key)
-                    if pc is None:
-                        self._message_table[key] = {
-                            "entry": e, "ranks": {rl.rank},
-                            "first_seen": now,
-                        }
-                    else:
-                        pc["ranks"].add(rl.rank)
+                    self._table_add(e, rl.rank, now)
                 return
             for rq in rl.requests:
                 e = rq.entry
@@ -270,14 +306,7 @@ class PyController:
                     cached = self._cache.entry_for_bit(rq.cache_bit)
                     if cached is not None:
                         e = wire.Entry(**{**cached.__dict__, "seq": rq.entry.seq})
-                key = self._table_key(e)
-                pc = self._message_table.get(key)
-                if pc is None:
-                    self._message_table[key] = {
-                        "entry": e, "ranks": {rl.rank}, "first_seen": now,
-                    }
-                else:
-                    pc["ranks"].add(rl.rank)
+                self._table_add(e, rl.rank, now)
 
     def _required_ranks(self, psid: int) -> int:
         ranks = self._process_sets.get(psid)
@@ -328,6 +357,26 @@ class PyController:
                     tensor_names=[e.name], tensor_shapes=[tuple(e.shape)],
                     total_bytes=e.nbytes,
                 )
+                if pc["mismatch"]:
+                    # Cross-rank disagreement: fail LOUDLY on every
+                    # member rank, naming each offender and what it
+                    # submitted (parity: the reference controller's
+                    # "Mismatched ..." error responses; text must match
+                    # Controller::BuildResponseList byte-for-byte).
+                    # The error broadcast also forces a full cache
+                    # resync below, re-anchoring the bypass plane.
+                    parts = [f"rank {pc['first_rank']} submitted "
+                             f"{self._entry_desc(e)}"]
+                    for r in sorted(pc["mismatch"]):
+                        parts.append(
+                            f"rank {r} submitted "
+                            f"{self._entry_desc(pc['mismatch'][r])}")
+                    rs.error = (f"cross-rank tensor mismatch for "
+                                f"'{e.name}': " + "; ".join(parts))
+                    out.cache_resync_needed = True
+                    responses.append(rs)
+                    del self._message_table[key]
+                    continue
                 # Zero substitution from joined ranks is only sound for
                 # additive semantics (must match Controller's C++ texts
                 # byte-for-byte for the cross-check tests).

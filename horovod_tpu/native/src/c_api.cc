@@ -59,7 +59,9 @@ extern "C" {
 // ---- versioning ----------------------------------------------------------
 // v2: + hvt_gp_* (gaussian_process.cc)
 // v3: wire v3 cache_bits bypass frame + hvt_controller_set_resync_every
-int hvt_abi_version() { return 3; }
+// v4: cross-rank mismatch diagnostics (named-rank error responses +
+//     forced cache resync on disagreement)
+int hvt_abi_version() { return 4; }
 
 // ---- controller ----------------------------------------------------------
 void* hvt_controller_new(int rank, int size, int64_t fusion_threshold,

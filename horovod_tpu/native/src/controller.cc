@@ -258,6 +258,56 @@ std::vector<uint8_t> Controller::DrainRequests() {
   return SerializeRequestList(rl);
 }
 
+bool Controller::SameParams(const Entry& a, const Entry& b) {
+  if (a.type != b.type || a.red_op != b.red_op || a.dtype != b.dtype ||
+      a.root_rank != b.root_rank) {
+    return false;
+  }
+  if (a.type == OpType::kAllgather || a.type == OpType::kAlltoall) {
+    // Dim 0 is legitimately per-rank (ragged gathers, variable
+    // splits); rank-count and trailing dims must still agree.
+    if (a.shape.size() != b.shape.size()) return false;
+    for (size_t i = 1; i < a.shape.size(); ++i) {
+      if (a.shape[i] != b.shape[i]) return false;
+    }
+    return true;
+  }
+  return a.shape == b.shape;
+}
+
+std::string Controller::EntryDesc(const Entry& e) {
+  std::ostringstream ss;
+  ss << "op=" << int(e.type) << " red_op=" << int(e.red_op)
+     << " dtype=" << int(e.dtype) << " shape=[";
+  for (size_t i = 0; i < e.shape.size(); ++i) {
+    if (i) ss << ',';
+    ss << e.shape[i];
+  }
+  ss << "] root_rank=" << e.root_rank;
+  return ss.str();
+}
+
+void Controller::TableAdd(Entry e, int32_t rank, double now) {
+  std::string key = TableKey(e);
+  auto it = message_table_.find(key);
+  if (it == message_table_.end()) {
+    // Parity: MessageTable insertion on first Request for a name.
+    PendingCoordination pc;
+    pc.entry = std::move(e);
+    pc.first_seen_s = now;
+    pc.first_rank = rank;
+    pc.ranks.insert(rank);
+    message_table_.emplace(std::move(key), std::move(pc));
+    return;
+  }
+  PendingCoordination& pc = it->second;
+  pc.ranks.insert(rank);
+  if (rank != pc.first_rank && !pc.mismatched.count(rank) &&
+      !SameParams(e, pc.entry)) {
+    pc.mismatched.emplace(rank, std::move(e));
+  }
+}
+
 std::string Controller::TableKey(const Entry& e) {
   // Coordination is scoped per process set: the same tensor name may be
   // pending simultaneously in disjoint sets (parity: each ProcessSet in
@@ -290,17 +340,7 @@ void Controller::Ingest(const uint8_t* data, size_t len) {
         continue;
       }
       cached.seq = 0;
-      std::string key = TableKey(cached);
-      auto it = message_table_.find(key);
-      if (it == message_table_.end()) {
-        PendingCoordination pc;
-        pc.entry = std::move(cached);
-        pc.first_seen_s = now;
-        pc.ranks.insert(rl.rank);
-        message_table_.emplace(std::move(key), std::move(pc));
-      } else {
-        it->second.ranks.insert(rl.rank);
-      }
+      TableAdd(std::move(cached), rl.rank, now);
     }
     return;
   }
@@ -315,18 +355,7 @@ void Controller::Ingest(const uint8_t* data, size_t len) {
         e = cached;
       }
     }
-    std::string key = TableKey(e);
-    auto it = message_table_.find(key);
-    if (it == message_table_.end()) {
-      // Parity: MessageTable insertion on first Request for a name.
-      PendingCoordination pc;
-      pc.entry = e;
-      pc.first_seen_s = now;
-      pc.ranks.insert(rl.rank);
-      message_table_.emplace(std::move(key), std::move(pc));
-    } else {
-      it->second.ranks.insert(rl.rank);
-    }
+    TableAdd(std::move(e), rl.rank, now);
   }
 }
 
@@ -393,6 +422,25 @@ ResponseList Controller::BuildResponseList() {
     rs.tensor_names.push_back(e.name);
     rs.tensor_shapes.push_back(e.shape);
     rs.total_bytes = e.nbytes();
+    if (!pc.mismatched.empty()) {
+      // Cross-rank disagreement: fail LOUDLY on every member rank,
+      // naming each offender and what it submitted (text must match
+      // fallback.PyController byte-for-byte).  The error broadcast
+      // also forces a full cache resync, re-anchoring the bypass
+      // plane.
+      std::ostringstream ss;
+      ss << "cross-rank tensor mismatch for '" << e.name << "': rank "
+         << pc.first_rank << " submitted " << EntryDesc(e);
+      for (const auto& kv : pc.mismatched) {
+        ss << "; rank " << kv.first << " submitted "
+           << EntryDesc(kv.second);
+      }
+      rs.error = ss.str();
+      out.cache_resync_needed = true;
+      out.responses.push_back(std::move(rs));
+      message_table_.erase(n);
+      continue;
+    }
     // Zero substitution from joined ranks is only sound for additive
     // semantics; reject ops it would silently corrupt (min/max/product
     // zeroed, adasum NaN from zero norms, broadcast root with no data,

@@ -195,9 +195,23 @@ class Controller {
     Entry entry;                 // from the first rank that reported it
     std::set<int32_t> ranks;     // ranks that reported ready
     double first_seen_s = 0;
+    int32_t first_rank = -1;     // who contributed `entry`
+    // ranks whose submission disagreed with `entry` on the agreement
+    // surface (SameParams), with what they submitted — turned into a
+    // named-rank error response instead of a silent mis-fuse/stall.
+    std::map<int32_t, Entry> mismatched;
   };
 
   static std::string TableKey(const Entry& e);
+  // Cross-rank agreement surface; group_id and allgather/alltoall
+  // dim 0 deliberately excluded (rank-local bookkeeping / legitimate
+  // per-rank raggedness).  Must match fallback._same_params.
+  static bool SameParams(const Entry& a, const Entry& b);
+  // Submission summary for mismatch diagnostics; byte-identical to
+  // fallback._entry_desc.
+  static std::string EntryDesc(const Entry& e);
+  // Record one rank's announcement, tracking per-rank conflicts.
+  void TableAdd(Entry e, int32_t rank, double now);
   int32_t RequiredRanks(int32_t psid) const;
   std::vector<int32_t> ProcessSetRanks(int32_t psid) const;
   int32_t PresentCount(const PendingCoordination& pc) const;

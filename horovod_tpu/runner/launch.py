@@ -187,6 +187,23 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                    help="seed for prob= fault selectors "
                         "(HVTPU_FAULT_SEED; per-rank streams derive "
                         "from it, so a seed reproduces a schedule)")
+    # data-plane integrity (core/audit.py + api/optimizer.py;
+    # docs/robustness.md "Integrity")
+    p.add_argument("--audit-every", type=int, default=None,
+                   help="run the parameter divergence audit every N "
+                        "steps (0 = off; HVTPU_AUDIT_EVERY)")
+    p.add_argument("--audit-action", default=None,
+                   choices=["abort", "warn"],
+                   help="what to do when the audit finds divergent "
+                        "replicas (HVTPU_AUDIT_ACTION, default abort: "
+                        "elastic jobs roll back to the last commit "
+                        "and relaunch verified-identical)")
+    p.add_argument("--nonfinite-action", default=None,
+                   choices=["skip", "zero", "abort", "off"],
+                   help="coordinated optimizer action when the reduced "
+                        "gradients carry NaN/inf — every rank acts "
+                        "together (HVTPU_NONFINITE_ACTION, default "
+                        "skip)")
     # CPU-simulation mode (this sandbox / CI: N ranks on localhost CPU)
     p.add_argument("--cpu-devices", type=int, default=None,
                    help="force the CPU platform with this many XLA "
@@ -307,6 +324,10 @@ def build_worker_env(
             "HVTPU_CPU_DEVICES": args.cpu_devices,
             "HVTPU_FAULT_SPEC": getattr(args, "fault_spec", None),
             "HVTPU_FAULT_SEED": getattr(args, "fault_seed", None),
+            "HVTPU_AUDIT_EVERY": getattr(args, "audit_every", None),
+            "HVTPU_AUDIT_ACTION": getattr(args, "audit_action", None),
+            "HVTPU_NONFINITE_ACTION":
+                getattr(args, "nonfinite_action", None),
             "HVTPU_ELASTIC_TIMEOUT": args.elastic_timeout,
             "HVTPU_START_TIMEOUT": args.start_timeout,
             "HVTPU_AUTOTUNE_WARMUP_SAMPLES": args.autotune_warmup_samples,

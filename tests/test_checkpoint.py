@@ -58,6 +58,64 @@ class TestCheckpointer:
         out = hvt.restore_checkpoint(d)
         np.testing.assert_allclose(np.asarray(out["x"]), [1.0, 1.0])
 
+    def test_resave_same_step_overwrites(self, hvt, tmp_path):
+        """Re-saving to the SAME path must replace the old payload —
+        the os.replace-onto-non-empty-directory ENOTEMPTY regression
+        (both backends)."""
+        import jax.numpy as jnp
+
+        for orbax in (False, True):
+            ckpt = hvt.Checkpointer(str(tmp_path / f"ck{int(orbax)}"),
+                                    use_orbax=orbax)
+            ckpt.save(7, {"v": jnp.asarray(1.0)})
+            ckpt.wait()
+            ckpt.save(7, {"v": jnp.asarray(2.0)})
+            ckpt.wait()
+            assert ckpt.all_steps() == [7]
+            assert float(np.asarray(ckpt.restore(7)["v"])) == 2.0
+
+    def test_stale_tmp_from_killed_worker_is_cleaned(self, hvt,
+                                                     tmp_path):
+        """A .tmp leftover from a save killed mid-write must neither
+        fail the next save nor leak its stale files into the final
+        checkpoint directory."""
+        import os
+
+        import jax.numpy as jnp
+
+        d = tmp_path / "ck"
+        ckpt = hvt.Checkpointer(str(d), use_orbax=False)
+        stale = d / "step_000000000007.tmp"
+        stale.mkdir(parents=True)
+        (stale / "garbage.pkl").write_text("killed mid-write")
+        ckpt.save(7, {"v": jnp.asarray(3.0)})
+        ckpt.wait()
+        target = d / "step_000000000007"
+        assert sorted(os.listdir(target)) == ["state.pkl"]
+        assert float(np.asarray(ckpt.restore(7)["v"])) == 3.0
+        assert not stale.exists()
+
+    def test_kill_between_rotate_and_promote_recovers(self, hvt,
+                                                      tmp_path):
+        """A save killed after rotating the old step aside (step_N ->
+        step_N.old, before the staged promote) must not lose the last
+        durable payload: restore falls back to the rotated copy."""
+        import os
+
+        import jax.numpy as jnp
+
+        d = tmp_path / "ck"
+        ckpt = hvt.Checkpointer(str(d), use_orbax=False)
+        ckpt.save(7, {"v": jnp.asarray(1.0)})
+        ckpt.wait()
+        # simulate the crash window: old rotated aside, promote never
+        # happened
+        os.replace(str(d / "step_000000000007"),
+                   str(d / "step_000000000007.old"))
+        assert float(np.asarray(ckpt.restore(7)["v"])) == 1.0
+        # the recovery also put the directory back for listing
+        assert ckpt.all_steps() == [7]
+
     def test_async_save_overlaps(self, hvt, tmp_path):
         import jax.numpy as jnp
 

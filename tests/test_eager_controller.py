@@ -414,6 +414,7 @@ class TestMultiRankNegotiation:
         finally:
             stop_world(ctrls)
 
+    @pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
     def test_stall_abort_fails_futures(self, hvt):
         ctrls = make_world(2, stall_warn_s=0.0, stall_abort_s=0.3)
         try:

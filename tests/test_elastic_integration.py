@@ -196,6 +196,7 @@ def test_max_np_caps_growth(tmp_path):
     assert epochs_seen == sorted(epochs_seen), out[-3000:]
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_blacklist_after_three_strikes(tmp_path):
     """A host whose workers crash BLACKLIST_THRESHOLD times must be
     excluded from subsequent incarnations (parity: registration.py
@@ -256,6 +257,7 @@ def test_elastic_resize_with_sharded_global_arrays(tmp_path):
     assert epochs_seen == sorted(epochs_seen), out[-3000:]
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_functional_run_elastic_api(tmp_path):
     """The function-mode elastic API (parity: horovod.spark.run_elastic):
     fn rides the signed pickle channel, runs under the elastic driver,

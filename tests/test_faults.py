@@ -47,6 +47,17 @@ class TestParse:
         assert cs[3].action == "delay" and cs[3].delay_ms == 250.0
         assert cs[3].pset == 1
 
+    def test_corrupt_grammar(self):
+        cs = faults.parse_spec(
+            "collective.pre:corrupt@rank=1; "
+            "collective.post:corrupt(bitflip)@count=2; "
+            "collective.post:corrupt(nan)")
+        assert [c.action for c in cs] == ["corrupt"] * 3
+        assert cs[0].corrupt_mode == "nan"  # default
+        assert cs[1].corrupt_mode == "bitflip" and cs[1].count == 2
+        assert cs[2].corrupt_mode == "nan"
+        assert cs[1].site == "collective.post"
+
     def test_empty_spec_yields_nothing(self):
         assert faults.parse_spec("") == []
         assert faults.parse_spec(" ; ; ") == []
@@ -60,6 +71,7 @@ class TestParse:
         "kv.put:drop@prob=1.5",
         "kv.put:drop@count=0",
         "worker.step:delay(x)",
+        "collective.pre:corrupt(weird)",
     ])
     def test_malformed_specs_fail_loudly(self, bad):
         with pytest.raises(faults.FaultSpecError):
@@ -167,6 +179,41 @@ class TestInjectionSites:
         with pytest.raises(faults.InjectedFault):
             hvt.allreduce(jnp.ones(2))
 
+    def test_collective_pre_corrupt_poisons_input(self, hvt):
+        import jax.numpy as jnp
+        import numpy as np
+
+        faults.install("collective.pre:corrupt", rank=0)
+        out = hvt.allreduce(jnp.ones(4))
+        assert not np.isfinite(np.asarray(out)).all()
+
+    def test_collective_post_corrupt_poisons_result(self, hvt):
+        import jax.numpy as jnp
+        import numpy as np
+
+        faults.install("collective.post:corrupt", rank=0)
+        out = hvt.allreduce(jnp.ones(4))
+        assert not np.isfinite(np.asarray(out)).all()
+        faults.uninstall()
+        clean = hvt.allreduce(jnp.ones(4))
+        assert np.isfinite(np.asarray(clean)).all()
+
+    def test_corrupt_clause_never_fires_at_non_tensor_sites(self):
+        """A corrupt clause at a KV site has nothing to poison; plain
+        inject() must neither fire nor consume its budget."""
+        faults.install("kv.put:corrupt@times=1", rank=0)
+        assert faults.inject("kv.put") is False
+        assert faults.inject("kv.put") is False
+
+    def test_bitflip_corrupts_non_float_dtypes(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        faults.install("collective.post:corrupt(bitflip)", rank=0)
+        out = faults.inject_tensor(
+            "collective.post", jnp.zeros((3,), jnp.int32))
+        assert int(np.asarray(out)[0]) != 0
+
     def test_worker_step_site_fires_at_commit(self):
         import horovod_tpu.elastic as elastic
 
@@ -239,6 +286,7 @@ def test_injected_rank_kill_recovers_within_budget(tmp_path):
 
 
 @pytest.mark.multiprocess
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_injected_kill_with_zero_budget_fails_fast(tmp_path):
     """The same injected death with --max-restarts=0 must NOT relaunch:
     the driver exits non-zero with the restart-budget diagnostic."""

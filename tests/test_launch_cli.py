@@ -62,6 +62,7 @@ def test_cli_torch_mnist_2proc():
     assert "ranks consistent (2 ranks)" in res.stdout
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_cli_tf_keras_mnist_2proc():
     res = _hvtpurun([
         "-np", "2", "--cpu-devices", "1", "--",
@@ -73,6 +74,7 @@ def test_cli_tf_keras_mnist_2proc():
     assert "ranks consistent (2 ranks)" in res.stdout
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_cli_torch_adasum_2proc():
     res = _hvtpurun([
         "-np", "2", "--cpu-devices", "1", "--",
@@ -84,6 +86,7 @@ def test_cli_torch_adasum_2proc():
     assert "ranks consistent (2 ranks)" in res.stdout
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_cli_tf2_custom_loop_2proc():
     res = _hvtpurun([
         "-np", "2", "--cpu-devices", "1", "--",
@@ -103,6 +106,7 @@ def _static_discovery(tmp_path, slots=2):
     return script
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_cli_torch_elastic_example(tmp_path):
     res = _hvtpurun([
         "--host-discovery-script", _static_discovery(tmp_path),
@@ -114,6 +118,7 @@ def test_cli_torch_elastic_example(tmp_path):
     assert "ranks consistent (2 ranks)" in res.stdout
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_cli_keras_elastic_example(tmp_path):
     res = _hvtpurun([
         "--host-discovery-script", _static_discovery(tmp_path),

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from horovod_tpu.models import MLP, ResNet18, ResNet50
 
@@ -187,6 +188,7 @@ class TestBenchmarkTrio:
         # torchvision vgg16: 138,357,544 params
         assert abs(n - 138_357_544) < 1e5, n
 
+    @pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
     def test_inception3_forward_and_stats(self):
         from horovod_tpu.models import InceptionV3
 

@@ -127,6 +127,7 @@ def test_tf_bare_collective_gradients_2proc():
         assert out["bcast_grad"] == ([3.0] if r == 0 else [0.0])
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_keras_fit_lockstep_2proc():
     def body():
         import numpy as np
@@ -163,6 +164,7 @@ def test_keras_fit_lockstep_2proc():
     assert w0 == w1
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_tf_process_set_scoped_collectives_4proc():
     """Process-set scoping through the TF frontend (parity: the
     reference's TF ops all take process_set; torch coverage existed,
@@ -215,6 +217,7 @@ def test_tf_process_set_scoped_collectives_4proc():
         assert out["obj"] == [("rank", q) for q in peers]
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_tf_v1_graph_optimizer_minimize_2proc():
     """tf.compat.v1 graph-mode DistributedOptimizer end-to-end at P=2
     (parity: the reference's test_tensorflow v1 session training): a
@@ -325,6 +328,7 @@ def test_sync_batch_normalization_2proc():
         assert all(np.isfinite(gg))
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_keras_load_model_lockstep_2proc(tmp_path):
     """hvd.load_model across real ranks: every rank loads the same
     checkpoint, refits on rank-dependent data, and the wrapped
@@ -366,6 +370,7 @@ def test_keras_load_model_lockstep_2proc(tmp_path):
     np.testing.assert_allclose(w0, w1, rtol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_tf_v1_broadcast_hook_monitored_session_2proc():
     """TF1 parity: BroadcastGlobalVariablesHook under a
     MonitoredTrainingSession equalizes rank-dependent initial
@@ -401,6 +406,7 @@ def test_tf_v1_broadcast_hook_monitored_session_2proc():
         assert b == [100.0] * 3
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_tf_op_matrix_alltoall_reducescatter_sparse_2proc():
     """The remaining TF op matrix across real processes: variable-split
     alltoall, reducescatter (even + uneven), IndexedSlices allreduce,
@@ -456,6 +462,7 @@ def test_tf_op_matrix_alltoall_reducescatter_sparse_2proc():
         assert out["obj"] == {"w": [1, 2, 3], "rank": 0}
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_tf_grouped_allgather_reducescatter_2proc():
     """TF grouped_allgather / grouped_reducescatter across real
     processes, values AND registered gradients (parity:
@@ -519,6 +526,7 @@ def test_tf_grouped_allgather_reducescatter_2proc():
 
 
 @pytest.mark.multiprocess
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_tf_alltoall_no_splits_ragged_grad_2proc():
     """Round-4 advisor finding: the no-splits alltoall gradient must
     replay with the NEGOTIATED received splits.  With ranks
@@ -559,6 +567,7 @@ def test_tf_alltoall_no_splits_ragged_grad_2proc():
     assert by_rank[1][1] == [1.0, 2.0]
 
 
+@pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
 def test_tf_graph_mode_fused_broadcast_2proc():
     """Graph-mode (tf.function) broadcast_variables across real
     processes: the fused per-dtype path must deliver rank-0 values to

@@ -605,6 +605,38 @@ class TestNativePythonAgreement:
         assert parsed.cache_resync
         assert [rq.entry.name for rq in parsed.requests] == ["x"]
 
+    def test_mismatch_diagnostics_bytes_identical(self):
+        """Cross-rank mismatch error responses (named-rank diagnostics
+        + forced cache resync) must serialize identically from the C++
+        and Python controllers — including after a bypass cycle where
+        one rank's bit expands against another rank's conflicting full
+        entry."""
+        outs = []
+        for cls in (ncore.NativeController, fallback.PyController):
+            c0, c1 = make_pair(cls, size=2)
+            c0.enqueue(1, "w/k", wire.ALLREDUCE, wire.RED_SUM, 6, (4, 4))
+            c1.enqueue(1, "w/k", wire.ALLREDUCE, wire.RED_SUM, 6, (4, 8))
+            resp, _fin = run_cycle([c0, c1])
+            # dtype mismatch on a broadcast with disagreeing roots too
+            c0.enqueue(2, "b", wire.BROADCAST, wire.RED_SUM, 6, (2,),
+                       0, -1, 0)
+            c1.enqueue(2, "b", wire.BROADCAST, wire.RED_SUM, 3, (2,),
+                       0, -1, 1)
+            resp2, _fin = run_cycle([c0, c1])
+            outs.append((resp, resp2))
+        assert outs[0] == outs[1]
+        rl = wire.parse_response_list(outs[0][0])
+        assert rl.cache_resync_needed
+        assert len(rl.responses) == 1
+        err = rl.responses[0].error
+        assert err.startswith("cross-rank tensor mismatch for 'w/k'")
+        assert "rank 0 submitted op=0 red_op=0 dtype=6 shape=[4,4]" in err
+        assert "rank 1 submitted op=0 red_op=0 dtype=6 shape=[4,8]" in err
+        rl2 = wire.parse_response_list(outs[0][1])
+        err2 = rl2.responses[0].error
+        assert "dtype=6" in err2 and "dtype=3" in err2
+        assert "root_rank=0" in err2 and "root_rank=1" in err2
+
     def test_cross_impl_fleet(self):
         """Rank 0 native + rank 1 Python coordinate successfully."""
         c0 = ncore.NativeController(0, 2, 1 << 20)
@@ -778,4 +810,4 @@ class TestWheelBuild:
         zipfile.ZipFile(whl).extractall(site)
         lib = ctypes.CDLL(str(site / "horovod_tpu/native/libhvt_core.so"))
         lib.hvt_abi_version.restype = ctypes.c_int
-        assert lib.hvt_abi_version() == 3
+        assert lib.hvt_abi_version() == 4

@@ -118,6 +118,28 @@ class TestWorkerEnv:
         assert "HVTPU_TIMELINE" not in env
         assert "HVTPU_COMPRESSION" not in env
 
+    def test_integrity_flags_mirrored_to_env(self):
+        """--audit-every / --audit-action / --nonfinite-action reach
+        workers as HVTPU_AUDIT_EVERY / HVTPU_AUDIT_ACTION /
+        HVTPU_NONFINITE_ACTION (docs/robustness.md Integrity)."""
+        args = launch_mod.parse_args(
+            ["-np", "2", "--audit-every", "16", "--audit-action",
+             "warn", "--nonfinite-action", "zero", "python", "x.py"]
+        )
+        env = launch_mod.build_worker_env({}, self._slot(), "h", 1, args)
+        assert env["HVTPU_AUDIT_EVERY"] == "16"
+        assert env["HVTPU_AUDIT_ACTION"] == "warn"
+        assert env["HVTPU_NONFINITE_ACTION"] == "zero"
+        # unset integrity flags must not leak
+        args = launch_mod.parse_args(["-np", "2", "python", "x.py"])
+        env = launch_mod.build_worker_env({}, self._slot(), "h", 1, args)
+        assert "HVTPU_AUDIT_EVERY" not in env
+        assert "HVTPU_NONFINITE_ACTION" not in env
+        # bad values are rejected at the CLI, not deep in a worker
+        with pytest.raises(SystemExit):
+            launch_mod.parse_args(["-np", "2", "--nonfinite-action",
+                                   "explode", "python", "x.py"])
+
 
 class TestSshCommand:
     def test_ssh_cmdline(self):

@@ -164,6 +164,7 @@ class TestTorchEstimator:
             np.asarray(dict_out["label__output"], dtype=np.float32),
             direct, rtol=1e-5)
 
+    @pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
     def test_resume_from_checkpoint_2proc(self, tmp_path):
         """VERDICT r4 #8: refit with the same run_id and
         resume_from_checkpoint=True continues from the Store
@@ -209,6 +210,7 @@ class TestTorchEstimator:
         fresh = make_est(resume=False).fit(df)
         assert fresh.getHistory()["loss"][0] > h2[0]
 
+    @pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
     def test_sample_weight_col_2proc(self, tmp_path):
         """sample_weight_col (reference contract): the weight batch is
         the loss callable's third argument.  Half the rows carry a
@@ -267,6 +269,7 @@ class TestTorchEstimator:
         # pulled toward the +25 poisoned rows: far from clean labels
         assert float(((upred - y) ** 2).mean()) > clean_mse * 10
 
+    @pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
     def test_loss_weights_and_gradient_compression_params(
             self, tmp_path):
         """Reference param spellings: loss_weights scales each
@@ -432,6 +435,7 @@ class TestTorchEstimator:
         with pytest.raises(ValueError, match="options"):
             resolve_compression(hvd_torch, "fp32")
 
+    @pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
     def test_keras_uneven_shards_train_in_lockstep(self, tmp_path):
         """65 rows over 2 ranks: without the min-rows trim, rank 0
         runs one more gradient-allreduce batch than rank 1 and the
@@ -515,6 +519,7 @@ class TestTorchEstimator:
 
 
 class TestKerasEstimator:
+    @pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
     def test_fit_transform_checkpoint_2proc(self, tmp_path):
         import keras
 
@@ -552,6 +557,7 @@ class TestKerasEstimator:
         assert (pred.argmax(1) == y).mean() > 0.7
 
 
+    @pytest.mark.slow  # tier-1 runtime diet: heaviest in the --durations audit; full matrix via -m slow
     def test_keras_resume_from_checkpoint_2proc(self, tmp_path):
         """Keras analog of the torch resume test: refit with the same
         run_id and resume_from_checkpoint=True loads the Store
