@@ -507,8 +507,8 @@ class EagerController:
         self._group_ids = itertools.count(1)
         # Coalescing-gate state: enqueues not yet drained, and when the
         # most recent one landed (see run_cycle_once).
-        self._undrained = 0
-        self._last_enqueue_t = 0.0
+        self._undrained = 0  # hvtpulint: guarded-by(_lock, racy-read-ok)
+        self._last_enqueue_t = 0.0  # hvtpulint: guarded-by(_lock)
         # Steady-state burst tracking: once the same burst size repeats
         # (the per-step DistributedOptimizer pattern), the gate exits
         # the moment the expected count lands instead of waiting out
@@ -519,10 +519,10 @@ class EagerController:
         # enqueues (which lock individually) so no concurrent enqueue can
         # slip a colliding name in mid-group.
         self._lock = threading.RLock()
-        self._payloads: Dict[int, _Payload] = {}
-        self._by_name: Dict[str, int] = {}
-        self._join_futures: List[OpFuture] = []
-        self._joined_local = False
+        self._payloads: Dict[int, _Payload] = {}  # hvtpulint: guarded-by(_lock)
+        self._by_name: Dict[str, int] = {}  # hvtpulint: guarded-by(_lock)
+        self._join_futures: List[OpFuture] = []  # hvtpulint: guarded-by(_lock)
+        self._joined_local = False  # hvtpulint: guarded-by(_lock)
         self._cycle = 0
         self._stall_logged: set = set()
         self._stop = threading.Event()
@@ -566,14 +566,14 @@ class EagerController:
         # scheduled onto the executor, and the FIFO of predicted
         # Responses awaiting verification against the real stream.
         self._cache_capacity = cache_capacity
-        self._pending_buf: List[str] = []
-        self._unsched: set = set()
-        self._predicted: "collections.deque" = collections.deque()
+        self._pending_buf: List[str] = []  # hvtpulint: guarded-by(_lock)
+        self._unsched: set = set()  # hvtpulint: guarded-by(_lock)
+        self._predicted: "collections.deque" = collections.deque()  # hvtpulint: guarded-by(_lock)
         # bit-sets whose predicted schedule has been VERIFIED against
         # the real response stream once (see _try_predict), plus the
         # FIFO of first-occurrence observations awaiting verification
-        self._verified_bits: set = set()
-        self._observe: "collections.deque" = collections.deque()
+        self._verified_bits: set = set()  # hvtpulint: guarded-by(_lock)
+        self._observe: "collections.deque" = collections.deque()  # hvtpulint: guarded-by(_lock)
         self._tuned_seen = False
         # EXPERIMENTAL opt-in (see _try_predict): local schedule
         # prediction assumes every rank drains the established steady

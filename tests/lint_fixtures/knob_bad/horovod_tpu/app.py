@@ -1,0 +1,9 @@
+"""knob-registry fixture (bad): drift in every direction."""
+
+import os
+
+# Read but missing from docs/knobs.md -> undocumented-knob.
+UNDOC = os.environ.get("HVTPU_FIXTURE_UNDOC", "0")
+
+# Read and documented, but the doc row still says TODO -> describe:.
+TODOKNOB = os.getenv("HVTPU_FIXTURE_TODO", "1")

@@ -1,0 +1,5 @@
+"""knob-registry fixture (clean): code and docs agree."""
+
+import os
+
+TIMEOUT = float(os.environ.get("HVTPU_FIXTURE_TIMEOUT", "30.0"))

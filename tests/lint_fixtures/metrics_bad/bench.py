@@ -1,0 +1,5 @@
+"""bench fixture (bad): requires a metric nobody registers or catalogs."""
+
+REQUIRED_METRIC_KEYS = [
+    "hvtpu_fixture_missing_total",
+]

@@ -1,0 +1,6 @@
+"""metrics-catalog fixture (bad): drift in every direction."""
+
+from .registry import counter
+
+# Registered but absent from docs/observability.md.
+UNDOC = counter("hvtpu_fixture_undocumented_total", "Not in the catalog.")

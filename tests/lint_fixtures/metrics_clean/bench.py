@@ -1,0 +1,5 @@
+"""bench fixture (clean): the metric contract the bench guard enforces."""
+
+REQUIRED_METRIC_KEYS = [
+    "hvtpu_fixture_steps_total",
+]

@@ -1,0 +1,6 @@
+"""metrics-catalog fixture (clean): registry, docs, and bench agree."""
+
+from .registry import counter, gauge
+
+STEPS = counter("hvtpu_fixture_steps_total", "Completed steps.")
+DEPTH = gauge("hvtpu_fixture_queue_depth", "Pending items.")

@@ -1,0 +1,33 @@
+"""rank-divergence fixture: collectives under rank-dependent branches.
+
+Every pattern here is a known-bad case the pass must flag.
+"""
+
+import horovod_tpu as hvt
+
+
+def direct_rank_test(grads):
+    # Bad: broadcast only on rank 0 — other ranks never enter the op.
+    if hvt.rank() == 0:
+        hvt.broadcast(grads, root_rank=0)
+
+
+def tainted_local(grads):
+    # Bad: the rank value flows through a local before the test.
+    r = hvt.rank()
+    if r > 0:
+        grads = hvt.allreduce(grads)
+    return grads
+
+
+def else_arm(state, grads):
+    # Bad: the else arm runs on the complement set of ranks.
+    if state.rank == 0:
+        pass
+    else:
+        hvt.barrier()
+
+
+def ternary(loss):
+    # Bad: rank-conditional collective inside a conditional expression.
+    return hvt.allreduce(loss) if hvt.local_rank() == 0 else loss

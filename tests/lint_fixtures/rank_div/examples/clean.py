@@ -1,0 +1,33 @@
+"""rank-divergence fixture: rank-uniform patterns the pass must accept."""
+
+import horovod_tpu as hvt
+
+
+def uniform_collectives(grads):
+    # Fine: every rank issues the same collectives unconditionally.
+    grads = hvt.allreduce(grads)
+    hvt.barrier()
+    return grads
+
+
+def rank_only_logging(loss):
+    # Fine: rank-dependent branch contains no collective.
+    loss = hvt.allreduce(loss)
+    if hvt.rank() == 0:
+        print("loss", loss)
+    return loss
+
+
+def helper_defined_under_rank_branch():
+    # Fine: a def nested under a rank test is not *executed* there.
+    if hvt.rank() == 0:
+        def save_hook(grads):
+            return hvt.allreduce(grads)
+        return save_hook
+    return None
+
+
+def thread_join(worker):
+    # Fine: Thread.join is not the collective join.
+    if hvt.rank() == 0:
+        worker.join()

@@ -1,0 +1,33 @@
+"""thread-safety fixture: lock-discipline violations the pass must flag."""
+
+import threading
+
+
+class BadWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []          # hvtpulint: guarded-by(_lock)
+        self._depth = 0           # hvtpulint: guarded-by(_lock, racy-read-ok)
+        self._thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        while True:
+            self._drain()
+
+    def _drain(self):             # hvtpulint: requires(_lock)
+        while self._queue:
+            self._queue.pop()
+
+    def submit(self, item):
+        # Bad: unlocked write to a guarded attribute.
+        self._queue.append(item)
+        # Bad: calling a requires(_lock) method without the lock.
+        self._drain()
+
+    def bump(self):
+        # racy-read-ok permits the read but this is a *write*.
+        self._depth = self._depth + 1
+
+    def peek_depth(self):
+        # Fine: racy-read-ok read.
+        return self._depth

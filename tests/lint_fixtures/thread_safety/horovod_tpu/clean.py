@@ -1,0 +1,33 @@
+"""thread-safety fixture: correct lock discipline the pass must accept."""
+
+import threading
+
+
+class CleanWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []          # hvtpulint: guarded-by(_lock)
+        self._depth = 0           # hvtpulint: guarded-by(_lock, racy-read-ok)
+        self._thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._drain()
+
+    def _drain(self):             # hvtpulint: requires(_lock)
+        while self._queue:
+            self._queue.pop()
+
+    def submit(self, item):
+        with self._lock:
+            self._queue.append(item)
+            self._depth += 1
+
+    def peek_depth(self):
+        # Fine: racy-read-ok read without the lock.
+        return self._depth
+
+    def _unreachable_helper(self):
+        # Private and never called from an entry point — not checked.
+        self._queue.clear()

@@ -1,0 +1,17 @@
+// Minimal fixture twin of native/src/controller.cc (wire-twin clean case).
+#include "controller.h"
+
+namespace hvt {
+
+std::string ResponseCache::Signature(const Entry& e) {
+  std::ostringstream ss;
+  ss << e.name << '|' << int(e.dtype) << '|';
+  for (int64_t d : e.shape) ss << d << ',';
+  return ss.str();
+}
+
+static std::string TableKey(const Entry& e) {
+  return std::to_string(e.process_set_id) + '|' + e.name;
+}
+
+}  // namespace hvt

@@ -1,0 +1,32 @@
+// Minimal fixture twin of native/src/message.cc (wire-twin clean case).
+#include "message.h"
+
+namespace hvt {
+
+static void WriteEntry(Writer& w, const Entry& e) {
+  w.u64(e.seq);
+  w.str(e.name);
+  w.u8(static_cast<uint8_t>(e.dtype));
+}
+
+std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
+  Writer w;
+  w.u32(kRequestMagic);
+  w.u32(kWireVersion);
+  w.i32(rl.rank);
+  for (const Request& rq : rl.requests) {
+    WriteEntry(w, rq.entry);
+  }
+  return std::move(w.buf);
+}
+
+std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
+  Writer w;
+  w.u32(kResponseMagic);
+  w.u32(kWireVersion);
+  w.str(rl.error);
+  w.u8(rl.shutdown ? 1 : 0);
+  return std::move(w.buf);
+}
+
+}  // namespace hvt

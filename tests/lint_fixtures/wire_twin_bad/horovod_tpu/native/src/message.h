@@ -1,0 +1,11 @@
+// Minimal fixture twin of native/src/message.h (wire-twin clean case).
+#pragma once
+#include <cstdint>
+
+namespace hvt {
+
+constexpr uint32_t kRequestMagic = 0x52545648;
+constexpr uint32_t kResponseMagic = 0x50545648;
+constexpr uint32_t kWireVersion = 4;
+
+}  // namespace hvt

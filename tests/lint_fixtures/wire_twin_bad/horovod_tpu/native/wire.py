@@ -1,0 +1,41 @@
+"""Minimal fixture twin of native/wire.py (wire-twin clean case)."""
+
+REQUEST_MAGIC = 0x52545648
+RESPONSE_MAGIC = 0x50545648
+WIRE_VERSION = 3
+
+ALLREDUCE, BARRIER = range(2)
+RED_SUM, RED_AVERAGE = range(2)
+DTYPE_IDS = {"uint8": 0, "float32": 1}
+DTYPE_SIZES = {0: 1, 1: 4}
+
+
+class Entry:
+    def signature(self):
+        dims = "".join(f"{d}," for d in self.shape)
+        return f"{self.name}|{self.dtype}|{dims}"
+
+
+def _write_entry(w, e):
+    w.u64(e.seq)
+    w.s(e.name)
+    w.u8(e.dtype)
+
+
+def serialize_request_list(rl):
+    w = _W()
+    w.u32(REQUEST_MAGIC)
+    w.u32(WIRE_VERSION)
+    w.i32(rl.rank)
+    for rq in rl.requests:
+        _write_entry(w, rq.entry)
+    return w.bytes()
+
+
+def serialize_response_list(rl):
+    w = _W()
+    w.u32(RESPONSE_MAGIC)
+    w.u32(WIRE_VERSION)
+    w.u8(1 if rl.shutdown else 0)
+    w.s(rl.error)
+    return w.bytes()

@@ -1,0 +1,5 @@
+"""Minimal fixture twin of native/fallback.py (wire-twin clean case)."""
+
+
+def _table_key(e):
+    return f"{e.process_set_id}\x01{e.name}"

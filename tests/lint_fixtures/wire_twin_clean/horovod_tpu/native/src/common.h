@@ -1,0 +1,31 @@
+// Minimal fixture twin of native/src/common.h (wire-twin clean case).
+#pragma once
+#include <cstdint>
+
+namespace hvt {
+
+enum class DataType : uint8_t {
+  kUint8 = 0,
+  kFloat32 = 1,
+};
+
+enum class OpType : uint8_t {
+  kAllreduce = 0,
+  kBarrier = 1,
+};
+
+enum class RedOp : uint8_t {
+  kSum = 0,
+  kAverage = 1,
+};
+
+inline int64_t DataTypeSize(DataType t) {
+  switch (t) {
+    case DataType::kUint8:
+      return 1;
+    default:
+      return 4;
+  }
+}
+
+}  // namespace hvt

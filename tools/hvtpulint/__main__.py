@@ -1,0 +1,81 @@
+"""CLI: ``python -m tools.hvtpulint`` (or the ``hvtpulint`` script).
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from . import SUPPRESSION_FILE, Project, pass_names, run_passes
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="hvtpulint",
+        description="static analysis for the hvtpu tree "
+                    "(docs/static-analysis.md)")
+    parser.add_argument("--root", type=Path, default=None,
+                        help="repo root (default: auto-detected from "
+                             "this file's location)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--passes", default=None, metavar="P1,P2",
+                        help="comma-separated subset of: "
+                             + ",".join(pass_names()))
+    parser.add_argument("--suppressions", type=Path, default=None,
+                        help=f"suppression file (default: "
+                             f"<root>/{SUPPRESSION_FILE})")
+    parser.add_argument("--list-passes", action="store_true")
+    parser.add_argument("--write-knobs", action="store_true",
+                        help="regenerate docs/knobs.md from the "
+                             "extracted knob set (preserves existing "
+                             "descriptions), then exit")
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        for name in pass_names():
+            print(name)
+        return 0
+
+    root = args.root
+    if root is None:
+        # tools/hvtpulint/__main__.py -> repo root two levels up
+        root = Path(__file__).resolve().parent.parent.parent
+    root = root.resolve()
+    if not (root / "horovod_tpu").is_dir():
+        print(f"hvtpulint: {root} does not look like the hvtpu repo "
+              "(no horovod_tpu/); pass --root", file=sys.stderr)
+        return 2
+
+    if args.write_knobs:
+        from . import knob_registry
+        out = knob_registry.write_knobs_md(Project(root))
+        print(f"hvtpulint: wrote {out}")
+        return 0
+
+    only = [p.strip() for p in args.passes.split(",")] if args.passes else None
+    try:
+        findings = run_passes(root, only=only,
+                              suppress_path=args.suppressions)
+    except ValueError as exc:
+        print(f"hvtpulint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps({"findings": [f.as_json() for f in findings],
+                          "count": len(findings)}, indent=2))
+    else:
+        for f in findings:
+            print(f.format_text())
+        n = len(findings)
+        ran = ", ".join(only) if only else "all passes"
+        print(f"hvtpulint: {n} finding{'s' if n != 1 else ''} ({ran})")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

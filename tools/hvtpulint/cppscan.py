@@ -1,0 +1,143 @@
+"""Lightweight lexical C++ scanning for the wire-twin pass.
+
+This is deliberately NOT a C++ parser.  The native sources follow the
+project style guide (one constant per line, brace-on-same-line
+function bodies, ``w.u32(...)`` writer calls), and the scanner leans
+on that.  If the style drifts far enough that these regexes miss, the
+wire-twin pass fails closed with a missing-surface finding rather
+than silently passing.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+_COMMENT_RE = re.compile(r"//[^\n]*|/\*.*?\*/", re.S)
+_CONST_RE = re.compile(
+    r"constexpr\s+(?:uint32_t|uint64_t|int32_t|int64_t|int|unsigned)\s+"
+    r"(k\w+)\s*=\s*(0x[0-9a-fA-F]+|\d+)\s*;")
+_ENUM_RE = re.compile(
+    r"enum\s+class\s+(\w+)\s*:\s*\w+\s*\{(.*?)\}\s*;", re.S)
+_ENUM_MEMBER_RE = re.compile(r"k(\w+)\s*=\s*(\d+)")
+# Writer calls: `w.u32(expr)` / `w.str(expr)` — the receiver is always
+# a local named `w` in message.cc.
+_WRITE_RE = re.compile(r"\bw\.(u8|u32|i32|i64|u64|f64|str)\s*\(")
+_WRITE_ENTRY_RE = re.compile(r"\bWriteEntry\s*\(")
+
+
+def strip_comments(src: str) -> str:
+    return _COMMENT_RE.sub("", src)
+
+
+def constants(src: str) -> Dict[str, int]:
+    """All `constexpr <int-type> kFoo = <literal>;` declarations."""
+    out: Dict[str, int] = {}
+    for m in _CONST_RE.finditer(strip_comments(src)):
+        out[m.group(1)] = int(m.group(2), 0)
+    return out
+
+
+def const_line(src: str, name: str) -> int:
+    for i, line in enumerate(src.splitlines(), 1):
+        if name in line:
+            return i
+    return 0
+
+
+def enums(src: str) -> Dict[str, Dict[str, int]]:
+    """`enum class Name : <type> { kA = 0, ... }` bodies.
+
+    Members without an explicit `= value` take previous+1, mirroring
+    C++ semantics, so the scan survives a style change even though the
+    sources currently spell every value out.
+    """
+    out: Dict[str, Dict[str, int]] = {}
+    for m in _ENUM_RE.finditer(strip_comments(src)):
+        members: Dict[str, int] = {}
+        next_val = 0
+        for item in m.group(2).split(","):
+            item = item.strip()
+            if not item:
+                continue
+            em = _ENUM_MEMBER_RE.search(item)
+            if em:
+                members[em.group(1)] = int(em.group(2))
+                next_val = int(em.group(2)) + 1
+            else:
+                nm = re.match(r"k(\w+)", item)
+                if nm:
+                    members[nm.group(1)] = next_val
+                    next_val += 1
+        out[m.group(1)] = members
+    return out
+
+
+def function_body(src: str, name: str) -> Optional[str]:
+    """Extract the brace-balanced body of the first function whose
+    signature line contains ``name(``."""
+    clean = strip_comments(src)
+    idx = clean.find(name + "(")
+    while idx != -1:
+        brace = clean.find("{", idx)
+        semi = clean.find(";", idx)
+        if brace == -1:
+            return None
+        if semi != -1 and semi < brace:
+            # A declaration, not a definition — keep looking.
+            idx = clean.find(name + "(", semi)
+            continue
+        depth = 0
+        for i in range(brace, len(clean)):
+            if clean[i] == "{":
+                depth += 1
+            elif clean[i] == "}":
+                depth -= 1
+                if depth == 0:
+                    return clean[brace:i + 1]
+        return None
+    return None
+
+
+def write_sequence(body: str) -> List[str]:
+    """Ordered writer-op sequence of a serialize function body.
+
+    Returns tokens like ``u32``/``i64``/``str`` plus ``entry`` for
+    nested WriteEntry calls.  Loops collapse to their element ops —
+    the twin check compares shapes of the write programs, and both
+    sides express repetition the same way (count prefix + loop)."""
+    events: List[Tuple[int, str]] = []
+    for m in _WRITE_RE.finditer(body):
+        events.append((m.start(), m.group(1)))
+    for m in _WRITE_ENTRY_RE.finditer(body):
+        events.append((m.start(), "entry"))
+    events.sort()
+    return [op for _, op in events]
+
+
+def datatype_size_map(src: str) -> Tuple[Dict[str, int], Optional[int]]:
+    """Parse the DataTypeSize() switch.
+
+    Returns ({enum-member: size}, default-size-or-None); members
+    covered by the ``default:`` label take the default size."""
+    body = function_body(src, "DataTypeSize")
+    if body is None:
+        return {}, None
+    out: Dict[str, int] = {}
+    default: Optional[int] = None
+    pending: List[str] = []
+    saw_default = False
+    for line in body.splitlines():
+        for cm in re.finditer(r"case\s+DataType::k(\w+)\s*:", line):
+            pending.append(cm.group(1))
+        if re.search(r"\bdefault\s*:", line):
+            saw_default = True
+        rm = re.search(r"return\s+(\d+)\s*;", line)
+        if rm:
+            for name in pending:
+                out[name] = int(rm.group(1))
+            pending = []
+            if saw_default:
+                default = int(rm.group(1))
+                saw_default = False
+    return out, default

@@ -1,0 +1,201 @@
+"""rank-divergence pass: collectives under rank-dependent control flow.
+
+Every rank must issue the same collectives in the same order, or the
+job deadlocks (or — since PR 4 — dies with HvtpuMismatchError at
+runtime).  This pass is the static counterpart: it flags calls whose
+name matches a known collective when they are lexically nested under
+an `if` / `while` / ternary whose test depends on the caller's rank.
+
+Rank-dependence is detected on the test expression:
+
+  * a call to rank()/local_rank()/cross_rank()/node_rank()/
+    process_index()/rank_in_process_set()
+  * an attribute read ending in .rank / .local_rank / .cross_rank
+    (e.g. ``state.rank == 0``)
+  * a local name assigned from either of the above earlier in the
+    same scope (dataflow-lite taint, single forward pass)
+
+Intentional root-rank-only patterns (checkpoint save on rank 0 that
+still issues a barrier, etc.) belong in the suppression file with a
+justification, not in code changes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from . import Finding, Project
+
+PASS = "rank-divergence"
+
+SCAN_DIRS = ("examples", "horovod_tpu")
+# Test worker scripts run as real ranks; the rest of tests/ drives
+# them from the outside and is exempt.
+SCRIPT_GLOB = "*_script.py"
+
+RANK_CALLS = {
+    "rank", "local_rank", "cross_rank", "node_rank", "process_index",
+    "rank_in_process_set",
+}
+RANK_ATTRS = {"rank", "local_rank", "cross_rank"}
+
+# Calls treated as collective issuance.  Matching is by terminal name
+# (``hvt.allreduce`` and ``state.commit`` both match), which
+# overmatches on purpose — a suppression with a justification is the
+# documented way to silence a non-collective homonym.
+COLLECTIVES = {
+    "allreduce", "allreduce_", "grouped_allreduce", "allgather",
+    "broadcast", "alltoall", "reducescatter", "barrier",
+    "broadcast_object", "broadcast_variables", "broadcast_parameters",
+    "broadcast_optimizer_state", "broadcast_global_variables",
+    "commit", "rebroadcast",          # elastic State transactions
+    "verify", "maybe_audit",          # core.audit cross-rank digests
+    "aggregate",                      # obs.metrics cross-rank reduce
+}
+# `join` (the collective) collides with Thread.join on every worker
+# thread; it is only matched through an hvt-ish receiver.
+JOIN_RECEIVERS = {"hvt", "hvtpu", "horovod_tpu", "controller", "ctl"}
+
+
+def _terminal_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _receiver_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return func.value.id
+    return None
+
+
+class _Scope:
+    def __init__(self, qualname: str):
+        self.qualname = qualname
+        self.tainted: Set[str] = set()
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rel_path: str):
+        self.rel = rel_path
+        self.findings: List[Finding] = []
+        self.scopes: List[_Scope] = [_Scope("<module>")]
+        self.rank_depth = 0  # nesting depth of rank-dependent branches
+
+    # -- taint ---------------------------------------------------------
+    def _is_rank_dependent(self, expr: ast.expr) -> bool:
+        tainted = self.scopes[-1].tainted
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                name = _terminal_name(node.func)
+                if name in RANK_CALLS:
+                    return True
+            elif isinstance(node, ast.Attribute) and node.attr in RANK_ATTRS:
+                return True
+            elif isinstance(node, ast.Name) and node.id in tainted:
+                return True
+        return False
+
+    def _note_assign(self, targets: List[ast.expr], value: Optional[ast.expr]):
+        if value is None or not self._is_rank_dependent(value):
+            return
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self.scopes[-1].tainted.add(t.id)
+
+    def visit_Assign(self, node: ast.Assign):
+        self._note_assign(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        self._note_assign([node.target], node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._note_assign([node.target], node.value)
+        self.generic_visit(node)
+
+    # -- scopes --------------------------------------------------------
+    def _visit_scope(self, node, name: str):
+        parent = self.scopes[-1].qualname
+        qual = name if parent == "<module>" else f"{parent}.{name}"
+        self.scopes.append(_Scope(qual))
+        # A nested def is only *executed* under the enclosing branch if
+        # called there; conservatively reset branch depth inside it so
+        # helpers defined under `if rank==0:` don't flag their bodies.
+        saved = self.rank_depth
+        self.rank_depth = 0
+        self.generic_visit(node)
+        self.rank_depth = saved
+        self.scopes.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._visit_scope(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._visit_scope(node, node.name)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._visit_scope(node, node.name)
+
+    # -- branches ------------------------------------------------------
+    def _visit_branch(self, test: ast.expr, bodies: List[List[ast.stmt]]):
+        dependent = self._is_rank_dependent(test)
+        self.visit(test)
+        if dependent:
+            self.rank_depth += 1
+        for body in bodies:
+            for stmt in body:
+                self.visit(stmt)
+        if dependent:
+            self.rank_depth -= 1
+
+    def visit_If(self, node: ast.If):
+        # The else/elif arm of a rank-test diverges exactly like the
+        # then-arm (it runs on the complement set of ranks).
+        self._visit_branch(node.test, [node.body, node.orelse])
+
+    def visit_While(self, node: ast.While):
+        self._visit_branch(node.test, [node.body, node.orelse])
+
+    def visit_IfExp(self, node: ast.IfExp):
+        self._visit_branch(node.test, [[ast.Expr(node.body)],
+                                       [ast.Expr(node.orelse)]])
+
+    # -- collectives ---------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        name = _terminal_name(node.func)
+        is_collective = name in COLLECTIVES
+        if name == "join" and _receiver_name(node.func) in JOIN_RECEIVERS:
+            is_collective = True
+        if is_collective and self.rank_depth > 0:
+            qual = self.scopes[-1].qualname
+            self.findings.append(Finding(
+                PASS, self.rel, node.lineno,
+                f"{self.rel}:{qual}:{name}",
+                f"collective '{name}' issued under rank-dependent "
+                "control flow — ranks would disagree on the op stream"))
+        self.generic_visit(node)
+
+
+def scan_file(project: Project, path) -> List[Finding]:
+    tree = project.parse(path)
+    if tree is None:
+        return []
+    v = _Visitor(project.rel(path))
+    v.visit(tree)
+    return v.findings
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    files = project.py_files(*SCAN_DIRS)
+    tests_dir = project.root / "tests"
+    if tests_dir.is_dir():
+        files.extend(sorted(tests_dir.glob(SCRIPT_GLOB)))
+    for path in files:
+        findings.extend(scan_file(project, path))
+    return findings

@@ -1,0 +1,251 @@
+"""thread-safety pass: guarded-by lock discipline for annotated classes.
+
+Opt-in via source annotations (grammar in docs/static-analysis.md):
+
+  self._payloads = {}        # hvtpulint: guarded-by(_lock)
+  self._undrained = 0        # hvtpulint: guarded-by(_lock, racy-read-ok)
+  def _take_payloads(self):  # hvtpulint: requires(_lock)
+
+For every class that declares at least one guarded attribute the pass
+computes the set of methods reachable from a *thread entry point* —
+a method handed to ``threading.Thread(target=self.X)`` or any public
+method (callable from user threads) — by following ``self.m()`` call
+edges.  Within reachable methods, every access to a guarded attribute
+must be lexically inside ``with self.<lock>:`` or inside a method
+annotated ``requires(<lock>)``; calls to requires-methods must
+themselves hold the lock.  ``racy-read-ok`` permits bare unlocked
+reads (intentional racy fast-path checks) but still flags writes.
+
+``__init__`` is exempt: the object is not yet shared.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, Project
+
+PASS = "thread-safety"
+
+SCAN_DIRS = ("horovod_tpu",)
+MARKER = "hvtpulint:"
+
+_GUARDED_RE = re.compile(
+    r"self\.(\w+)\s*[:=].*#\s*hvtpulint:\s*guarded-by\(([^)]*)\)")
+_REQUIRES_RE = re.compile(r"#\s*hvtpulint:\s*requires\((\w+)\)")
+_ANY_ANNOT_RE = re.compile(r"#\s*hvtpulint:\s*(guarded-by|requires)\b")
+
+
+class _Guard:
+    def __init__(self, lock: str, racy_read_ok: bool, line: int):
+        self.lock = lock
+        self.racy_read_ok = racy_read_ok
+        self.line = line
+
+
+def _parse_guard(args: str, line: int) -> Optional[_Guard]:
+    parts = [p.strip() for p in args.split(",") if p.strip()]
+    if not parts:
+        return None
+    lock = parts[0]
+    flags = set(parts[1:])
+    return _Guard(lock, "racy-read-ok" in flags, line)
+
+
+def _method_requires(lines: List[str], fn: ast.FunctionDef) -> Optional[str]:
+    """requires(<lock>) on the def line(s) or the line just above."""
+    start = max(fn.lineno - 2, 0)
+    end = fn.body[0].lineno - 1 if fn.body else fn.lineno
+    for raw in lines[start:end]:
+        m = _REQUIRES_RE.search(raw)
+        if m:
+            return m.group(1)
+    return None
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Collects guarded-attribute accesses with the lock-held context,
+    self-call edges, and thread targets for one method body."""
+
+    def __init__(self, guards: Dict[str, _Guard], held: Set[str]):
+        self.guards = guards
+        self.base_held = held
+        self.held: Set[str] = set(held)
+        # (attr, lineno, is_write, held-locks-at-site)
+        self.accesses: List[Tuple[str, int, bool, Set[str]]] = []
+        # (callee, lineno, held-locks-at-site)
+        self.calls: List[Tuple[str, int, Set[str]]] = []
+        self.thread_targets: Set[str] = set()
+
+    def visit_With(self, node: ast.With):
+        saved = set(self.held)
+        for item in node.items:
+            self.visit(item.context_expr)
+            attr = _self_attr(item.context_expr)
+            if attr is not None:
+                self.held.add(attr)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = _self_attr(node)
+        if attr is not None and attr in self.guards:
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self.accesses.append((attr, node.lineno, is_write,
+                                  set(self.held)))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        attr = _self_attr(node.func)
+        if attr is not None:
+            self.calls.append((attr, node.lineno, set(self.held)))
+        # threading.Thread(target=self._loop, ...)
+        fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else (node.func.id if isinstance(node.func, ast.Name) else None)
+        if fname == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    tgt = _self_attr(kw.value)
+                    if tgt is not None:
+                        self.thread_targets.add(tgt)
+        self.generic_visit(node)
+
+    # Nested defs/lambdas may run on yet another thread (callbacks);
+    # keep visiting them but with no locks assumed held.
+    def _visit_nested(self, node):
+        saved, saved_base = self.held, self.base_held
+        self.held, self.base_held = set(), set()
+        self.generic_visit(node)
+        self.held, self.base_held = saved, saved_base
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._visit_nested(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._visit_nested(node)
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self._visit_nested(node)
+
+
+def _check_class(project: Project, rel: str, src: str,
+                 cls: ast.ClassDef) -> List[Finding]:
+    lines = src.splitlines()
+    findings: List[Finding] = []
+
+    # Guarded-attribute declarations anywhere in the class source span.
+    guards: Dict[str, _Guard] = {}
+    end = cls.end_lineno or len(lines)
+    for lineno in range(cls.lineno, min(end, len(lines)) + 1):
+        m = _GUARDED_RE.search(lines[lineno - 1])
+        if not m:
+            continue
+        guard = _parse_guard(m.group(2), lineno)
+        if guard is None:
+            findings.append(Finding(
+                PASS, rel, lineno, f"{cls.name}:bad-annotation:{m.group(1)}",
+                "guarded-by() needs a lock attribute name"))
+            continue
+        guards[m.group(1)] = guard
+    if not guards:
+        return findings
+
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, ast.FunctionDef)}
+    requires: Dict[str, str] = {}
+    for name, fn in methods.items():
+        lock = _method_requires(lines, fn)
+        if lock is not None:
+            requires[name] = lock
+
+    # Scan every method once.
+    scans: Dict[str, _MethodScan] = {}
+    thread_targets: Set[str] = set()
+    for name, fn in methods.items():
+        held = {requires[name]} if name in requires else set()
+        scan = _MethodScan(guards, held)
+        for stmt in fn.body:
+            scan.visit(stmt)
+        scans[name] = scan
+        thread_targets |= scan.thread_targets
+
+    # Reachability from thread entry points over self-call edges.
+    entries = set(thread_targets)
+    entries |= {n for n in methods
+                if not n.startswith("_") or n in thread_targets}
+    entries.discard("__init__")
+    reachable: Set[str] = set()
+    frontier = [e for e in entries if e in methods]
+    while frontier:
+        name = frontier.pop()
+        if name in reachable:
+            continue
+        reachable.add(name)
+        for callee, _, _ in scans[name].calls:
+            if callee in methods and callee not in reachable:
+                frontier.append(callee)
+    reachable.discard("__init__")
+
+    for name in sorted(reachable):
+        scan = scans[name]
+        for attr, lineno, is_write, held in scan.accesses:
+            guard = guards[attr]
+            if guard.lock in held:
+                continue
+            if guard.racy_read_ok and not is_write:
+                continue
+            kind = "write to" if is_write else "read of"
+            findings.append(Finding(
+                PASS, rel, lineno, f"{cls.name}.{name}:{attr}",
+                f"{kind} self.{attr} without holding self.{guard.lock} "
+                f"(declared guarded-by({guard.lock}) at line {guard.line}; "
+                f"reachable from a thread entry point via {name}())"))
+        for callee, lineno, held in scan.calls:
+            lock = requires.get(callee)
+            if lock is not None and lock not in held:
+                findings.append(Finding(
+                    PASS, rel, lineno, f"{cls.name}.{name}:call:{callee}",
+                    f"call to self.{callee}() which requires({lock}) "
+                    f"without holding self.{lock}"))
+    return findings
+
+
+def scan_file(project: Project, path) -> List[Finding]:
+    src = project.read(path)
+    if src is None or MARKER not in src:
+        return []
+    tree = project.parse(path)
+    if tree is None:
+        return []
+    findings: List[Finding] = []
+    rel = project.rel(path)
+    # Annotations outside any class would be silently dead — flag them.
+    class_spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            class_spans.append((node.lineno, node.end_lineno or node.lineno))
+            findings.extend(_check_class(project, rel, src, node))
+    for lineno, line in enumerate(src.splitlines(), 1):
+        if _ANY_ANNOT_RE.search(line) and not any(
+                a <= lineno <= b for a, b in class_spans):
+            findings.append(Finding(
+                PASS, rel, lineno, f"orphan-annotation:{lineno}",
+                "hvtpulint annotation outside a class body has no effect"))
+    return findings
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in project.py_files(*SCAN_DIRS):
+        findings.extend(scan_file(project, path))
+    return findings

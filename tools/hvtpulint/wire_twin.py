@@ -1,0 +1,389 @@
+"""wire-twin pass: C++ wire ABI vs the Python twin, without compiling.
+
+Surfaces checked (all byte-layout-relevant):
+
+  * kRequestMagic / kResponseMagic / kWireVersion (message.h) vs
+    REQUEST_MAGIC / RESPONSE_MAGIC / WIRE_VERSION (native/wire.py)
+  * OpType / RedOp / DataType enum values (common.h) vs the range()
+    tuples and DTYPE_IDS in wire.py, both directions
+  * DataTypeSize() switch vs DTYPE_SIZES
+  * serialized field order: the ordered writer-op programs of
+    WriteEntry / SerializeRequestList / SerializeResponseList
+    (message.cc) vs _write_entry / serialize_request_list /
+    serialize_response_list (wire.py)
+  * ResponseCache::Signature field order (controller.cc) vs
+    Entry.signature, and the '\\x01' message-table key separator
+    (controller.cc vs native/fallback.py)
+
+The runtime byte-agreement tests still exist; this pass catches the
+same drift at lint time and — unlike those tests — does not need a
+C++ toolchain.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from . import Finding, Project
+from . import cppscan
+
+PASS = "wire-twin"
+
+MESSAGE_H = "horovod_tpu/native/src/message.h"
+COMMON_H = "horovod_tpu/native/src/common.h"
+MESSAGE_CC = "horovod_tpu/native/src/message.cc"
+CONTROLLER_CC = "horovod_tpu/native/src/controller.cc"
+WIRE_PY = "horovod_tpu/native/wire.py"
+FALLBACK_PY = "horovod_tpu/native/fallback.py"
+
+# C++ constant -> Python twin constant.
+CONSTANT_TWINS = {
+    "kRequestMagic": "REQUEST_MAGIC",
+    "kResponseMagic": "RESPONSE_MAGIC",
+    "kWireVersion": "WIRE_VERSION",
+}
+
+# C++ serialize function -> Python twin function.
+ORDER_TWINS = {
+    "WriteEntry": "_write_entry",
+    "SerializeRequestList": "serialize_request_list",
+    "SerializeResponseList": "serialize_response_list",
+}
+
+
+def _py_constants(tree: ast.Module) -> Dict[str, Tuple[int, int]]:
+    """Module-level `NAME = <int literal>` -> (value, line)."""
+    out: Dict[str, Tuple[int, int]] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            out[node.targets[0].id] = (node.value.value, node.lineno)
+    return out
+
+
+def _py_enum_tuples(tree: ast.Module) -> List[Tuple[List[str], int, int]]:
+    """`A, B, C = range(n)` assigns -> ([names], n, line)."""
+    out = []
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Tuple)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id == "range"
+                and len(node.value.args) == 1
+                and isinstance(node.value.args[0], ast.Constant)):
+            names = [t.id for t in node.targets[0].elts
+                     if isinstance(t, ast.Name)]
+            out.append((names, node.value.args[0].value, node.lineno))
+    return out
+
+
+def _py_dict(tree: ast.Module, name: str) -> Optional[Tuple[dict, int]]:
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+                and isinstance(node.value, ast.Dict)):
+            try:
+                d = ast.literal_eval(node.value)
+            except ValueError:
+                return None
+            return d, node.lineno
+    return None
+
+
+def _py_write_sequence(tree: ast.Module, func_name: str) -> Optional[List[str]]:
+    """Ordered writer-op sequence of a wire.py serialize function.
+
+    Collects `w.<op>(...)` calls plus `_write_entry(...)` calls in
+    source order; the writer method `s` normalizes to the C++ `str`.
+    """
+    fn = next((n for n in tree.body
+               if isinstance(n, ast.FunctionDef) and n.name == func_name),
+              None)
+    if fn is None:
+        return None
+    events: List[Tuple[int, int, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "w"
+                and f.attr in {"u8", "u32", "i32", "i64", "u64", "f64", "s"}):
+            op = "str" if f.attr == "s" else f.attr
+            events.append((node.lineno, node.col_offset, op))
+        elif isinstance(f, ast.Name) and f.id == "_write_entry":
+            events.append((node.lineno, node.col_offset, "entry"))
+    events.sort()
+    return [op for _, _, op in events]
+
+
+_CPP_FIELD_RE = re.compile(r"\be\.(\w+)")
+_PY_FIELD_RE = re.compile(r"self\.(\w+)")
+
+
+def _signature_fields_cpp(body: str) -> List[str]:
+    seen: List[str] = []
+    for m in _CPP_FIELD_RE.finditer(body):
+        if m.group(1) not in seen:
+            seen.append(m.group(1))
+    return seen
+
+
+def _self_fields_in(node: ast.expr) -> List[str]:
+    """self.<field> reads under `node`, in source order, deduped."""
+    hits: List[Tuple[int, int, str]] = []
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name)
+                and n.value.id == "self"):
+            hits.append((n.lineno, n.col_offset, n.attr))
+    hits.sort()
+    out: List[str] = []
+    for _, _, attr in hits:
+        if attr not in out:
+            out.append(attr)
+    return out
+
+
+def _signature_fields_py(src: str, tree: ast.Module) -> Tuple[List[str], int]:
+    """Field *emission* order of Entry.signature().
+
+    Locals assigned from self.<field> expressions (`dims` built from
+    self.shape) resolve to their source fields at the position where
+    the local is interpolated, so the order reflects the produced
+    string, not textual appearance.
+    """
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef) and node.name == "Entry"):
+            continue
+        for item in node.body:
+            if not (isinstance(item, ast.FunctionDef)
+                    and item.name == "signature"):
+                continue
+            local_fields: Dict[str, List[str]] = {}
+            ret: Optional[ast.Return] = None
+            for n in ast.walk(item):
+                if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                        and isinstance(n.targets[0], ast.Name)):
+                    local_fields[n.targets[0].id] = _self_fields_in(n.value)
+                elif isinstance(n, ast.Return) and n.value is not None:
+                    ret = n
+            if ret is None:
+                return [], item.lineno
+            hits: List[Tuple[int, int, List[str]]] = []
+            for n in ast.walk(ret.value):
+                if (isinstance(n, ast.Attribute)
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == "self"):
+                    hits.append((n.lineno, n.col_offset, [n.attr]))
+                elif isinstance(n, ast.Name) and n.id in local_fields:
+                    hits.append((n.lineno, n.col_offset,
+                                 local_fields[n.id]))
+            hits.sort(key=lambda h: (h[0], h[1]))
+            seen: List[str] = []
+            for _, _, fields in hits:
+                for f in fields:
+                    if f not in seen:
+                        seen.append(f)
+            return seen, item.lineno
+    return [], 0
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+
+    msg_h = project.read(MESSAGE_H)
+    common_h = project.read(COMMON_H)
+    msg_cc = project.read(MESSAGE_CC)
+    ctrl_cc = project.read(CONTROLLER_CC)
+    wire_src = project.read(WIRE_PY)
+    wire_ast = project.parse(WIRE_PY)
+    fallback_src = project.read(FALLBACK_PY)
+
+    for rel, content in [(MESSAGE_H, msg_h), (COMMON_H, common_h),
+                         (MESSAGE_CC, msg_cc), (CONTROLLER_CC, ctrl_cc),
+                         (WIRE_PY, wire_src), (FALLBACK_PY, fallback_src)]:
+        if content is None:
+            findings.append(project.missing(PASS, rel))
+    if None in (msg_h, common_h, msg_cc, ctrl_cc, wire_src, fallback_src) \
+            or wire_ast is None:
+        return findings
+
+    # -- magic numbers and wire version --------------------------------
+    cpp_consts = cppscan.constants(msg_h)
+    py_consts = _py_constants(wire_ast)
+    for cpp_name, py_name in CONSTANT_TWINS.items():
+        if cpp_name not in cpp_consts:
+            findings.append(Finding(
+                PASS, MESSAGE_H, 0, f"const:{cpp_name}",
+                f"constant {cpp_name} not found in message.h"))
+            continue
+        if py_name not in py_consts:
+            findings.append(Finding(
+                PASS, WIRE_PY, 0, f"const:{cpp_name}",
+                f"twin constant {py_name} not found in wire.py"))
+            continue
+        cv = cpp_consts[cpp_name]
+        pv, pline = py_consts[py_name]
+        if cv != pv:
+            findings.append(Finding(
+                PASS, WIRE_PY, pline, f"const:{cpp_name}",
+                f"{py_name}=0x{pv:x} disagrees with "
+                f"{cpp_name}=0x{cv:x} "
+                f"({MESSAGE_H}:{cppscan.const_line(msg_h, cpp_name)})"))
+
+    # -- enum values ----------------------------------------------------
+    cpp_enums = cppscan.enums(common_h)
+    tuples = _py_enum_tuples(wire_ast)
+    py_optype = next((dict(zip(names, range(n)))
+                      for names, n, _ in tuples
+                      if names and not names[0].startswith("RED_")), {})
+    py_redop = next((dict(zip(names, range(n)))
+                     for names, n, _ in tuples
+                     if names and names[0].startswith("RED_")), {})
+
+    def check_enum(cpp_name: str, py_map: Dict[str, int],
+                   to_py: "callable") -> None:
+        cpp_map = cpp_enums.get(cpp_name)
+        if cpp_map is None:
+            findings.append(Finding(
+                PASS, COMMON_H, 0, f"enum:{cpp_name}",
+                f"enum class {cpp_name} not found in common.h"))
+            return
+        if not py_map:
+            findings.append(Finding(
+                PASS, WIRE_PY, 0, f"enum:{cpp_name}",
+                f"Python twin of enum {cpp_name} not found in wire.py"))
+            return
+        for member, val in cpp_map.items():
+            py_name = to_py(member)
+            if py_name not in py_map:
+                findings.append(Finding(
+                    PASS, WIRE_PY, 0, f"enum:{cpp_name}:{member}",
+                    f"{cpp_name}::k{member}={val} has no Python twin "
+                    f"{py_name}"))
+            elif py_map[py_name] != val:
+                findings.append(Finding(
+                    PASS, WIRE_PY, 0, f"enum:{cpp_name}:{member}",
+                    f"{py_name}={py_map[py_name]} disagrees with "
+                    f"{cpp_name}::k{member}={val}"))
+        cpp_twins = {to_py(m) for m in cpp_map}
+        for py_name in py_map:
+            if py_name not in cpp_twins:
+                findings.append(Finding(
+                    PASS, WIRE_PY, 0, f"enum:{cpp_name}:{py_name}",
+                    f"{py_name} has no {cpp_name} member in common.h"))
+
+    check_enum("OpType", py_optype, lambda m: m.upper())
+    check_enum("RedOp", py_redop, lambda m: "RED_" + m.upper())
+
+    dtype_ids = _py_dict(wire_ast, "DTYPE_IDS")
+    cpp_dtypes = cpp_enums.get("DataType")
+    if cpp_dtypes is None:
+        findings.append(Finding(PASS, COMMON_H, 0, "enum:DataType",
+                                "enum class DataType not found in common.h"))
+    elif dtype_ids is None:
+        findings.append(Finding(PASS, WIRE_PY, 0, "enum:DataType",
+                                "DTYPE_IDS dict not found in wire.py"))
+    else:
+        ids, ids_line = dtype_ids
+        for member, val in cpp_dtypes.items():
+            py_name = member.lower()
+            if py_name not in ids:
+                findings.append(Finding(
+                    PASS, WIRE_PY, ids_line, f"enum:DataType:{member}",
+                    f"DataType::k{member}={val} missing from DTYPE_IDS"))
+            elif ids[py_name] != val:
+                findings.append(Finding(
+                    PASS, WIRE_PY, ids_line, f"enum:DataType:{member}",
+                    f"DTYPE_IDS[{py_name!r}]={ids[py_name]} disagrees "
+                    f"with DataType::k{member}={val}"))
+        cpp_names = {m.lower() for m in cpp_dtypes}
+        for py_name in ids:
+            if py_name not in cpp_names:
+                findings.append(Finding(
+                    PASS, WIRE_PY, ids_line, f"enum:DataType:{py_name}",
+                    f"DTYPE_IDS[{py_name!r}] has no DataType member"))
+
+        # element sizes, joined on the dtype id
+        sizes = _py_dict(wire_ast, "DTYPE_SIZES")
+        cpp_sizes, cpp_default = cppscan.datatype_size_map(common_h)
+        if sizes is None:
+            findings.append(Finding(PASS, WIRE_PY, 0, "dtype-sizes",
+                                    "DTYPE_SIZES dict not found in wire.py"))
+        elif not cpp_sizes and cpp_default is None:
+            findings.append(Finding(
+                PASS, COMMON_H, 0, "dtype-sizes",
+                "could not parse DataTypeSize() switch in common.h"))
+        else:
+            sz, sz_line = sizes
+            cpp_by_id = {
+                val: cpp_sizes.get(member, cpp_default)
+                for member, val in cpp_dtypes.items()
+            }
+            if sz != cpp_by_id:
+                findings.append(Finding(
+                    PASS, WIRE_PY, sz_line, "dtype-sizes",
+                    f"DTYPE_SIZES={sz} disagrees with DataTypeSize() "
+                    f"switch {cpp_by_id}"))
+
+    # -- serialized field order ----------------------------------------
+    for cpp_fn, py_fn in ORDER_TWINS.items():
+        cpp_body = cppscan.function_body(msg_cc, cpp_fn)
+        if cpp_body is None:
+            findings.append(Finding(
+                PASS, MESSAGE_CC, 0, f"order:{cpp_fn}",
+                f"serialize function {cpp_fn} not found in message.cc"))
+            continue
+        cpp_seq = cppscan.write_sequence(cpp_body)
+        py_seq = _py_write_sequence(wire_ast, py_fn)
+        if py_seq is None:
+            findings.append(Finding(
+                PASS, WIRE_PY, 0, f"order:{cpp_fn}",
+                f"twin function {py_fn} not found in wire.py"))
+            continue
+        if cpp_seq != py_seq:
+            findings.append(Finding(
+                PASS, WIRE_PY, 0, f"order:{cpp_fn}",
+                f"field order of {py_fn} {py_seq} disagrees with "
+                f"{cpp_fn} {cpp_seq} — serialized byte layout drift"))
+
+    # -- response-cache signature field order --------------------------
+    sig_body = cppscan.function_body(ctrl_cc, "ResponseCache::Signature")
+    if sig_body is None:
+        findings.append(Finding(
+            PASS, CONTROLLER_CC, 0, "signature-order",
+            "ResponseCache::Signature not found in controller.cc"))
+    else:
+        cpp_fields = _signature_fields_cpp(sig_body)
+        py_fields, sig_line = _signature_fields_py(wire_src, wire_ast)
+        if not py_fields:
+            findings.append(Finding(
+                PASS, WIRE_PY, 0, "signature-order",
+                "Entry.signature() not found in wire.py"))
+        elif cpp_fields != py_fields:
+            findings.append(Finding(
+                PASS, WIRE_PY, sig_line, "signature-order",
+                f"Entry.signature() field order {py_fields} disagrees "
+                f"with ResponseCache::Signature {cpp_fields} — cache "
+                "keys would diverge across implementations"))
+
+    # -- message-table key separator -----------------------------------
+    # Both sources spell the separator as the escape `\x01`; match the
+    # raw character sequence so f-strings and char literals both count.
+    if "\\x01" not in ctrl_cc:
+        findings.append(Finding(
+            PASS, CONTROLLER_CC, 0, "table-key-separator",
+            "TableKey '\\x01' separator not found in controller.cc"))
+    if "\\x01" not in fallback_src:
+        findings.append(Finding(
+            PASS, FALLBACK_PY, 0, "table-key-separator",
+            "_table_key '\\x01' separator not found in fallback.py — "
+            "table keys would diverge from the native controller"))
+
+    return findings
