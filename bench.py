@@ -116,6 +116,9 @@ REQUIRED_METRIC_KEYS = (
     "hvtpu_optimizer_nonfinite_skips_total",
     "hvtpu_audit_runs_total",
     "hvtpu_audit_divergences_total",
+    # observability layer (PR 7): arrival-skew histogram — the report's
+    # straggler signal; {count, sum} gives mean skew per collective.
+    "hvtpu_collective_arrival_skew_seconds",
 )
 
 
@@ -152,6 +155,15 @@ def build_report(**fields) -> dict:
     (schema enforced by tests/test_bench_guard.py)."""
     report = dict(fields)
     report["metrics"] = condense_metrics()
+    # Straggler headline: mean cross-rank arrival skew per collective
+    # (rank 0 observes the skew histogram; 0 collectives -> 0.0 mean so
+    # the row is schema-stable even on 1-proc runs).
+    skew = report["metrics"]["hvtpu_collective_arrival_skew_seconds"]
+    report["arrival_skew"] = {
+        "collectives": skew["count"],
+        "mean_seconds": round(skew["sum"] / skew["count"], 6)
+        if skew["count"] else 0.0,
+    }
     return report
 
 

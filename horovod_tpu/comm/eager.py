@@ -55,6 +55,7 @@ from ..core import faults
 from ..core import state as core_state
 from ..core.topology import DCN_AXIS, ICI_AXIS, LDEV_AXIS, PROC_AXIS
 from ..obs import metrics as obs_metrics
+from ..obs import tracing
 from . import spmd
 from . import stall
 from .compression import NoneCompressor
@@ -733,6 +734,15 @@ def allreduce(
     tname = name or f"allreduce.{x.shape}.{x.dtype}"
     if timeline is not None:
         timeline.begin(tname, "ICI_ALLREDUCE")
+    # Sync-plane trace span: opens at dispatch — after
+    # _record_collective, so an injected pre-collective fault delays
+    # the span start and shows up as arrival skew in the merged trace.
+    # Controller-driven dispatches (bypass threads) are already spanned
+    # by the controller's phase chain and must not open a second span
+    # under the same rank-agnostic trace id.
+    traced = tracing.ACTIVE and not stall.bypass_active()
+    if traced:
+        tracing.op_begin(tname, "allreduce", phase=tracing.EXEC)
     try:
         # the descriptor carries the tensor NAME (not just op/shape/
         # dtype): two ranks entering different same-shaped collectives
@@ -790,6 +800,8 @@ def allreduce(
         return _post_collective("allreduce", out,
                                 pset=ps.process_set_id)
     finally:
+        if traced:
+            tracing.op_done(tname, bytes=int(x.nbytes))
         if timeline is not None:
             timeline.end(tname)
 

@@ -71,6 +71,7 @@ from typing import Dict, List, Optional
 
 from ..core import faults
 from ..core.exceptions import HorovodInternalError
+from ..obs import tracing
 from ..obs import metrics as obs_metrics
 
 logger = logging.getLogger("horovod_tpu")
@@ -201,6 +202,14 @@ def bypass_thread():
     _tls.bypass = True
 
 
+def bypass_active() -> bool:
+    """True on threads marked by :func:`bypass_thread`.  The sync data
+    plane consults this before opening its own trace span — controller-
+    driven dispatches are already spanned by the controller's
+    NEGOTIATE/QUEUE/EXEC phases and must not double-trace."""
+    return bool(getattr(_tls, "bypass", False))
+
+
 class SyncStallInspector:
     """Strict mode: per-op rendezvous over the coordination KV."""
 
@@ -212,6 +221,16 @@ class SyncStallInspector:
         self.abort_s = abort_s
         self.gen = generation
         self._seq: Dict[int, int] = {}
+
+    def debug_state(self) -> dict:
+        """/debug provider payload (strict mode has no heartbeats; the
+        per-set sequence counters are the useful live signal)."""
+        return {
+            "mode": "strict",
+            "rank": self.rank,
+            "generation": self.gen,
+            "op_seq_per_set": {str(k): v for k, v in self._seq.items()},
+        }
 
     # -- key helpers --------------------------------------------------
     def _key(self, set_id: int, seq: int, rank: int) -> str:
@@ -288,6 +307,11 @@ class SyncStallInspector:
                     "waited %.1fs; ranks not at the rendezvous: %s",
                     desc, set_id, seq, elapsed, pending,
                 )
+                if tracing.ACTIVE:
+                    tracing.instant(
+                        "stall_warning", collective=desc,
+                        process_set=set_id, op_seq=seq,
+                        waited_s=elapsed, ranks_missing=sorted(pending))
             # back off from a near-spin (normal skew is sub-ms) to a
             # 20ms poll for genuinely late peers
             sleep = min(0.02, sleep * 2 if sleep else 0.0002)
@@ -491,6 +515,25 @@ class AmortizedStallInspector:
             tr = self._tracks.get(str(set_id))
             if tr is not None:
                 tr.inflight = None
+
+    def debug_state(self) -> dict:
+        """/debug provider payload: per-peer heartbeat ages (seconds
+        since each peer's beat number last advanced).  _peer_seen is
+        written only by the heartbeat thread; the snapshot below is an
+        intentional racy read of a dict whose values are immutable
+        tuples."""
+        now = time.monotonic()
+        ages = {str(r): round(now - t, 3)
+                for r, (_b, t) in list(self._peer_seen.items())}
+        return {
+            "mode": "amortized",
+            "rank": self.rank,
+            "generation": self.gen,
+            "heartbeat_s": self.heartbeat_s,
+            "stale_s": self.stale_s,
+            "peer_heartbeat_age_s": ages,
+            "failure": self.failure,
+        }
 
     def stop(self) -> None:
         self._stopped.set()
@@ -699,6 +742,11 @@ class AmortizedStallInspector:
                 "waited %.1fs; ranks not at the rendezvous: %s",
                 desc, sid, op, age, behind,
             )
+            if tracing.ACTIVE:
+                tracing.instant(
+                    "stall_warning", collective=desc, process_set=sid,
+                    op_seq=op, waited_s=age,
+                    ranks_missing=sorted(behind))
 
 
 def _make_inspector(st, cfg):
@@ -750,6 +798,7 @@ def _make_inspector(st, cfg):
             generation=st.init_generation,
         )
     st.sync_stall = insp
+    obs_metrics.register_debug_provider("stall", insp.debug_state)
     return insp
 
 
@@ -1001,6 +1050,7 @@ def stall_guard(fn=None, *, name: Optional[str] = None,
 def stop(st) -> None:
     """Shut down the inspector's background thread (called from
     ``core.state.shutdown``)."""
+    obs_metrics.unregister_debug_provider("stall")
     insp = st.sync_stall
     if isinstance(insp, AmortizedStallInspector):
         try:

@@ -81,6 +81,13 @@ class Config:
     # --- timeline / tracing ---
     timeline_filename: Optional[str] = None
     timeline_mark_cycles: bool = False
+    # directory for per-rank cross-rank trace files (obs/tracing.py);
+    # None disables tracing entirely (the hot-path guard is a single
+    # module-attribute check)
+    trace_dir: Optional[str] = None
+    # KV clock-sync pings per rank at trace install (min-RTT sample
+    # wins; more pings tighten the offset error bound)
+    trace_clock_pings: int = 8
 
     # --- stall inspector ---
     stall_check_disable: bool = False
@@ -169,6 +176,8 @@ class Config:
             uniform_local_size=_env_int("UNIFORM_LOCAL_SIZE", 0),
             timeline_filename=_env_str("TIMELINE"),
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
+            trace_dir=_env_str("TRACE"),
+            trace_clock_pings=_env_int("TRACE_CLOCK_PINGS", 8),
             stall_check_disable=_env_bool("STALL_CHECK_DISABLE", False),
             stall_check_time_seconds=_env_float("STALL_CHECK_TIME_SECONDS", 60.0),
             stall_shutdown_time_seconds=_env_float(

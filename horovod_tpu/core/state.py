@@ -58,6 +58,23 @@ _state = GlobalState()
 _init_lock = threading.Lock()
 
 
+def _job_debug_state() -> dict:
+    """Job identity for the metrics server's /debug endpoint
+    (registered by init(), removed by shutdown())."""
+    import os as _os
+
+    return {
+        "initialized": _state.initialized,
+        "rank": _state.rank,
+        "size": _state.size,
+        "local_rank": _state.local_rank,
+        "local_size": _state.local_size,
+        "init_generation": _state.init_generation,
+        "elastic_generation": int(
+            _os.environ.get("HVTPU_ELASTIC_GENERATION", "0") or 0),
+    }
+
+
 def _coordination_client_active() -> bool:
     """True if jax.distributed is already initialized, checked WITHOUT
     triggering XLA backend initialization (jax.process_count() would)."""
@@ -283,6 +300,36 @@ def init(config: Optional[Config] = None) -> GlobalState:
                 _state.rank,
                 mark_cycles=cfg.timeline_mark_cycles,
             )
+        if cfg.trace_dir:
+            # Cross-rank distributed tracing (obs/tracing.py): per-rank
+            # span files + a KV clock handshake so tools/hvtputrace can
+            # merge them onto one clock.  Any failure disables tracing
+            # rather than failing init.
+            try:
+                from ..obs import tracing as _tracing
+
+                _client = None
+                if _state.size > 1:
+                    try:
+                        from jax._src import distributed as _jd
+
+                        _client = _jd.global_state.client
+                        if _client is not None:
+                            from .retry import resilient_kv
+
+                            _client = resilient_kv(
+                                _client, rank=_state.rank)
+                    except Exception:
+                        _client = None
+                _tracing.install(
+                    cfg.trace_dir, rank=_state.rank, size=_state.size,
+                    client=_client, pings=cfg.trace_clock_pings)
+            except Exception:
+                _logging.getLogger("horovod_tpu").warning(
+                    "distributed tracing disabled: install failed",
+                    exc_info=True)
+        # Live /debug job identity (rank/world/elastic generation).
+        _metrics.register_debug_provider("job", _job_debug_state)
         if cfg.autotune:
             from ..obs.autotune import Autotuner
 
@@ -311,7 +358,22 @@ def shutdown():
             except Exception:
                 pass
             _state.timeline = None
+        # Flush trace files BEFORE the coordination client goes away
+        # (and from _shutdown_at_exit on abnormal exits) so traces
+        # survive; uninstall is idempotent.
+        try:
+            from ..obs import tracing as _tracing
+
+            _tracing.uninstall()
+        except Exception:
+            pass
         _state.autotuner = None
+        try:
+            from ..obs import metrics as _m
+
+            _m.unregister_debug_provider("job")
+        except Exception:
+            pass
         try:
             from ..obs import metrics as _metrics
 

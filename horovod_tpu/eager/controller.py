@@ -48,6 +48,7 @@ from ..core import faults
 from ..core import retry as core_retry
 from ..core.exceptions import HorovodInternalError, HvtpuMismatchError
 from ..obs import metrics as obs_metrics
+from ..obs import tracing
 
 logger = logging.getLogger("horovod_tpu.eager")
 
@@ -89,6 +90,16 @@ _M_MISMATCH = obs_metrics.counter(
     "Error responses for cross-rank tensor-metadata disagreement "
     "(mismatched type/red_op/dtype/shape/root for one tensor name), "
     "surfaced as HvtpuMismatchError on every member rank.")
+_M_ARRIVAL_SKEW = obs_metrics.histogram(
+    "hvtpu_collective_arrival_skew_seconds",
+    "Per-collective spread between the first and last member rank's "
+    "announcement reaching the coordinator (rank 0 only; straggler "
+    "signal, see docs/observability.md).",
+    buckets=(0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0))
+_M_LAST_ARRIVER = obs_metrics.counter(
+    "hvtpu_collective_last_arriver_total",
+    "Times each rank was the LAST member to announce a collective "
+    "(rank 0 only; labeled by the straggling rank).")
 
 #: Error-text marker the controllers (C++ and Python twin, byte-
 #: identical) emit for cross-rank metadata disagreement; used to raise
@@ -618,6 +629,8 @@ class EagerController:
                     daemon=True,
                 )
             self._thread.start()
+            obs_metrics.register_debug_provider(
+                "controller", self.debug_state)
 
     def request_shutdown(self):
         """Announce this rank's shutdown in subsequent cycles WITHOUT
@@ -663,6 +676,7 @@ class EagerController:
                     break
         self._stop.set()
         self._wake.set()
+        obs_metrics.unregister_debug_provider("controller")
         # Close the transport so a cycle thread blocked in a
         # coordination-service get unblocks promptly (TransportClosed).
         self._transport.close()
@@ -782,6 +796,9 @@ class EagerController:
                 # come from the data plane).  Inside the lock: the
                 # cycle thread could otherwise end() before begin().
                 self._timeline.begin(name, f"NEGOTIATE_{kind.upper()}")
+            if tracing.ACTIVE:
+                # Same inside-the-lock ordering argument as above.
+                tracing.op_begin(name, kind)
         self._wake.set()
         self.start()
         return fut
@@ -1121,6 +1138,23 @@ class EagerController:
         _M_PREDICTED.inc()
         return True
 
+    def _drain_arrival_skew(self):
+        """Coordinator only: feed per-op arrival spreads recorded in
+        the message table into the straggler metrics (and, when
+        tracing, an ``arrival_skew`` instant).  The native twin does
+        not record them — getattr-guarded, metric simply stays 0."""
+        if self.rank != 0:
+            return
+        take = getattr(self._ctrl, "take_arrival_skew", None)
+        if take is None:
+            return
+        for name, skew, last in take():
+            _M_ARRIVAL_SKEW.observe(skew)
+            _M_LAST_ARRIVER.inc(rank=str(last))
+            if tracing.ACTIVE:
+                tracing.instant("arrival_skew", tensor=name,
+                                skew_s=skew, last_rank=last)
+
     def _service_once(self) -> bool:
         """Rank-0 coordination service: ingest newly streamed request
         blobs, compute responses, append non-trivial ResponseLists to
@@ -1132,6 +1166,7 @@ class EagerController:
             return False
         self._svc_dirty = False
         resp = self._ctrl.compute_responses()
+        self._drain_arrival_skew()
         rl = wire.parse_response_list(resp)
         tuned = (rl.tuned_fusion_threshold, rl.tuned_cycle_time_us)
         trivial = (not rl.responses and rl.join_last_rank < 0
@@ -1310,6 +1345,15 @@ class EagerController:
                             finished: List[int]):
         """Run (or hand to the pipelined executor) one applied
         ResponseList, then fold in tuning/shutdown signals."""
+        if tracing.ACTIVE and rl.responses:
+            # Negotiation is over for these tensors: they now wait for
+            # executor pickup.  op_phase no-ops for names this rank
+            # does not hold live (responses broadcast to all ranks,
+            # including non-members of the response's process set).
+            for rs in rl.responses:
+                if not rs.error:
+                    for n in rs.tensor_names:
+                        tracing.op_phase(n, tracing.QUEUE)
         if rl.responses or rl.join_last_rank >= 0:
             if self._exec_queue is not None:
                 # pipelined: the executor thread runs the data plane
@@ -1377,6 +1421,7 @@ class EagerController:
         if drained:
             self._note_drained(drained, req)
         resp_blob = self._transport.exchange(self._ctrl, cycle, req)
+        self._drain_arrival_skew()
         finished = self._ctrl.apply_responses(resp_blob)
         rl = wire.parse_response_list(resp_blob)
         active = bool(rl.responses) or drained > 0
@@ -1407,6 +1452,12 @@ class EagerController:
                     "ranks missing %s",
                     s["name"], s["waiting_s"], s["present"], s["missing"],
                 )
+                if tracing.ACTIVE:
+                    tracing.instant(
+                        "stall_warning", tensor=s["name"],
+                        waited_s=s["waiting_s"],
+                        ranks_present=s["present"],
+                        ranks_missing=s["missing"])
             if (self.stall_abort_s > 0
                     and s["waiting_s"] > self.stall_abort_s):
                 obs_metrics.counter("hvtpu_stall_aborts_total").inc()
@@ -1435,12 +1486,58 @@ class EagerController:
                     "(coordinator rank 0 logs which ranks are missing)",
                     name, waited, self.rank,
                 )
+                if tracing.ACTIVE:
+                    tracing.instant(
+                        "stall_warning", tensor=name,
+                        waited_s=waited, rank=self.rank)
             if self.stall_abort_s > 0 and waited > self.stall_abort_s:
                 obs_metrics.counter("hvtpu_stall_aborts_total").inc()
                 raise HorovodInternalError(
                     f"collective {name!r} stalled for {waited:.0f}s on "
                     f"rank {self.rank}"
                 )
+
+    # ---- live introspection (/debug) ----
+    def debug_state(self) -> dict:
+        """JSON-serializable snapshot of live controller state, served
+        by the metrics HTTP server's /debug endpoint (registered at
+        start(), removed at stop())."""
+        with self._lock:
+            queue_depth = len(self._payloads)
+            undrained = self._undrained
+            unscheduled = len(self._unsched)
+            in_flight = sorted(self._by_name)[:64]
+        out: Dict[str, Any] = {
+            "rank": self.rank,
+            "size": self.size,
+            "plane": "streamed" if self._stream else "lockstep",
+            "cycle": self._cycle,
+            "stream_req_idx": self._req_idx,
+            "stream_next_resp": self._next_resp,
+            "queue_depth": queue_depth,
+            "undrained": undrained,
+            "unscheduled": unscheduled,
+            "in_flight_ops": in_flight,
+            "thread_error": (repr(self._thread_error)
+                             if self._thread_error else None),
+            "cache": {"capacity": self._cache_capacity},
+        }
+        # cache_size is a property on the Python twin, a method on the
+        # native controller; tolerate both (and a closing controller).
+        cs = getattr(self._ctrl, "cache_size", None)
+        try:
+            out["cache"]["size"] = int(cs() if callable(cs) else cs)
+        except Exception:
+            pass
+        for attr in ("pending_count", "pending_bytes"):
+            v = getattr(self._ctrl, attr, None)
+            if v is not None and not callable(v):
+                out[attr] = int(v)
+        if self.rank == 0:
+            ps = getattr(self._ctrl, "pending_summary", None)
+            if callable(ps):
+                out["pending_coordination"] = ps()
+        return out
 
     # ---- execution (parity: PerformOperation dispatching to ops/*) ----
     def _zero_payload(self, rs: wire.Response, i: int) -> _Payload:
@@ -1534,6 +1631,8 @@ class EagerController:
         for p in self._take_payloads(rs, strict=False):
             if self._timeline is not None:
                 self._timeline.end(p.name)
+            if tracing.ACTIVE:
+                tracing.op_done(p.name, error=rs.error)
             p.future.set_error(err_cls(rs.error))
 
     def _execute(self, rl: wire.ResponseList, finished: List[int]):
@@ -1561,6 +1660,8 @@ class EagerController:
                 # PerformOperation's error path).
                 for p in payloads:
                     if not p.future.done():
+                        if tracing.ACTIVE:
+                            tracing.op_done(p.name, error=str(e))
                         p.future.set_error(HorovodInternalError(str(e)))
         if rl.join_last_rank >= 0:
             with self._lock:
@@ -1575,12 +1676,20 @@ class EagerController:
         # controller negotiated readiness among exactly those ranks.
         if rs.type == wire.BARRIER:
             for p in payloads:
+                if tracing.ACTIVE:
+                    tracing.op_phase(p.name, tracing.EXEC)
                 eager_comm.barrier(process_set=p.process_set)
                 p.future.set_result(None)
+                if tracing.ACTIVE:
+                    tracing.op_done(p.name)
             return
         if rs.type == wire.ALLREDUCE:
             self._execute_allreduce(rs, payloads)
-        elif rs.type == wire.ALLGATHER:
+            return
+        if tracing.ACTIVE:
+            for p in payloads:
+                tracing.op_phase(p.name, tracing.EXEC)
+        if rs.type == wire.ALLGATHER:
             for p in payloads:
                 p.future.set_result(
                     eager_comm.allgather(p.tensor,
@@ -1606,6 +1715,9 @@ class EagerController:
                 )
         else:  # pragma: no cover
             raise HorovodInternalError(f"unknown response type {rs.type}")
+        if tracing.ACTIVE:
+            for p in payloads:
+                tracing.op_done(p.name, bytes=rs.total_bytes)
 
     def _execute_allreduce(self, rs: wire.Response, payloads: List[_Payload]):
         from ..comm.spmd import _is_int8
@@ -1623,6 +1735,8 @@ class EagerController:
             # Adasum stays per-tensor (scale-invariance is per-tensor);
             # single-tensor responses skip the pack entirely.
             for p in payloads:
+                if tracing.ACTIVE:
+                    tracing.op_phase(p.name, tracing.EXEC)
                 out = eager_comm.allreduce(
                     p.tensor, op=p.rop,
                     prescale_factor=p.prescale,
@@ -1632,6 +1746,8 @@ class EagerController:
                     process_set=p.process_set,
                 )
                 p.future.set_result(out)
+                if tracing.ACTIVE:
+                    tracing.op_done(p.name, bytes=int(p.tensor.nbytes))
             return
         # Fused execution: per-tensor prescale & wire-compression commute
         # with elementwise reduction, so apply them per tensor around ONE
@@ -1639,6 +1755,8 @@ class EagerController:
         # ncclAllReduce -> MemcpyOutFusionBuffer).
         wires, ctxs = [], []
         for p in payloads:
+            if tracing.ACTIVE:
+                tracing.op_phase(p.name, tracing.FUSE)
             t = p.tensor
             if p.prescale != 1.0:
                 t = _apply_scale(t, p.prescale)
@@ -1646,6 +1764,9 @@ class EagerController:
             wires.append(t)
             ctxs.append(ctx)
         flat, _ = pack_flat(wires)
+        if tracing.ACTIVE:
+            for p in payloads:
+                tracing.op_phase(p.name, tracing.EXEC)
         # The fuser only merges responses with equal process_set_id
         # (fallback._fuse / Controller::FuseResponses), so the group's
         # shared set is payloads[0]'s.
@@ -1659,3 +1780,6 @@ class EagerController:
             if p.postscale != 1.0:
                 out = _apply_scale(out, p.postscale)
             p.future.set_result(out)
+            if tracing.ACTIVE:
+                tracing.op_done(p.name, bytes=int(p.tensor.nbytes),
+                                fused=len(payloads))

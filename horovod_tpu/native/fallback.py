@@ -95,6 +95,11 @@ class PyController:
         self._shutdown_ranks: Set[int] = set()
         self._resync_needed = False
         self._process_sets: Dict[int, List[int]] = {0: list(range(size))}
+        # (name, skew_s, last_rank) per released op, drained by the
+        # eager controller into the arrival-skew metrics (bounded:
+        # oldest entries drop if nobody drains, e.g. native twin hosts
+        # or manual tests).
+        self._skew_events: List[Tuple[str, float, int]] = []
 
     # ---- rank-local side ----
     def enqueue(self, seq: int, name: str, op_type: int, red_op: int,
@@ -267,12 +272,17 @@ class PyController:
         key = self._table_key(e)
         pc = self._message_table.get(key)
         if pc is None:
+            # "arrived" (first announcement time per rank) is local
+            # bookkeeping for arrival-skew attribution — not part of
+            # the C++ parity surface.
             self._message_table[key] = {
                 "entry": e, "ranks": {rank}, "first_seen": now,
                 "first_rank": rank, "mismatch": {},
+                "arrived": {rank: now},
             }
             return
         pc["ranks"].add(rank)
+        pc["arrived"].setdefault(rank, now)
         if (rank != pc["first_rank"] and rank not in pc["mismatch"]
                 and not self._same_params(e, pc["entry"])):
             pc["mismatch"][rank] = e
@@ -400,6 +410,13 @@ class PyController:
                           and e.dtype == wire.DTYPE_IDS["int8"]):
                         rs.error = ("int8 wire format does not support "
                                     "joined-rank zero contribution")
+                arrived = pc.get("arrived") or {}
+                if len(arrived) >= 2:
+                    last_rank = max(arrived, key=arrived.get)
+                    skew = max(arrived.values()) - min(arrived.values())
+                    self._skew_events.append((e.name, skew, last_rank))
+                    if len(self._skew_events) > 1024:
+                        del self._skew_events[:-1024]
                 responses.append(rs)
                 del self._message_table[key]
             out.responses = self._fuse(responses)
@@ -531,6 +548,38 @@ class PyController:
 
     def set_fusion_threshold(self, nbytes: int):
         self.fusion_threshold = nbytes
+
+    def take_arrival_skew(self) -> List[Tuple[str, float, int]]:
+        """Drain (name, skew_s, last_rank) events recorded when ops
+        released from the message table (coordinator side only; the
+        eager controller feeds them into the arrival-skew metrics).
+        The native twin has no equivalent — callers getattr-guard."""
+        with self._lock:
+            out, self._skew_events = self._skew_events, []
+            return out
+
+    def pending_summary(self, limit: int = 32) -> List[dict]:
+        """Coordinator's pending-coordination table for the /debug
+        endpoint: which ops are waiting and on whom."""
+        now = time.monotonic()
+        out: List[dict] = []
+        with self._lock:
+            for key in sorted(self._message_table):
+                if len(out) >= limit:
+                    break
+                pc = self._message_table[key]
+                members = self._member_ranks(pc["entry"].process_set_id)
+                present = [r for r in members
+                           if r in pc["ranks"] or r in self._joined_ranks]
+                out.append({
+                    "name": pc["entry"].name,
+                    "process_set_id": pc["entry"].process_set_id,
+                    "waiting_s": round(now - pc["first_seen"], 6),
+                    "ranks_present": present,
+                    "ranks_missing": [r for r in members
+                                      if r not in present],
+                })
+        return out
 
     def check_stalls(self) -> List[dict]:
         now = time.monotonic()

@@ -39,7 +39,7 @@ import logging
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -362,6 +362,44 @@ def note_step(examples: float = 0.0, steps: float = 1.0):
 
 
 # ---------------------------------------------------------------------------
+# live /debug introspection plane
+# ---------------------------------------------------------------------------
+# Subsystems (eager controller, stall inspector, core state) register a
+# zero-argument callable returning a JSON-serializable dict; the HTTP
+# server's /debug route snapshots all of them so "what is my job doing"
+# is one curl away.  A provider that raises is reported in place as an
+# {"error": ...} entry — introspection never takes the endpoint down.
+
+_debug_providers: Dict[str, Callable[[], dict]] = {}
+_debug_lock = threading.Lock()
+
+
+def register_debug_provider(name: str, fn: Callable[[], dict]) -> None:
+    with _debug_lock:
+        _debug_providers[name] = fn
+
+
+def unregister_debug_provider(name: str) -> None:
+    with _debug_lock:
+        _debug_providers.pop(name, None)
+
+
+def debug_snapshot() -> dict:
+    """One coherent-ish dump of every registered provider (each
+    provider snapshots under its own lock; cross-provider skew is the
+    wall time between calls)."""
+    with _debug_lock:
+        items = list(_debug_providers.items())
+    out: dict = {"time_unix": time.time()}
+    for name, fn in items:
+        try:
+            out[name] = fn()
+        except Exception as e:  # noqa: BLE001 — isolate provider faults
+            out[name] = {"error": str(e)}
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Prometheus exposition endpoint
 # ---------------------------------------------------------------------------
 
@@ -373,14 +411,20 @@ _server_lock = threading.Lock()
 def _make_handler(registry: MetricsRegistry):
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 — http.server API
-            if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+            path = self.path.split("?", 1)[0]
+            if path == "/debug":
+                body = json.dumps(
+                    debug_snapshot(), indent=2, default=str,
+                ).encode("utf-8")
+                ctype = "application/json"
+            elif path in ("/", "/metrics"):
+                body = registry.exposition().encode("utf-8")
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
                 self.send_error(404)
                 return
-            body = registry.exposition().encode("utf-8")
             self.send_response(200)
-            self.send_header(
-                "Content-Type",
-                "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

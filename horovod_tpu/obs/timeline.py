@@ -49,7 +49,12 @@ class Timeline:
         self._file = open(filename, "w")
         self._file.write("[\n")
         self._first = True
+        # Monotonic epoch for event timestamps plus the wall-clock
+        # reading taken at the same instant: merge tooling
+        # (tools/hvtputrace) rebases per-rank relative timestamps onto
+        # a shared wall clock via this anchor and the clock offsets.
         self._t0 = time.monotonic()
+        self._wall_t0 = time.time()
         self._open_spans = {}
         self._closed = False
         self._emit(
@@ -60,6 +65,11 @@ class Timeline:
                 "args": {"name": f"hvtpu rank {rank}"},
             }
         )
+
+    @property
+    def wall_t0(self) -> float:
+        """time.time() captured at the trace's ts=0 instant."""
+        return self._wall_t0
 
     @property
     def mark_cycles(self) -> bool:
@@ -80,7 +90,7 @@ class Timeline:
             json.dump(event, self._file)
             self._file.flush()
 
-    def begin(self, tensor_name: str, phase: str):
+    def begin(self, tensor_name: str, phase: str, **args):
         # A tensor entering its next phase before end() closes the
         # previous one (NEGOTIATE -> QUEUE -> ICI_ALLREDUCE) must end
         # that span first — silently overwriting the open-span entry
@@ -96,7 +106,7 @@ class Timeline:
                 "ts": self._now_us(),
                 "pid": self._rank,
                 "tid": hash(tensor_name) % (1 << 31),
-                "args": {"tensor": tensor_name},
+                "args": {"tensor": tensor_name, **args},
             }
         )
 

@@ -130,6 +130,11 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                         "HVTPU_AUTOTUNE_GP_SAMPLES)")
     p.add_argument("--timeline-filename", default=None)
     p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--trace-dir", default=None,
+                   help="enable cross-rank distributed tracing: each "
+                        "worker writes DIR/rank<N>.trace.json (exported "
+                        "as HVTPU_TRACE; merge/report with "
+                        "python -m tools.hvtputrace)")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve Prometheus text-format metrics from each "
                         "worker at http://host:(PORT+local_rank)/metrics "
@@ -313,6 +318,7 @@ def build_worker_env(
             "HVTPU_CYCLE_TIME": args.cycle_time_ms,
             "HVTPU_CACHE_CAPACITY": args.cache_capacity,
             "HVTPU_TIMELINE": args.timeline_filename,
+            "HVTPU_TRACE": args.trace_dir,
             "HVTPU_METRICS_PORT": args.metrics_port,
             "HVTPU_AUTOTUNE_LOG": args.autotune_log,
             "HVTPU_COMPRESSION": args.compression,

@@ -86,6 +86,17 @@ class TestMetricsEmbedding:
         assert "hvtpu_wire_bytes_total" in required
         assert "hvtpu_controller_cycle_seconds" in required
         assert "hvtpu_optimizer_steps_total" in required
+        # PR 7: straggler signal rides in every bench line
+        assert "hvtpu_collective_arrival_skew_seconds" in required
+
+    def test_report_embeds_arrival_skew_summary(self, bench):
+        report = bench.build_report(metric="m", value=1.0, unit="u")
+        skew = report["arrival_skew"]
+        assert set(skew) == {"collectives", "mean_seconds"}
+        # 1-proc run: no multi-rank collectives, schema still stable
+        assert skew["collectives"] == report["metrics"][
+            "hvtpu_collective_arrival_skew_seconds"]["count"]
+        json.dumps(report)
 
 
 class TestTorchStepSchema:

@@ -197,6 +197,30 @@ class TestHttpEndpoint:
         finally:
             metrics.stop_http_server()
 
+    def test_debug_endpoint_round_trip(self):
+        metrics.stop_http_server()
+        metrics.register_debug_provider(
+            "okprov", lambda: {"depth": 3})
+        metrics.register_debug_provider(
+            "badprov", lambda: 1 / 0)
+        port = metrics.start_http_server(0, registry=MetricsRegistry())
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug", timeout=10) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith(
+                    "application/json")
+                dbg = json.loads(r.read().decode())
+            assert dbg["okprov"] == {"depth": 3}
+            # a raising provider is isolated, not a 500
+            assert "error" in dbg["badprov"]
+            assert dbg["time_unix"] > 0
+        finally:
+            metrics.stop_http_server()
+            metrics.unregister_debug_provider("okprov")
+            metrics.unregister_debug_provider("badprov")
+        assert "okprov" not in metrics.debug_snapshot()
+
     def test_serve_from_env_disabled_and_bad_values(self, monkeypatch):
         monkeypatch.delenv("HVTPU_METRICS_PORT", raising=False)
         monkeypatch.delenv("HOROVOD_METRICS_PORT", raising=False)

@@ -320,6 +320,12 @@ class TestFlagPlumbing:
         # unset: never exported, endpoint stays off
         assert "HVTPU_METRICS_PORT" not in self._env_for(["-np", "2"])
 
+    def test_trace_dir_flag(self):
+        env = self._env_for(["-np", "2", "--trace-dir", "/tmp/tr"])
+        assert env["HVTPU_TRACE"] == "/tmp/tr"
+        # unset: never exported, tracing stays off on the workers
+        assert "HVTPU_TRACE" not in self._env_for(["-np", "2"])
+
     def test_env_passthrough_set_and_copy(self):
         env = self._env_for(
             ["-np", "2", "-x", "FOO=bar", "-x", "INHERITED"])

@@ -1,0 +1,269 @@
+"""hvtputrace: merge per-rank hvtpu trace files and attribute stragglers.
+
+Input: a trace directory produced by ``HVTPU_TRACE=<dir>`` (or
+``hvtpurun --trace-dir``) holding one ``rank<N>.trace.json`` Chrome
+trace per rank, each carrying two metadata instants written by
+``horovod_tpu/obs/tracing.py``:
+
+  * ``clock_anchor``  — ``wall_t0_us``: the local wall clock at the
+    file's ``ts=0`` instant
+  * ``clock_offset``  — ``offset_us``: rank0-relative clock offset
+    (add it to a local wall timestamp to get rank-0 time), with its
+    ``error_bound_us`` from the min-RTT NTP-style KV handshake
+
+``merge`` rebases every rank's relative timestamps onto rank 0's
+clock — ``ts_rank0 = wall_t0_us + ts + offset_us − epoch`` — and emits
+one Perfetto/chrome://tracing-loadable JSON array with one process
+lane per rank.
+
+``report`` correlates spans across ranks by their rank-agnostic
+``trace_id`` (``tensor#occurrence``, agreed by the negotiation
+protocol / SPMD program order) and computes per-collective arrival
+skew (who started last, by how much), a per-rank wait-vs-compute
+decomposition, and a top-N straggler table.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+_RANK_FILE_RE = re.compile(r"rank(\d+)\.trace\.json$")
+
+# Span phases that are communication/coordination wait from the
+# submitting rank's perspective (everything else in the trace extent
+# is treated as compute for the wait-vs-compute split).
+_WAIT_PHASES = {"NEGOTIATE", "QUEUE", "FUSE", "EXEC"}
+
+
+def _load_events(path: str) -> List[dict]:
+    """Parse one per-rank trace, tolerating a truncated file (process
+    died before Timeline.close wrote the closing bracket, possibly
+    mid-event).  The writer emits one event per line, so repair drops
+    trailing lines until the remainder closes as a valid array."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        data = None
+        while text:
+            repaired = text.rstrip().rstrip(",")
+            if not repaired.endswith("]"):
+                repaired += "\n]"
+            try:
+                data = json.loads(repaired)
+                break
+            except json.JSONDecodeError:
+                cut = text.rstrip().rfind("\n")
+                if cut <= 0:
+                    raise
+                text = text[:cut]
+    return [e for e in data if isinstance(e, dict)]
+
+
+def load_rank_traces(trace_dir: str) -> Dict[int, List[dict]]:
+    """rank -> event list for every rank<N>.trace.json in the dir."""
+    out: Dict[int, List[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(trace_dir,
+                                              "rank*.trace.json"))):
+        m = _RANK_FILE_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        out[int(m.group(1))] = _load_events(path)
+    if not out:
+        raise FileNotFoundError(
+            f"no rank*.trace.json files in {trace_dir!r} — was the job "
+            "run with HVTPU_TRACE/--trace-dir?")
+    return out
+
+
+def _find_instant(events: List[dict], name: str) -> Optional[dict]:
+    for e in events:
+        if e.get("name") == name and e.get("ph") == "i":
+            return e.get("args", {})
+    return None
+
+
+def clock_metadata(events: List[dict]) -> Tuple[Optional[float],
+                                                Optional[float],
+                                                Optional[float]]:
+    """(wall_t0_us, offset_us, error_bound_us) for one rank's trace.
+    offset_us is None when the KV handshake degraded on that rank."""
+    anchor = _find_instant(events, "clock_anchor") or {}
+    off = _find_instant(events, "clock_offset") or {}
+    return (anchor.get("wall_t0_us"), off.get("offset_us"),
+            off.get("error_bound_us"))
+
+
+def merge(trace_dir: str) -> List[dict]:
+    """Fuse per-rank traces into one event list on rank 0's clock.
+
+    Ranks whose clock_offset degraded to None merge with offset 0 (their
+    lane stays internally consistent but may sit skewed against the
+    others); ranks missing the wall anchor keep raw timestamps.
+    """
+    traces = load_rank_traces(trace_dir)
+    rebased: List[Tuple[int, dict]] = []
+    epochs: List[float] = []
+    per_rank_base: Dict[int, Optional[float]] = {}
+    for rank, events in traces.items():
+        wall_t0_us, offset_us, _err = clock_metadata(events)
+        if wall_t0_us is None:
+            per_rank_base[rank] = None
+            continue
+        base = float(wall_t0_us) + float(offset_us or 0.0)
+        per_rank_base[rank] = base
+        epochs.append(base)
+    epoch = min(epochs) if epochs else 0.0
+    merged: List[dict] = []
+    for rank, events in traces.items():
+        base = per_rank_base[rank]
+        shift = 0.0 if base is None else base - epoch
+        for e in events:
+            e = dict(e)
+            e["pid"] = rank
+            if "ts" in e:
+                e["ts"] = float(e["ts"]) + shift
+            merged.append(e)
+    merged.sort(key=lambda e: e.get("ts", 0.0))
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# attribution analysis
+# ---------------------------------------------------------------------------
+
+def _collect_spans(merged: List[dict]) -> Dict[Tuple[str, int], List[dict]]:
+    """(trace_id, rank) -> completed [{phase, t0, t1}] span list, built
+    by pairing B/E events per (rank, tid) track."""
+    open_by_track: Dict[Tuple[int, int], dict] = {}
+    spans: Dict[Tuple[str, int], List[dict]] = {}
+    for e in merged:
+        ph = e.get("ph")
+        if ph not in ("B", "E"):
+            continue
+        track = (e.get("pid", 0), e.get("tid", 0))
+        if ph == "B":
+            tid = (e.get("args") or {}).get("trace_id")
+            if tid is None:
+                continue
+            open_by_track[track] = {
+                "trace_id": tid,
+                "tensor": (e.get("args") or {}).get("tensor"),
+                "phase": e.get("name"),
+                "t0": float(e.get("ts", 0.0)),
+            }
+        else:
+            sp = open_by_track.pop(track, None)
+            if sp is None:
+                continue
+            sp["t1"] = float(e.get("ts", 0.0))
+            spans.setdefault((sp["trace_id"], track[0]), []).append(sp)
+    return spans
+
+
+def report(trace_dir: str, top: int = 10) -> dict:
+    """Straggler-attribution analysis over a trace directory."""
+    merged = merge(trace_dir)
+    traces = load_rank_traces(trace_dir)
+    spans = _collect_spans(merged)
+
+    # per-collective arrival skew: first span start per (trace_id, rank)
+    arrivals: Dict[str, Dict[int, float]] = {}
+    for (tid, rank), sps in spans.items():
+        arrivals.setdefault(tid, {})[rank] = min(s["t0"] for s in sps)
+    collectives = []
+    last_count: Dict[int, int] = {}
+    skew_sum: Dict[int, float] = {}
+    for tid, by_rank in sorted(arrivals.items()):
+        if len(by_rank) < 2:
+            continue
+        last_rank = max(by_rank, key=by_rank.get)
+        first_rank = min(by_rank, key=by_rank.get)
+        skew_us = by_rank[last_rank] - by_rank[first_rank]
+        last_count[last_rank] = last_count.get(last_rank, 0) + 1
+        skew_sum[last_rank] = skew_sum.get(last_rank, 0.0) + skew_us
+        collectives.append({
+            "trace_id": tid,
+            "ranks": sorted(by_rank),
+            "first_rank": first_rank,
+            "last_rank": last_rank,
+            "arrival_skew_us": round(skew_us, 1),
+        })
+
+    # per-rank wait vs compute: wait = time inside coordination/comm
+    # span phases; compute = rest of that rank's trace extent
+    per_rank: Dict[int, dict] = {}
+    for rank in traces:
+        ts = [float(e["ts"]) for e in merged
+              if e.get("pid") == rank and "ts" in e]
+        extent = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+        wait = sum(
+            s["t1"] - s["t0"]
+            for (tid, r), sps in spans.items() if r == rank
+            for s in sps if s["phase"] in _WAIT_PHASES)
+        wall_t0, offset, err = clock_metadata(traces[rank])
+        per_rank[rank] = {
+            "trace_extent_us": round(extent, 1),
+            "wait_us": round(wait, 1),
+            "compute_us": round(max(extent - wait, 0.0), 1),
+            "wait_fraction": round(wait / extent, 4) if extent else 0.0,
+            "clock_offset_us": offset,
+            "clock_error_bound_us": err,
+        }
+
+    stragglers = sorted(
+        ({"rank": r, "times_last": n,
+          "total_skew_us": round(skew_sum.get(r, 0.0), 1)}
+         for r, n in last_count.items()),
+        key=lambda row: (-row["times_last"], -row["total_skew_us"]),
+    )[:top]
+    return {
+        "trace_dir": trace_dir,
+        "ranks": sorted(traces),
+        "collectives": collectives,
+        "per_rank": per_rank,
+        "stragglers": stragglers,
+    }
+
+
+def render_report(rep: dict) -> str:
+    """Human-readable rendering of report()'s dict."""
+    lines = [f"hvtputrace report — {rep['trace_dir']} "
+             f"(ranks: {rep['ranks']})", ""]
+    lines.append("per-rank wait vs compute:")
+    lines.append(f"  {'rank':>4}  {'extent_ms':>10}  {'wait_ms':>10}  "
+                 f"{'compute_ms':>10}  {'wait%':>6}  {'clk_off_us':>10}")
+    for rank in rep["ranks"]:
+        row = rep["per_rank"][rank]
+        off = row["clock_offset_us"]
+        lines.append(
+            f"  {rank:>4}  {row['trace_extent_us'] / 1e3:>10.2f}  "
+            f"{row['wait_us'] / 1e3:>10.2f}  "
+            f"{row['compute_us'] / 1e3:>10.2f}  "
+            f"{row['wait_fraction'] * 100:>5.1f}%  "
+            f"{'n/a' if off is None else f'{off:.0f}':>10}")
+    lines.append("")
+    lines.append("top stragglers (times last to arrive):")
+    if not rep["stragglers"]:
+        lines.append("  (no multi-rank collectives in trace)")
+    for row in rep["stragglers"]:
+        lines.append(
+            f"  rank {row['rank']}: last {row['times_last']}x, "
+            f"total skew {row['total_skew_us'] / 1e3:.2f} ms")
+    lines.append("")
+    lines.append("slowest collectives by arrival skew:")
+    worst = sorted(rep["collectives"],
+                   key=lambda c: -c["arrival_skew_us"])[:10]
+    if not worst:
+        lines.append("  (none)")
+    for c in worst:
+        lines.append(
+            f"  {c['trace_id']}: rank {c['last_rank']} arrived "
+            f"{c['arrival_skew_us'] / 1e3:.2f} ms after "
+            f"rank {c['first_rank']}")
+    return "\n".join(lines)

@@ -1,0 +1,54 @@
+"""CLI: ``python -m tools.hvtputrace {merge,report} <trace-dir>``."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import merge, render_report, report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="hvtputrace",
+        description="Merge per-rank hvtpu traces (HVTPU_TRACE dirs) "
+                    "into one Perfetto-loadable file and attribute "
+                    "stragglers.")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pm = sub.add_parser(
+        "merge", help="fuse rank*.trace.json into one Chrome-trace "
+                      "JSON on rank 0's clock")
+    pm.add_argument("trace_dir")
+    pm.add_argument("-o", "--output", default=None,
+                    help="output path (default: "
+                         "<trace-dir>/merged.trace.json)")
+
+    pr = sub.add_parser(
+        "report", help="arrival-skew / wait-vs-compute / straggler "
+                       "attribution analysis")
+    pr.add_argument("trace_dir")
+    pr.add_argument("--top", type=int, default=10,
+                    help="straggler table size (default 10)")
+    pr.add_argument("--json", action="store_true",
+                    help="emit the raw report dict as JSON")
+
+    args = p.parse_args(argv)
+    if args.cmd == "merge":
+        events = merge(args.trace_dir)
+        out = args.output or os.path.join(args.trace_dir,
+                                          "merged.trace.json")
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(events, f)
+        print(f"wrote {len(events)} events from "
+              f"{len({e.get('pid') for e in events})} ranks to {out}")
+        return 0
+    rep = report(args.trace_dir, top=args.top)
+    print(json.dumps(rep, indent=2) if args.json else render_report(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
