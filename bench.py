@@ -119,6 +119,12 @@ REQUIRED_METRIC_KEYS = (
     # observability layer (PR 7): arrival-skew histogram — the report's
     # straggler signal; {count, sum} gives mean skew per collective.
     "hvtpu_collective_arrival_skew_seconds",
+    # graceful preemption (PR 8): notice/drain counters and the
+    # drain-commit latency histogram — 0 on a healthy bench run, and a
+    # nonzero count here flags that the round absorbed a preemption.
+    "hvtpu_preempt_notices_total",
+    "hvtpu_elastic_drains_total",
+    "hvtpu_drain_commit_seconds",
 )
 
 

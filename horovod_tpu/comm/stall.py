@@ -70,6 +70,7 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from ..core import faults
+from ..core import preempt
 from ..core.exceptions import HorovodInternalError
 from ..obs import tracing
 from ..obs import metrics as obs_metrics
@@ -294,24 +295,40 @@ class SyncStallInspector:
             if not pending:
                 break
             elapsed = time.monotonic() - start
-            if self.abort_s > 0 and elapsed > self.abort_s:
+            # A rank inside its drain grace window (core/preempt.py) is
+            # late BY DESIGN — it is heading for the drain commit, not
+            # stuck.  Hold the abort and report it as draining; once
+            # the window expires, draining_ranks() empties and normal
+            # abort semantics resume.
+            draining = preempt.draining_ranks() if preempt.PENDING \
+                else {}
+            blamable = [r for r in pending if r not in draining]
+            if self.abort_s > 0 and elapsed > self.abort_s and blamable:
                 _M_ABORTS.inc()
                 raise HorovodInternalError(
                     _stall_abort_msg(desc, set_id, seq, elapsed,
-                                     self.abort_s, pending))
-            if self.warn_s > 0 and elapsed > next_warn:
+                                     self.abort_s, blamable))
+            if self.warn_s > 0 and elapsed > next_warn and not blamable:
+                next_warn += self.warn_s
+                for r in sorted(r for r in pending if r in draining):
+                    logger.info(
+                        "rank %d draining (%.0fs grace remaining); "
+                        "holding the stall abort for [%s] "
+                        "(process set %s, op #%d)",
+                        r, draining.get(r, 0.0), desc, set_id, seq)
+            elif self.warn_s > 0 and elapsed > next_warn:
                 next_warn += self.warn_s
                 _M_WARNINGS.inc()
                 logger.warning(
                     "stalled collective [%s] (process set %s, op #%d): "
                     "waited %.1fs; ranks not at the rendezvous: %s",
-                    desc, set_id, seq, elapsed, pending,
+                    desc, set_id, seq, elapsed, blamable,
                 )
                 if tracing.ACTIVE:
                     tracing.instant(
                         "stall_warning", collective=desc,
                         process_set=set_id, op_seq=seq,
-                        waited_s=elapsed, ranks_missing=sorted(pending))
+                        waited_s=elapsed, ranks_missing=sorted(blamable))
             # back off from a near-spin (normal skew is sub-ms) to a
             # 20ms poll for genuinely late peers
             sleep = min(0.02, sleep * 2 if sleep else 0.0002)
@@ -657,6 +674,7 @@ class AmortizedStallInspector:
         now = time.monotonic()
         fail: Optional[str] = None
         warns: List[tuple] = []
+        drain_notes: List[tuple] = []
         with self._lock:
             if self.failure:
                 return
@@ -703,7 +721,10 @@ class AmortizedStallInspector:
                     want_warn = self.warn_s > 0 and age > tr.next_warn
                     if not (want_abort or want_warn):
                         continue
+                    draining = (preempt.draining_ranks()
+                                if preempt.PENDING else {})
                     behind = []
+                    drain_behind = []
                     for r in tr.members:
                         if r == self.rank or r in bye:
                             # a cleanly-exited rank is never blamed
@@ -719,10 +740,24 @@ class AmortizedStallInspector:
                         # last snapshot showed it caught up: it may
                         # have died mid-collective, after posting
                         if pseq < tr.seq or r in stale:
-                            behind.append(r)
+                            if r in draining:
+                                # inside its drain grace window
+                                # (core/preempt.py): heading for the
+                                # drain commit, not stuck — report,
+                                # don't blame.  The exclusion expires
+                                # with the window, unlike bye.
+                                drain_behind.append(r)
+                            else:
+                                behind.append(r)
                     if not behind:
-                        # everyone dispatched it: a slow collective,
-                        # not a stall
+                        if drain_behind and want_warn:
+                            tr.next_warn = age + self.warn_s
+                            for r in sorted(drain_behind):
+                                drain_notes.append(
+                                    (r, draining.get(r, 0.0),
+                                     tr.inflight, sid))
+                        # everyone (still blamable) dispatched it: a
+                        # slow collective, not a stall
                         continue
                     if want_abort:
                         fail = _stall_abort_msg(
@@ -735,6 +770,11 @@ class AmortizedStallInspector:
             if fail:
                 self.failure = fail
                 _M_ABORTS.inc()
+        for r, rem, desc, sid in drain_notes:
+            logger.info(
+                "rank %d draining (%.0fs grace remaining); holding "
+                "the heartbeat abort for [%s] (process set %s)",
+                r, rem, desc, sid)
         for desc, sid, op, age, behind in warns:
             _M_WARNINGS.inc()
             logger.warning(

@@ -147,6 +147,17 @@ class Config:
     blacklist_cooldown_seconds: float = 300.0
     blacklist_cooldown_max_seconds: float = 3600.0
 
+    # --- graceful preemption / drain (core/preempt.py) ---
+    # signal interpreted as a preemption notice ("" disables the
+    # signal channel; the notice file and fault action still work)
+    preempt_signal: str = "SIGTERM"
+    # optional path polled for a preemption notice (file-based
+    # platforms: metadata probes, node-problem-detector touch files)
+    preempt_notice_file: Optional[str] = None
+    # seconds a preempted worker may spend reaching a drain commit
+    # before force-exiting with the planned-departure code anyway
+    drain_grace_seconds: float = 30.0
+
     # --- fault injection (core/faults.py; docs/robustness.md) ---
     fault_spec: Optional[str] = None
     fault_seed: int = 0
@@ -220,6 +231,9 @@ class Config:
             blacklist_cooldown_max_seconds=_env_float(
                 "BLACKLIST_COOLDOWN_MAX_SECONDS", 3600.0
             ),
+            preempt_signal=_env_str("PREEMPT_SIGNAL", "SIGTERM"),
+            preempt_notice_file=_env_str("PREEMPT_NOTICE_FILE"),
+            drain_grace_seconds=_env_float("DRAIN_GRACE_SECONDS", 30.0),
             fault_spec=_env_str("FAULT_SPEC"),
             fault_seed=_env_int("FAULT_SEED", 0),
             cpu_devices=_env_int("CPU_DEVICES", 0),

@@ -54,6 +54,24 @@ class HostsUpdatedInterrupt(HorovodTpuError):
         self.skip_sync = skip_sync
 
 
+class DrainInterrupt(HostsUpdatedInterrupt):
+    """A member rank is draining after a preemption notice
+    (core/preempt.py); raised on the REMAINING ranks at the agreed
+    drain-commit boundary.
+
+    The drain commit already persisted this step, so the committed
+    state stands — no rollback.  Subclasses
+    :class:`HostsUpdatedInterrupt` so user training loops that catch
+    the parent keep working unchanged; the elastic run wrapper catches
+    this first to count the reset as ``peer_drain``.
+    """
+
+    def __init__(self, rank: int = -1):
+        super().__init__(skip_sync=False)
+        #: rank that announced the departure (-1 if unknown)
+        self.rank = rank
+
+
 class NotInitializedError(HorovodTpuError):
     """An API requiring ``horovod_tpu.init()`` was called before init."""
 

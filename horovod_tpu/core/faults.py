@@ -15,13 +15,16 @@ Grammar (full reference in docs/robustness.md)::
     CLAUSE := SITE ":" ACTION ("@" SEL ("," SEL)*)?
     SITE   := kv.get | kv.put | heartbeat | collective.pre
             | collective.post | worker.step
-    ACTION := drop | delay(MS) | error | kill
+    ACTION := drop | delay(MS) | error | kill | preempt
             | corrupt | corrupt(nan) | corrupt(bitflip)
     SEL    := rank=R[|R...] | pset=ID | count=N | prob=P | times=K
 
 Examples::
 
     worker.step:kill@rank=1,count=3      # rank 1 dies at its 3rd step
+    worker.step:preempt@rank=1,count=3   # rank 1 gets a preemption
+                                         # notice at its 3rd step and
+                                         # drains (core/preempt.py)
     kv.put:error@prob=0.01               # 1% of KV writes fail (seeded)
     heartbeat:drop@rank=0,count=5,times=20   # beats 5..24 suppressed
     collective.pre:delay(250)@rank=2     # rank 2 lags every collective
@@ -35,8 +38,8 @@ Selector semantics:
   counted per process per clause).
 - ``prob=P`` — fire with probability P from a per-``(seed, rank,
   clause)`` RNG, so a given seed reproduces the same fault schedule.
-- ``times=K`` — at most K firings (default: 1 for ``kill``, unlimited
-  otherwise).  Finite ``times`` persist across elastic incarnations
+- ``times=K`` — at most K firings (default: 1 for ``kill`` and
+  ``preempt``, unlimited otherwise).  Finite ``times`` persist across elastic incarnations
   through a marker file under ``HVTPU_FAULT_STATE_DIR`` (defaulting to
   the driver-provided ``HVTPU_ELASTIC_STATE_DIR``), so a relaunched
   worker does not replay a one-shot kill forever.
@@ -66,7 +69,7 @@ logger = logging.getLogger("horovod_tpu")
 SITES = ("kv.get", "kv.put", "heartbeat", "collective.pre",
          "collective.post", "worker.step")
 
-ACTIONS = ("drop", "delay", "error", "kill", "corrupt")
+ACTIONS = ("drop", "delay", "error", "kill", "preempt", "corrupt")
 
 #: Module-level fast path: False means ``inject`` is never entered.
 ACTIVE = False
@@ -182,16 +185,18 @@ def parse_spec(spec: str) -> List[FaultClause]:
             action, delay_ms = "delay", float(m.group(1))
         elif mc:
             action, corrupt_mode = "corrupt", mc.group(1) or "nan"
-        elif action_s in ("drop", "error", "kill"):
+        elif action_s in ("drop", "error", "kill", "preempt"):
             action = action_s
         else:
             raise FaultSpecError(
                 f"fault clause {raw!r}: unknown action {action_s!r} "
-                "(known: drop, delay(MS), error, kill, "
+                "(known: drop, delay(MS), error, kill, preempt, "
                 "corrupt[(nan|bitflip)])")
         ranks = pset = prob = None
         count = 1
-        times = 1 if action == "kill" else 0
+        # one-shot by default: a rank dies (kill) or departs (preempt)
+        # at most once per job unless times= says otherwise
+        times = 1 if action in ("kill", "preempt") else 0
         for sel in filter(None, (s.strip() for s in sel_s.split(","))):
             if "=" not in sel:
                 raise FaultSpecError(
@@ -314,6 +319,15 @@ class FaultRegistry:
             return True
         if fired.action == "error":
             raise InjectedFault(fired, site)
+        if fired.action == "preempt":
+            # deliver a preemption notice instead of dying: the
+            # graceful-drain path (core/preempt.py) takes it from here
+            # — persisted above like kill, so the relaunched rank does
+            # not re-preempt forever.
+            from . import preempt as _preempt
+
+            _preempt.notice("fault")
+            return False
         # kill: flush and hard-exit — simulate a worker dying mid-op
         # (exit 1 = crash, NOT the reset code: the driver must treat
         # this as an unplanned death, exactly like a real one).

@@ -1,0 +1,567 @@
+"""Graceful preemption: coordinated drain, emergency commit, and a
+planned elastic departure.
+
+TPU fleets lose workers to *planned* events (spot/preemptible reclaims,
+maintenance windows) far more often than to crashes.  Without this
+module a SIGTERM'd worker dies mid-collective: peers hit stall aborts,
+the elastic driver burns a restart-budget strike and a blacklist strike
+on a healthy host, and training rolls back to the last periodic commit.
+With it, the preemption notice window is used proactively:
+
+1. **Notice** — the departing rank learns it is going away, from any of
+   three sources: the configured signal (``HVTPU_PREEMPT_SIGNAL``,
+   default SIGTERM), a pollable notice file (``HVTPU_PREEMPT_NOTICE_FILE``,
+   the TPU maintenance-event delivery style), or the fault-injection
+   action ``preempt`` (core/faults.py), which makes the whole path
+   deterministically testable.  The watcher publishes
+   ``hvtdrain/<generation>/notice/<rank>`` through the coordination KV
+   (ResilientKV) so every peer observes the pending departure within
+   one poll.
+
+2. **Drain commit** — at its next commit boundary the departing rank
+   publishes ``plan/<rank> = commit_count + 1``: the commit count every
+   rank must reach before draining.  Commit counts advance in lockstep
+   (the elastic contract), so all ranks reach the agreed boundary
+   together, making an unconditionally *durable* save safe even for
+   collective savers (``ShardedJaxState``).  The one-step lookahead
+   gives peers a full step to learn the plan through the watcher.
+
+3. **Planned exit** — after the drain commit the departing rank exits
+   with :data:`DRAIN_EXIT_CODE` (distinct from the crash and reset
+   codes); peers raise :class:`~.exceptions.DrainInterrupt` so the
+   committed state stands (no rollback).  The elastic driver classifies
+   the exit as a planned departure: no restart-budget strike, no
+   blacklist strike, immediate resize, and the next incarnation resumes
+   from the drain commit — zero lost steps.
+
+The whole exchange is bounded by ``HVTPU_DRAIN_GRACE_SECONDS``: if no
+commit boundary arrives in time, the departing rank force-exits with
+:data:`DRAIN_EXIT_CODE` anyway (the departure stays planned; progress
+since the last durable commit is lost).  During the grace window the
+stall inspectors (comm/stall.py) report "rank N draining" instead of
+firing a heartbeat abort, and the eager controller drains its burst
+gate immediately so in-flight collectives complete before the commit.
+
+Hot-path cost when nothing is draining: one module attribute read
+(:data:`PENDING`), the same idiom as ``faults.ACTIVE``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from ..obs import metrics as obs_metrics
+from ..obs import tracing
+
+logger = logging.getLogger("horovod_tpu")
+
+#: Exit code the elastic driver classifies as a PLANNED departure (no
+#: restart-budget strike, no blacklist strike).  Distinct from the
+#: worker reset code (73), plain crashes, and signal deaths.
+DRAIN_EXIT_CODE = 79
+
+#: Module-level fast path: False means no drain is pending anywhere in
+#: the world as seen by this process — commit boundaries and the eager
+#: burst gate check this single attribute and skip everything else.
+PENDING = False
+
+# KV namespace for the drain protocol; namespaced by the ELASTIC
+# generation (env HVTPU_ELASTIC_GENERATION — identical on every rank of
+# one incarnation, unlike the per-process init counter) so a relaunched
+# world can never read the previous incarnation's markers.
+_NS = "hvtdrain"
+
+# Watcher poll interval.  Deliberately a constant, not a knob: at 0.2s
+# the notice→peer-visibility latency is far below any realistic grace
+# window, and the KV load is one directory read per rank per poll.
+_POLL_S = 0.2
+
+_M_NOTICES = obs_metrics.counter(
+    "hvtpu_preempt_notices_total",
+    "Preemption notices accepted by this rank, by source "
+    "(signal | file | fault | api).")
+_M_DRAIN_COMMIT_S = obs_metrics.histogram(
+    "hvtpu_drain_commit_seconds",
+    "Notice-to-drain-commit latency: how much of the preemption grace "
+    "window the coordinated emergency commit consumed.")
+
+_coord: Optional["_DrainCoordinator"] = None
+_module_lock = threading.Lock()
+
+
+def resolve_signal(name) -> Optional[signal.Signals]:
+    """'SIGTERM' / 'TERM' / '15' -> signal.Signals, None if unknown."""
+    s = str(name or "").strip()
+    if not s:
+        return None
+    if s.isdigit():
+        try:
+            return signal.Signals(int(s))
+        except ValueError:
+            return None
+    s = s.upper()
+    if not s.startswith("SIG"):
+        s = "SIG" + s
+    got = getattr(signal, s, None)
+    return got if isinstance(got, signal.Signals) else None
+
+
+def configured_signal() -> signal.Signals:
+    """The preemption-notice signal (HVTPU_PREEMPT_SIGNAL, default
+    SIGTERM).  Shared with the elastic driver's drain forwarding so
+    both sides always speak the same signal."""
+    sig = resolve_signal(os.environ.get("HVTPU_PREEMPT_SIGNAL"))
+    return sig if sig is not None else signal.SIGTERM
+
+
+class _DrainCoordinator:
+    """Per-process drain state: notice intake, the KV watcher thread,
+    and the commit-boundary agreement protocol."""
+
+    def __init__(self, rank: int, size: int, grace_s: float,
+                 notice_file: Optional[str], generation: int,
+                 client=None):
+        self._kv = client
+        self.rank = rank
+        self.size = size
+        self.grace_s = max(0.5, float(grace_s))
+        self.notice_file = notice_file
+        self.gen = generation
+        self._lock = threading.Lock()
+        # Set from the signal handler WITHOUT the lock (a handler runs
+        # on the main thread between bytecodes; taking a non-reentrant
+        # lock the interrupted frame may hold would deadlock) — plain
+        # attribute writes are atomic under the GIL, and every other
+        # accessor tolerates reading them a poll late.
+        self._departing = False
+        self._reason = ""
+        self._notice_t = 0.0
+        # watcher-thread-only bookkeeping
+        self._notice_posted = False
+        self._grace_timer: Optional[threading.Timer] = None
+        # rank -> first-seen monotonic time of a peer's drain notice
+        self._peer_notices: Dict[int, float] = {}  # hvtpulint: guarded-by(_lock)
+        self._plans: Dict[int, int] = {}  # hvtpulint: guarded-by(_lock)
+        self._plan: Optional[int] = None  # hvtpulint: guarded-by(_lock)
+        self._drained = False  # hvtpulint: guarded-by(_lock)
+        self._wake = threading.Event()
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._watch_loop, name="hvtpu-preempt-watch",
+            daemon=True)
+        self._thread.start()
+
+    # -- notice intake (signal-handler safe) ---------------------------
+    def notice(self, source: str) -> None:
+        """Accept a preemption notice for THIS rank.  Safe to call from
+        a signal handler: flag writes and an Event set only — all KV,
+        metrics, and tracing work happens on the watcher thread."""
+        if self._departing:
+            return
+        self._reason = source
+        self._notice_t = time.monotonic()
+        self._departing = True
+        global PENDING
+        PENDING = True
+        self._wake.set()
+
+    # -- watcher -------------------------------------------------------
+    def _watch_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                self._poll_once()
+            except Exception:
+                # the watcher must never take the job down on its own
+                logger.debug("preempt watcher error", exc_info=True)
+            self._wake.wait(_POLL_S)
+            self._wake.clear()
+
+    def _poll_once(self) -> None:
+        # 1. pollable notice file (TPU maintenance-event delivery)
+        if (not self._departing and self.notice_file
+                and os.path.exists(self.notice_file)):
+            self.notice("file")
+        # 2. publish this rank's departure exactly once
+        if self._departing and not self._notice_posted:
+            self._notice_posted = True
+            _M_NOTICES.inc(source=self._reason)
+            logger.warning(
+                "preemption notice (%s): rank %d draining; coordinating "
+                "an emergency commit within %.0fs grace",
+                self._reason, self.rank, self.grace_s)
+            if tracing.ACTIVE:
+                tracing.instant(
+                    "drain_begin", rank=self.rank, source=self._reason,
+                    grace_s=self.grace_s)
+            self._arm_grace_timer()
+            if self._kv is not None:
+                self._kv.key_value_set(
+                    f"{_NS}/{self.gen}/notice/{self.rank}",
+                    json.dumps({"reason": self._reason,
+                                "grace_s": self.grace_s}))
+        # 3. observe peers' notices and drain plans
+        if self._kv is None or self.size <= 1:
+            return
+        entries = self._dir_entries()
+        now = time.monotonic()
+        newly_seen = []
+        any_peer = False
+        with self._lock:
+            for kind, r, v in entries:
+                if r == self.rank:
+                    continue
+                if kind == "notice":
+                    any_peer = True
+                    if r not in self._peer_notices:
+                        self._peer_notices[r] = now
+                        newly_seen.append(r)
+                elif kind == "plan":
+                    any_peer = True
+                    try:
+                        self._plans[r] = int(v)
+                    except (TypeError, ValueError):
+                        pass
+        for r in newly_seen:
+            logger.warning(
+                "rank %d draining (preemption notice); emergency "
+                "commit at the next agreed step boundary", r)
+        if any_peer:
+            global PENDING
+            PENDING = True
+
+    def _dir_entries(self):
+        """[(kind, rank, value)] under this generation's namespace —
+        one directory read when the client supports it, per-rank
+        try_get fallback otherwise (test fakes, older clients)."""
+        prefix = f"{_NS}/{self.gen}/"
+        out = []
+        dir_get = getattr(self._kv, "key_value_dir_get", None)
+        if dir_get is not None:
+            try:
+                for k, v in dir_get(prefix):
+                    parts = k.rsplit("/", 2)
+                    if len(parts) < 2:
+                        continue
+                    kind, r = parts[-2], parts[-1]
+                    try:
+                        out.append((kind, int(r), v))
+                    except ValueError:
+                        continue
+                return out
+            except Exception:
+                out = []
+        for kind in ("notice", "plan"):
+            for r in range(self.size):
+                if r == self.rank:
+                    continue
+                try:
+                    v = self._kv.key_value_try_get(f"{prefix}{kind}/{r}")
+                except Exception:
+                    v = None
+                if v is not None:
+                    out.append((kind, r, v))
+        return out
+
+    # -- grace bound ---------------------------------------------------
+    def _arm_grace_timer(self) -> None:
+        t = threading.Timer(self.grace_s, self._grace_expired)
+        t.daemon = True
+        t.start()
+        self._grace_timer = t
+
+    def _grace_expired(self) -> None:
+        with self._lock:
+            if self._drained:
+                return
+        # No commit boundary arrived inside the grace window (the loop
+        # may be wedged, or the window was simply too short).  Exit
+        # with the DRAIN code anyway: the departure stays planned (no
+        # budget/blacklist strike), but progress since the last durable
+        # commit is lost — the bounded-grace half of the contract.
+        print(
+            f"hvtpu.preempt: drain grace ({self.grace_s:.0f}s) expired "
+            f"before a commit boundary; rank {self.rank} exiting "
+            f"{DRAIN_EXIT_CODE} without a drain commit (planned "
+            "departure; progress since the last durable commit is "
+            "lost)", file=sys.stderr, flush=True)
+        if tracing.ACTIVE:
+            tracing.instant("drain_exit", rank=self.rank,
+                            committed=False)
+        try:
+            from . import state as core_state
+
+            core_state.shutdown()
+        except Exception:
+            pass
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(DRAIN_EXIT_CODE)
+
+    # -- commit-boundary protocol --------------------------------------
+    def drain_boundary(self, commit_count: int) -> bool:
+        """Called by ``State.commit()`` (via :func:`drain_boundary`)
+        once a drain is pending.  Returns True when THIS commit is the
+        agreed drain commit: every published plan (commit-count target)
+        has been reached.  The departing rank publishes
+        ``commit_count + 1`` on its first boundary after the notice, so
+        peers get one full step — including its collectives — to learn
+        the plan before anyone drains."""
+        post = None
+        with self._lock:
+            if self._drained:
+                return False
+            if self._departing and self._plan is None:
+                self._plan = commit_count + 1
+                post = self._plan
+            plans = dict(self._plans)
+            if self._plan is not None:
+                plans[self.rank] = self._plan
+        if post is not None:
+            logger.warning(
+                "rank %d drain plan: emergency commit at step boundary "
+                "%d", self.rank, post)
+            if self._kv is not None:
+                try:
+                    self._kv.key_value_set(
+                        f"{_NS}/{self.gen}/plan/{self.rank}", str(post))
+                except Exception:
+                    logger.warning(
+                        "could not publish the drain plan; peers will "
+                        "recover through the collective-failure path",
+                        exc_info=True)
+        if not plans or commit_count < min(plans.values()):
+            return False
+        # This is the drain commit: let in-flight eager collectives
+        # finish before the durable save so no negotiation is abandoned
+        # mid-burst (controller.quiesce is a no-op when idle).
+        self._quiesce_controller()
+        return True
+
+    def _quiesce_controller(self) -> None:
+        try:
+            from . import state as core_state
+
+            c = core_state.global_state().controller
+            if c is not None and hasattr(c, "quiesce"):
+                c.quiesce(timeout=min(5.0, self.grace_s / 2))
+        except Exception:
+            logger.debug("pre-drain controller quiesce failed",
+                         exc_info=True)
+
+    def finish_drain(self, commit_count: int) -> None:
+        """After the drain commit persisted: record telemetry, then
+        either exit (departing rank) or raise DrainInterrupt (peers) so
+        the committed state stands without a rollback."""
+        with self._lock:
+            if self._drained:
+                return
+            self._drained = True
+            peer_ranks = sorted(self._peer_notices)
+        departing = self._departing
+        t0 = self._notice_t
+        if not departing:
+            # peers measure from their first observation of any notice
+            with self._lock:
+                t0 = min(self._peer_notices.values(), default=0.0)
+        elapsed = (time.monotonic() - t0) if t0 else 0.0
+        _M_DRAIN_COMMIT_S.observe(elapsed)
+        if tracing.ACTIVE:
+            tracing.instant(
+                "drain_commit", rank=self.rank, commit=commit_count,
+                departing=departing, waited_s=round(elapsed, 3))
+        if self._grace_timer is not None:
+            self._grace_timer.cancel()
+        if departing:
+            print(
+                f"hvtpu.preempt: drain commit done at step boundary "
+                f"{commit_count} ({elapsed:.1f}s after the notice); "
+                f"rank {self.rank} exiting {DRAIN_EXIT_CODE} for a "
+                "planned departure", file=sys.stderr, flush=True)
+            if tracing.ACTIVE:
+                tracing.instant("drain_exit", rank=self.rank,
+                                committed=True)
+            try:
+                from . import state as core_state
+
+                # posts the stall goodbye tombstone and flushes traces
+                # before the coordination client goes away
+                core_state.shutdown()
+            except Exception:
+                pass
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(DRAIN_EXIT_CODE)
+        from .exceptions import DrainInterrupt
+
+        raise DrainInterrupt(
+            rank=peer_ranks[0] if peer_ranks else -1)
+
+    # -- read-side surface ---------------------------------------------
+    def draining_ranks(self) -> Dict[int, float]:
+        """rank -> grace seconds remaining, for every rank currently
+        inside its drain window.  Peer windows are measured from OUR
+        first observation of the notice (clock-skew-free, and slightly
+        generous — the safe direction for holding a stall abort).
+        Entries disappear when the window expires, so normal stall
+        semantics resume if a drain wedges."""
+        now = time.monotonic()
+        out: Dict[int, float] = {}
+        if self._departing:
+            rem = self.grace_s - (now - self._notice_t)
+            if rem > 0:
+                out[self.rank] = rem
+        with self._lock:
+            peers = dict(self._peer_notices)
+        for r, t0 in peers.items():
+            rem = self.grace_s - (now - t0)
+            if rem > 0:
+                out[r] = rem
+        return out
+
+    def debug_state(self) -> dict:
+        draining = self.draining_ranks()
+        with self._lock:
+            plans = dict(self._plans)
+            if self._plan is not None:
+                plans[self.rank] = self._plan
+            drained = self._drained
+        return {
+            "pending": PENDING,
+            "departing": self._departing,
+            "reason": self._reason or None,
+            "drained": drained,
+            "grace_s": self.grace_s,
+            "notice_file": self.notice_file,
+            "plans": {str(r): p for r, p in sorted(plans.items())},
+            "draining_ranks": {str(r): round(rem, 1)
+                               for r, rem in sorted(draining.items())},
+        }
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._wake.set()
+        self._thread.join(timeout=2.0)
+        if self._grace_timer is not None:
+            self._grace_timer.cancel()
+
+
+# -- module surface (what the rest of the framework calls) -------------
+
+def install(cfg, rank: int, size: int, client=None) -> None:
+    """Arm the drain coordinator (called by ``core.state.init`` for
+    elastic jobs): start the watcher, install the preemption-signal
+    handler, and remember the prior disposition for uninstall."""
+    global _coord
+    with _module_lock:
+        if _coord is not None:
+            _uninstall_locked()
+        gen = int(os.environ.get("HVTPU_ELASTIC_GENERATION", "0") or 0)
+        _coord = _DrainCoordinator(
+            rank=rank, size=size,
+            grace_s=getattr(cfg, "drain_grace_seconds", 30.0),
+            notice_file=getattr(cfg, "preempt_notice_file", None),
+            generation=gen, client=client)
+        obs_metrics.register_debug_provider("drain", debug_state)
+        signame = getattr(cfg, "preempt_signal", "SIGTERM")
+        sig = resolve_signal(signame) or signal.SIGTERM
+        coord = _coord
+
+        def handler(signum, frame):
+            coord.notice("signal")
+
+        try:
+            _prev_handler[:] = [sig, signal.signal(sig, handler)]
+        except ValueError:
+            # non-main thread (tests importing under a runner thread):
+            # signal delivery degrades to the notice file / fault
+            # action — worth saying, since a real preemption would
+            # then kill the process with the default disposition.
+            _prev_handler[:] = []
+            logger.warning(
+                "could not install the %s preemption handler "
+                "(signal.signal outside the main thread); preemption "
+                "notices degrade to the notice file / fault action",
+                sig.name)
+
+
+_prev_handler: list = []
+
+
+def _uninstall_locked() -> None:
+    global _coord, PENDING
+    if _coord is not None:
+        _coord.stop()
+        _coord = None
+        try:
+            obs_metrics.unregister_debug_provider("drain")
+        except Exception:
+            pass
+    if _prev_handler:
+        sig, prev = _prev_handler
+        _prev_handler[:] = []
+        try:
+            signal.signal(sig, prev)
+        except (ValueError, TypeError):
+            pass
+    PENDING = False
+
+
+def uninstall() -> None:
+    with _module_lock:
+        _uninstall_locked()
+
+
+def installed() -> bool:
+    return _coord is not None
+
+
+def notice(source: str = "api") -> None:
+    """Deliver a preemption notice to this rank programmatically (the
+    ``preempt`` fault action and tests use this)."""
+    coord = _coord
+    if coord is None:
+        logger.warning(
+            "preemption notice (%s) ignored: the drain coordinator is "
+            "not installed (non-elastic job, or before init)", source)
+        return
+    coord.notice(source)
+
+
+def drain_boundary(commit_count: int) -> bool:
+    """True when this commit boundary is the agreed drain commit.
+    Callers guard on :data:`PENDING` first (hot path)."""
+    coord = _coord
+    if coord is None:
+        return False
+    return coord.drain_boundary(commit_count)
+
+
+def finish_drain(commit_count: int) -> None:
+    """Complete the drain after the commit persisted: the departing
+    rank exits :data:`DRAIN_EXIT_CODE`; peers raise DrainInterrupt."""
+    coord = _coord
+    if coord is not None:
+        coord.finish_drain(commit_count)
+
+
+def draining_ranks() -> Dict[int, float]:
+    """rank -> remaining grace seconds for ranks currently draining
+    (stall inspectors report these instead of blaming them)."""
+    coord = _coord
+    if coord is None:
+        return {}
+    return coord.draining_ranks()
+
+
+def debug_state() -> dict:
+    coord = _coord
+    if coord is None:
+        return {"pending": PENDING, "installed": False}
+    return coord.debug_state()

@@ -328,6 +328,35 @@ def init(config: Optional[Config] = None) -> GlobalState:
                 _logging.getLogger("horovod_tpu").warning(
                     "distributed tracing disabled: install failed",
                     exc_info=True)
+        if cfg.elastic:
+            # Graceful-preemption watcher (core/preempt.py): catch the
+            # configured preemption signal / notice file and run the
+            # coordinated drain protocol over the coordination KV.
+            # Failure degrades to plain SIGTERM death, not a broken
+            # init.
+            try:
+                from . import preempt as _preempt
+
+                _pclient = None
+                if _state.size > 1:
+                    try:
+                        from jax._src import distributed as _jd
+
+                        _pclient = _jd.global_state.client
+                        if _pclient is not None:
+                            from .retry import resilient_kv
+
+                            _pclient = resilient_kv(
+                                _pclient, rank=_state.rank)
+                    except Exception:
+                        _pclient = None
+                _preempt.install(
+                    cfg, rank=_state.rank, size=_state.size,
+                    client=_pclient)
+            except Exception:
+                _logging.getLogger("horovod_tpu").warning(
+                    "graceful preemption disabled: install failed",
+                    exc_info=True)
         # Live /debug job identity (rank/world/elastic generation).
         _metrics.register_debug_provider("job", _job_debug_state)
         if cfg.autotune:
@@ -378,6 +407,15 @@ def shutdown():
             from ..obs import metrics as _metrics
 
             _metrics.stop_http_server()
+        except Exception:
+            pass
+        # Stop the preemption watcher before the client goes away (its
+        # poll loop reads the coordination KV); uninstall is idempotent
+        # and restores the previous signal handler.
+        try:
+            from . import preempt as _preempt
+
+            _preempt.uninstall()
         except Exception:
             pass
         # The stall inspector's stop posts a goodbye tombstone over the

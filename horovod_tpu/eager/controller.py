@@ -45,6 +45,7 @@ from ..comm.compression import NoneCompressor
 from ..comm.packing import pack_flat, unpack_flat
 from ..comm.reduce_ops import ReduceOp
 from ..core import faults
+from ..core import preempt
 from ..core import retry as core_retry
 from ..core.exceptions import HorovodInternalError, HvtpuMismatchError
 from ..obs import metrics as obs_metrics
@@ -631,6 +632,23 @@ class EagerController:
             self._thread.start()
             obs_metrics.register_debug_provider(
                 "controller", self.debug_state)
+
+    def quiesce(self, timeout: float = 5.0) -> bool:
+        """Wait until this rank has no queued or in-flight eager ops —
+        the pre-drain-commit barrier for core/preempt.py: the drain
+        commit must not race collectives still being negotiated or
+        executed.  Returns True when the controller went idle within
+        ``timeout`` (immediately true when already idle)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                idle = not self._payloads and self._undrained == 0
+            if idle:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            self._wake.set()
+            time.sleep(0.01)
 
     def request_shutdown(self):
         """Announce this rank's shutdown in subsequent cycles WITHOUT
@@ -1311,12 +1329,18 @@ class EagerController:
                 undrained = self._undrained
                 last_t = self._last_enqueue_t
             now = time.monotonic()
+            # A pending drain (core/preempt.py) must not wait out the
+            # burst gate: drain whatever is queued NOW so in-flight
+            # collectives finish before the drain commit's grace
+            # window burns down.
             if expected > 0:
                 if (undrained == 0 or undrained >= expected
-                        or now >= deadline or self._stop.is_set()):
+                        or now >= deadline or self._stop.is_set()
+                        or preempt.PENDING):
                     break
             elif (undrained == 0 or now - last_t >= quiesce
-                    or now >= deadline or self._stop.is_set()):
+                    or now >= deadline or self._stop.is_set()
+                    or preempt.PENDING):
                 break
             time.sleep(min(quiesce / 2, max(deadline - now, 1e-4)))
 

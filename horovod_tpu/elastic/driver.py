@@ -36,6 +36,7 @@ from ..runner.launch import (
     ssh_options_from_args,
     uniform_local_size,
 )
+from ..core.preempt import DRAIN_EXIT_CODE, configured_signal
 from .discovery import HostDiscoveryScript, HostManager
 from .worker import RESET_EXIT_CODE
 
@@ -69,6 +70,11 @@ _M_BUDGET_LEFT = obs_metrics.gauge(
     "hvtpu_elastic_restart_budget_remaining",
     "Relaunches left before the driver declares the workload "
     "crash-looping and fails fast (-1 = unlimited).")
+_M_DRAINS = obs_metrics.counter(
+    "hvtpu_elastic_drains_total",
+    "Planned departures (DRAIN_EXIT_CODE exits after a graceful drain, "
+    "core/preempt.py) the driver resized around WITHOUT charging the "
+    "restart budget or a blacklist strike.")
 
 _TERM_CODES = (-signal.SIGTERM, 128 + signal.SIGTERM)
 # SIGUSR1 arriving before the worker installed its handler kills the
@@ -94,6 +100,7 @@ class ElasticDriver:
         max_restarts: int = -1,
         restart_window: float = 0.0,
         blacklist_cooldown: Optional[float] = None,
+        drain_grace: Optional[float] = None,
     ):
         self.command = command
         self.hosts = HostManager(discovery,
@@ -111,6 +118,18 @@ class ElasticDriver:
         self.restart_window = restart_window
         self._restart_times: List[float] = []
         self._last_crash_summary = ""
+        # drain grace: how long workers get to reach the coordinated
+        # drain commit after the driver forwards a preemption notice
+        # (SIGTERM to hvtpurun) — always applied BEFORE terminate()'s
+        # SIGTERM/SIGKILL escalation, so the kill grace can never
+        # undercut the drain grace.
+        if drain_grace is None:
+            drain_grace = float(
+                os.environ.get("HVTPU_DRAIN_GRACE_SECONDS", "30")
+                or 30)
+        self.drain_grace = drain_grace
+        self._drain_requested = False
+        self._drain_forwarded = False
         # durable-commit location: explicit arg > caller's env (a user
         # pointing commits at a persistent/shared filesystem) > fresh
         # temp dir owned — and cleaned up on success — by this driver
@@ -220,6 +239,29 @@ class ElasticDriver:
 
     def run(self) -> int:
         """Main loop (parity: ElasticDriver.start + _run_elastic)."""
+        # Driver-level preemption: a SIGTERM to hvtpurun itself means
+        # the WHOLE job is being reclaimed — flag it and let
+        # _supervise forward a drain to the workers first (handler is
+        # flag-only: no locks, no I/O).
+        prev_term = None
+
+        def _term_handler(signum, frame):
+            self._drain_requested = True
+
+        try:
+            prev_term = signal.signal(signal.SIGTERM, _term_handler)
+        except ValueError:
+            pass  # non-main thread (tests): no driver-side drain
+        try:
+            return self._run_loop()
+        finally:
+            if prev_term is not None:
+                try:
+                    signal.signal(signal.SIGTERM, prev_term)
+                except ValueError:
+                    pass
+
+    def _run_loop(self) -> int:
         _M_BUDGET_LEFT.set(self.max_restarts
                            if self.max_restarts >= 0 else -1)
         while True:
@@ -257,6 +299,17 @@ class ElasticDriver:
                 return 0
             if outcome == "failed":
                 return 1
+            if outcome == "term":
+                # whole-job preemption (driver got SIGTERM): workers
+                # drained; propagate the conventional signal code
+                return 128 + int(signal.SIGTERM)
+            if outcome == "drain":
+                # planned departure: resize immediately with NO
+                # restart-budget charge — that budget exists to catch
+                # crash loops, and a graceful drain is the opposite of
+                # a crash.
+                _M_DRAINS.inc()
+                continue
             # outcome == "restart": loop around, re-discover, relaunch
             # — unless the restart budget says this workload is
             # crash-looping and relaunching forever helps nobody.
@@ -294,31 +347,77 @@ class ElasticDriver:
         )
         return False
 
+    def _forward_drain(self, workers):
+        """Forward the preemption notice to every live worker (pid,
+        not pgid: the worker's own handler starts the drain; its
+        children follow at terminate())."""
+        sig = configured_signal()
+        self._log(
+            f"driver preempted (SIGTERM); forwarding {sig.name} drain "
+            f"to workers with {self.drain_grace:.0f}s grace before "
+            "terminate escalation")
+        for w in workers:
+            if w.poll() is None:
+                try:
+                    os.kill(w.proc.pid, sig)
+                except ProcessLookupError:
+                    pass
+
     def _supervise(self, workers, slots) -> str:
-        """Watch one incarnation. Returns 'done' | 'restart' | 'failed'."""
+        """Watch one incarnation.
+        Returns 'done' | 'restart' | 'drain' | 'term' | 'failed'."""
         notified = False
+        drain_deadline = None
         while True:
             time.sleep(self.interval)
+            # 0. driver-level preemption: forward the drain FIRST and
+            # give workers the full drain grace to reach the commit;
+            # only then escalate through terminate()'s SIGTERM/SIGKILL
+            # — the kill grace can never undercut the drain grace.
+            if self._drain_requested and not self._drain_forwarded:
+                self._drain_forwarded = True
+                drain_deadline = time.monotonic() + self.drain_grace
+                self._forward_drain(workers)
             # 1. check worker exits
-            running, done_ok, reset_req, crashed = [], [], [], []
+            running, done_ok, reset_req, crashed, drained = \
+                [], [], [], [], []
             for w in workers:
                 code = w.poll()
                 if code is None:
                     running.append(w)
                 elif code == 0:
                     done_ok.append(w)
+                elif code == DRAIN_EXIT_CODE:
+                    # graceful drain after a preemption notice: a
+                    # PLANNED departure, never a crash
+                    drained.append(w)
                 elif code == RESET_EXIT_CODE or code in _USR1_CODES:
                     reset_req.append(w)
-                elif code in _TERM_CODES and notified:
+                elif code in _TERM_CODES and (notified
+                                              or self._drain_forwarded):
                     reset_req.append(w)
                 else:
                     crashed.append((w, code))
             _M_WORKERS.set(len(running))
+            if self._drain_forwarded:
+                # whole-job preemption: wait out the drain, then stop
+                if not running:
+                    return "term"
+                if time.monotonic() >= drain_deadline:
+                    for w in workers:
+                        w.terminate()
+                    for w in workers:
+                        try:
+                            w.wait(timeout=10)
+                        except Exception:
+                            pass
+                    return "term"
+                continue
             if not running:
-                if crashed or reset_req:
+                if crashed or reset_req or drained:
                     return self._finish_incarnation(workers, slots, crashed)
                 return "done"
-            if crashed or reset_req:
+            if crashed or reset_req or drained:
                 # A peer is gone: remaining workers would stall in
                 # collectives. Tell them to reset at the commit
                 # boundary, then escalate to SIGTERM.
@@ -380,6 +479,19 @@ class ElasticDriver:
                 w.wait(timeout=10)
             except Exception:
                 pass
+        # Classify AFTER the grace wait: the drain exit (the departing
+        # rank's DRAIN_EXIT_CODE) often lands a poll tick after its
+        # peers' reset exits, and a poll-time snapshot would misfile
+        # the planned departure as a budget-charged restart.
+        drained = [w for w in workers if w.poll() == DRAIN_EXIT_CODE]
+        if drained and not crashed:
+            ranks = sorted(w.rank for w in drained)
+            print(
+                f"hvtpu.elastic: planned departure: rank(s) {ranks} "
+                f"drained (exit {DRAIN_EXIT_CODE}); resizing without "
+                "a restart-budget or blacklist strike",
+                file=sys.stderr, flush=True)
+            return "drain"
         return "restart"
 
 
@@ -398,6 +510,7 @@ def run_elastic_driver(args: argparse.Namespace
         restart_window = float(
             os.environ.get("HVTPU_RESTART_WINDOW_SECONDS", "0"))
     blacklist_cooldown = getattr(args, "blacklist_cooldown", None)
+    drain_grace = getattr(args, "drain_grace", None)
     driver = ElasticDriver(
         command=args.command,
         discovery=discovery,
@@ -413,6 +526,7 @@ def run_elastic_driver(args: argparse.Namespace
         max_restarts=max_restarts,
         restart_window=restart_window,
         blacklist_cooldown=blacklist_cooldown,
+        drain_grace=drain_grace,
     )
     return driver.run(), driver
 

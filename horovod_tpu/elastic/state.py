@@ -100,7 +100,19 @@ class State:
 
         _worker.note_step()
         self._commit_count += 1
+        # Graceful drain (core/preempt.py): with a preemption notice
+        # pending somewhere in the world, ask whether THIS boundary is
+        # the agreed drain commit.  The boundary is a commit-count
+        # agreement (min over published plans) and commit counts
+        # advance in lockstep, so — unlike the SIGUSR1 promotion below
+        # — forcing even a COLLECTIVE durable save is safe here.
+        from ..core import preempt as _preempt
+
+        drain_now = _preempt.PENDING \
+            and _preempt.drain_boundary(self._commit_count)
         durable = self._commit_count % self._durable_every == 0
+        if drain_now:
+            durable = True
         if not durable and self._host_messages.flag \
                 and not self._DURABLE_IS_COLLECTIVE:
             # a membership change is about to interrupt this commit —
@@ -124,6 +136,11 @@ class State:
         n = core_audit.audit_every()
         if n > 0 and self._commit_count % n == 0:
             self.audit("elastic.commit")
+        if drain_now:
+            # the drain commit persisted: the departing rank exits
+            # DRAIN_EXIT_CODE here; peers raise DrainInterrupt (the
+            # committed state stands — no rollback)
+            _preempt.finish_drain(self._commit_count)
         self.check_host_updates()
 
     def check_host_updates(self):

@@ -26,7 +26,8 @@ import logging
 
 from ..core import faults
 from ..core import state as core_state
-from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..core.exceptions import (DrainInterrupt, HorovodInternalError,
+                               HostsUpdatedInterrupt)
 from ..obs import metrics as obs_metrics
 from .state import State, _HostUpdateFlag
 
@@ -38,7 +39,7 @@ logger = logging.getLogger("horovod_tpu")
 _M_RESETS = obs_metrics.counter(
     "hvtpu_elastic_worker_resets_total",
     "World-reset requests issued by this worker, by reason "
-    "(collective_failure | hosts_updated).")
+    "(collective_failure | hosts_updated | peer_drain).")
 _M_SIGUSR1_FAILED = obs_metrics.counter(
     "hvtpu_elastic_sigusr1_install_failed_total",
     "Failed attempts to install the driver-notification (SIGUSR1) "
@@ -143,6 +144,15 @@ def run(func):
             _M_RESETS.inc(reason="collective_failure")
             state.restore()
             _exit_for_reset("collective failure")
+        except DrainInterrupt as e:
+            # A peer drained after a preemption notice
+            # (core/preempt.py): the drain commit already persisted
+            # this step, so NO restore — the next incarnation resumes
+            # from it with zero lost steps.  Must precede the parent
+            # HostsUpdatedInterrupt handler.
+            _M_RESETS.inc(reason="peer_drain")
+            _exit_for_reset(
+                f"peer drain (rank {e.rank} departing, planned)")
         except HostsUpdatedInterrupt:
             _M_RESETS.inc(reason="hosts_updated")
             _exit_for_reset("hosts updated")

@@ -19,6 +19,7 @@ from __future__ import annotations
 import argparse
 import os
 import shlex
+import signal
 import socket
 import sys
 import threading
@@ -182,6 +183,20 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                    help="seconds a host stays blacklisted after its "
                         "first strike; doubles per strike "
                         "(HVTPU_BLACKLIST_COOLDOWN_SECONDS, default 300)")
+    # graceful preemption / drain (core/preempt.py; docs/robustness.md)
+    p.add_argument("--drain-grace", type=float, default=None,
+                   dest="drain_grace",
+                   help="seconds a preempted worker may spend reaching "
+                        "a drain commit before it force-exits; also how "
+                        "long the driver waits after forwarding a drain "
+                        "(HVTPU_DRAIN_GRACE_SECONDS, default 30)")
+    p.add_argument("--preempt-notice-file", default=None,
+                   dest="preempt_notice_file",
+                   help="path workers poll for a preemption notice; "
+                        "creating it triggers a coordinated drain, for "
+                        "platforms that announce preemption via files "
+                        "or metadata probes instead of signals "
+                        "(HVTPU_PREEMPT_NOTICE_FILE)")
     # fault injection (core/faults.py; docs/robustness.md)
     p.add_argument("--fault-spec", default=None,
                    help="deterministic fault-injection spec exported "
@@ -335,6 +350,9 @@ def build_worker_env(
             "HVTPU_NONFINITE_ACTION":
                 getattr(args, "nonfinite_action", None),
             "HVTPU_ELASTIC_TIMEOUT": args.elastic_timeout,
+            "HVTPU_DRAIN_GRACE_SECONDS": getattr(args, "drain_grace", None),
+            "HVTPU_PREEMPT_NOTICE_FILE":
+                getattr(args, "preempt_notice_file", None),
             "HVTPU_START_TIMEOUT": args.start_timeout,
             "HVTPU_AUTOTUNE_WARMUP_SAMPLES": args.autotune_warmup_samples,
             "HVTPU_AUTOTUNE_STEPS_PER_SAMPLE":
@@ -490,9 +508,40 @@ def launch_workers(
             file=sys.stderr,
         )
 
-    return safe_shell_exec.wait_for_any_failure_or_all_done(
-        workers, timeout=job_timeout, on_failure=_on_failure
-    )
+    # Launcher SIGTERM (scheduler preemption of hvtpurun itself)
+    # forwards the configured preemption signal to every live worker
+    # so they run the coordinated drain protocol (core/preempt.py)
+    # instead of dying to the escalation path's killpg — the workers'
+    # own SIGTERM handler publishes the drain notice; the escalation
+    # timer only starts after this wait returns.
+    def _forward_preempt(signum, frame):
+        from ..core.preempt import configured_signal
+
+        fwd = configured_signal()
+        for w in workers:
+            if w.poll() is None and fwd is not None:
+                try:
+                    os.kill(w.proc.pid, fwd)
+                except (ProcessLookupError, OSError):
+                    pass
+        print("hvtpurun: SIGTERM received; forwarded preemption "
+              "notice to workers (coordinated drain)", file=sys.stderr)
+
+    prev_term = None
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _forward_preempt)
+    except ValueError:
+        pass  # non-main thread: no forwarding, escalation path only
+    try:
+        return safe_shell_exec.wait_for_any_failure_or_all_done(
+            workers, timeout=job_timeout, on_failure=_on_failure
+        )
+    finally:
+        if prev_term is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_term)
+            except ValueError:
+                pass
 
 
 def _check_build() -> int:

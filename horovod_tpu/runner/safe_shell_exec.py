@@ -19,6 +19,25 @@ from typing import Callable, Dict, List, Optional, Sequence
 GRACEFUL_TERMINATION_TIME_S = 5.0
 
 
+def term_grace_s() -> float:
+    """Seconds between SIGTERM and the SIGKILL escalation when the
+    launcher terminates a worker (HVTPU_TERM_GRACE_SECONDS, default 5).
+
+    Raise it together with HVTPU_DRAIN_GRACE_SECONDS: a worker handed a
+    preemption notice (core/preempt.py) needs the kill grace to at
+    least cover the drain grace, or the escalation SIGKILL lands
+    mid-drain-commit and downgrades a planned departure to a crash.
+    Read per call so tests and long-lived drivers pick up changes."""
+    raw = os.environ.get("HVTPU_TERM_GRACE_SECONDS")
+    if not raw:
+        return GRACEFUL_TERMINATION_TIME_S
+    try:
+        val = float(raw)
+    except ValueError:
+        return GRACEFUL_TERMINATION_TIME_S
+    return val if val > 0 else GRACEFUL_TERMINATION_TIME_S
+
+
 def _pump(stream, sink, prefix: str, lock: threading.Lock):
     """Forward ``stream`` to ``sink`` line-by-line with a rank prefix
     (parity: the '[1]<stdout>:' piping threads of launch_gloo)."""
@@ -99,16 +118,21 @@ class WorkerProcess:
             except Exception:
                 pass
 
-    def terminate(self):
+    def terminate(self, grace_s: Optional[float] = None):
         """SIGTERM the worker's process group, escalate to SIGKILL after
-        the graceful window (parity: safe_shell_exec terminate path)."""
+        the graceful window (parity: safe_shell_exec terminate path).
+        ``grace_s`` overrides HVTPU_TERM_GRACE_SECONDS for one call —
+        the elastic driver passes its drain grace so a draining worker
+        is never killed before its drain window expires."""
         if self.proc.poll() is not None:
             return
         try:
             os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
             return
-        deadline = time.monotonic() + GRACEFUL_TERMINATION_TIME_S
+        if grace_s is None:
+            grace_s = term_grace_s()
+        deadline = time.monotonic() + grace_s
         while time.monotonic() < deadline:
             if self.proc.poll() is not None:
                 return
@@ -158,7 +182,7 @@ def wait_for_any_failure_or_all_done(
         w.terminate()
     for w in workers:
         try:
-            w.proc.wait(timeout=GRACEFUL_TERMINATION_TIME_S * 2)
+            w.proc.wait(timeout=term_grace_s() * 2)
         except subprocess.TimeoutExpired:
             pass
         w.join_pumps()

@@ -337,10 +337,11 @@ class TorchEstimator(HorovodEstimator):
                     # non-weight-looking name (focal's `gamma`, say)
                     # probably means the weight batch is about to bind
                     # to a hyperparameter and train silently wrong —
-                    # say so, naming the parameter.
-                    import warnings
-
-                    warnings.warn(
+                    # say so, naming the parameter.  HVTPU_SPARK_STRICT
+                    # upgrades the warning to a hard error for
+                    # pipelines that would rather fail at fit() than
+                    # risk a silently misweighted model.
+                    msg = (
                         f"sample_weight_col is set and loss "
                         f"{getattr(fn, '__name__', fn)!r} will receive "
                         f"the per-sample weight batch as its third "
@@ -348,7 +349,19 @@ class TorchEstimator(HorovodEstimator):
                         "does not look like a weight parameter — if "
                         f"{third.name!r} is a hyperparameter, bind it "
                         "with functools.partial and accept "
-                        "(output, label, sample_weight) instead",
+                        "(output, label, sample_weight) instead")
+                    strict = os.environ.get(
+                        "HVTPU_SPARK_STRICT", "").lower()
+                    if strict not in ("", "0", "false", "no"):
+                        raise ValueError(
+                            msg + " (raised because HVTPU_SPARK_STRICT "
+                            "is set; unset it to downgrade this to a "
+                            "warning)")
+                    import warnings
+
+                    warnings.warn(
+                        msg + " (set HVTPU_SPARK_STRICT=1 to make this "
+                        "an error)",
                         stacklevel=2)
         lw = self.getLossWeights()
         if lw is not None:

@@ -1,0 +1,408 @@
+"""Graceful preemption (core/preempt.py): coordinated drain, emergency
+commit, and planned elastic resize.
+
+Unit tests drive the drain coordinator over a fake KV client (notice
+intake, the commit-boundary agreement protocol, the stall-inspector
+exclusion, the launcher's kill-grace knob); the acceptance smokes
+launch REAL 2-process elastic jobs where the `preempt` fault action
+delivers a notice to one rank and assert (a) every rank reaches the
+drain commit, the departing rank exits DRAIN_EXIT_CODE, and the driver
+resizes with ZERO restart-budget/blacklist strikes even under
+``--max-restarts 0``, and (b) a `preempt` and a `kill` in the same job
+are classified differently — only the kill charges the budget.
+"""
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import horovod_tpu
+from horovod_tpu.core import faults, preempt
+from horovod_tpu.core.exceptions import (DrainInterrupt,
+                                         HostsUpdatedInterrupt)
+from horovod_tpu.core.preempt import (DRAIN_EXIT_CODE, _DrainCoordinator,
+                                      configured_signal, resolve_signal)
+from horovod_tpu.elastic.worker import RESET_EXIT_CODE
+
+from test_stall import FakeKV, FakeKVNoDir
+
+_REPO = os.path.dirname(os.path.dirname(horovod_tpu.__file__))
+_SCRIPT = os.path.join(_REPO, "tests", "elastic_train_script.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_preempt():
+    yield
+    preempt.uninstall()
+    preempt.PENDING = False
+    faults.uninstall()
+
+
+def _wait_until(cond, timeout=3.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestSignals:
+    def test_resolve_signal_spellings(self):
+        assert resolve_signal("SIGTERM") is signal.SIGTERM
+        assert resolve_signal("term") is signal.SIGTERM
+        assert resolve_signal(str(int(signal.SIGUSR2))) is signal.SIGUSR2
+        assert resolve_signal("") is None
+        assert resolve_signal(None) is None
+        assert resolve_signal("SIGNOPE") is None
+        assert resolve_signal("999") is None
+
+    def test_configured_signal_env(self, monkeypatch):
+        monkeypatch.delenv("HVTPU_PREEMPT_SIGNAL", raising=False)
+        assert configured_signal() is signal.SIGTERM
+        monkeypatch.setenv("HVTPU_PREEMPT_SIGNAL", "USR2")
+        assert configured_signal() is signal.SIGUSR2
+        # unknown spelling falls back rather than disabling forwarding
+        monkeypatch.setenv("HVTPU_PREEMPT_SIGNAL", "SIGNOPE")
+        assert configured_signal() is signal.SIGTERM
+
+    def test_drain_exit_code_is_distinct(self):
+        assert DRAIN_EXIT_CODE != RESET_EXIT_CODE
+        assert DRAIN_EXIT_CODE not in (0, 1)
+        assert DRAIN_EXIT_CODE != 128 + int(signal.SIGTERM)
+
+
+class TestFaultAction:
+    def test_preempt_grammar_is_one_shot(self):
+        cs = faults.parse_spec("worker.step:preempt@rank=1,count=3")
+        assert cs[0].action == "preempt"
+        assert cs[0].times == 1  # planned departures don't repeat
+        assert cs[0].count == 3
+
+    def test_unknown_action_message_names_preempt(self):
+        with pytest.raises(faults.FaultSpecError, match="preempt"):
+            faults.parse_spec("worker.step:explode")
+
+    def test_preempt_action_delivers_notice(self, caplog):
+        # without a coordinator installed the notice is dropped loudly,
+        # not fatally — the fault path must be safe in non-elastic jobs
+        faults.install("worker.step:preempt", rank=0)
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            assert faults.inject("worker.step") is False
+        assert any("not installed" in r.getMessage()
+                   for r in caplog.records)
+
+
+class TestNoticeIntake:
+    def test_notice_file_triggers_departure(self, tmp_path):
+        notice = tmp_path / "preempt-notice"
+        c = _DrainCoordinator(rank=0, size=1, grace_s=60.0,
+                              notice_file=str(notice), generation=0,
+                              client=None)
+        try:
+            time.sleep(0.3)
+            assert not c._departing  # no file yet: nothing pending
+            notice.write_text("going away\n")
+            _wait_until(lambda: c._departing, msg="file notice")
+            assert preempt.PENDING is True
+            assert c._reason == "file"
+            assert 0 in c.draining_ranks()
+        finally:
+            c.stop()
+
+    def test_notice_is_idempotent_and_keeps_first_reason(self):
+        c = _DrainCoordinator(rank=0, size=1, grace_s=60.0,
+                              notice_file=None, generation=0,
+                              client=None)
+        try:
+            c.notice("api")
+            c.notice("signal")
+            assert c._reason == "api"
+        finally:
+            c.stop()
+
+    def test_grace_remaining_counts_down_and_expires(self):
+        c = _DrainCoordinator(rank=0, size=1, grace_s=0.5,
+                              notice_file=None, generation=0,
+                              client=None)
+        try:
+            # window open: reported as draining...
+            c._departing = True
+            c._notice_t = time.monotonic()
+            rem = c.draining_ranks()
+            assert 0 in rem and 0 < rem[0] <= 0.5
+            # ...window past: exclusion expires, normal stall semantics
+            c._notice_t = time.monotonic() - 1.0
+            assert c.draining_ranks() == {}
+        finally:
+            c.stop()
+
+
+@pytest.fixture(params=[FakeKV, FakeKVNoDir],
+                ids=["dir-get", "try-get-fallback"])
+def kv(request):
+    return request.param()
+
+
+class TestDrainProtocol:
+    """Two coordinators over one fake KV: the full notice → plan →
+    agreed-boundary exchange, exactly as two ranks would run it."""
+
+    def _pair(self, kv):
+        a = _DrainCoordinator(rank=0, size=2, grace_s=60.0,
+                              notice_file=None, generation=0, client=kv)
+        b = _DrainCoordinator(rank=1, size=2, grace_s=60.0,
+                              notice_file=None, generation=0, client=kv)
+        return a, b
+
+    def test_peer_observes_notice_and_plan(self, kv):
+        a, b = self._pair(kv)
+        try:
+            a.notice("api")
+            # the watcher publishes, the peer's watcher observes
+            _wait_until(lambda: 0 in b.draining_ranks(),
+                        msg="peer notice observation")
+            assert preempt.PENDING is True
+            # departing rank's first boundary: publish plan = count+1,
+            # do NOT drain yet (peers need a step to learn the plan)
+            assert a.drain_boundary(5) is False
+            _wait_until(
+                lambda: b.drain_boundary(5) is False and b._plans,
+                msg="peer plan observation")
+            # the agreed boundary: both sides say drain NOW
+            assert a.drain_boundary(6) is True
+            assert b.drain_boundary(6) is True
+            # the peer completes by raising DrainInterrupt (a
+            # HostsUpdatedInterrupt: the committed state stands)
+            with pytest.raises(DrainInterrupt) as ei:
+                b.finish_drain(6)
+            assert isinstance(ei.value, HostsUpdatedInterrupt)
+            assert ei.value.rank == 0
+            # finish_drain is once-only; later boundaries are inert
+            assert b.drain_boundary(7) is False
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_generation_namespacing(self, kv):
+        """A relaunched world (new generation) must never observe the
+        previous incarnation's drain markers."""
+        a = _DrainCoordinator(rank=0, size=2, grace_s=60.0,
+                              notice_file=None, generation=0, client=kv)
+        b = _DrainCoordinator(rank=1, size=2, grace_s=60.0,
+                              notice_file=None, generation=1, client=kv)
+        try:
+            a.notice("api")
+            _wait_until(lambda: kv.key_value_dir_get is None
+                        or any("notice/0" in k for k, _ in
+                               kv.key_value_dir_get("hvtdrain/0/")),
+                        msg="notice published")
+            time.sleep(0.5)  # several polls on b's side
+            assert b.draining_ranks() == {}
+            assert b.drain_boundary(5) is False
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_debug_state_surfaces_protocol(self, kv):
+        a, b = self._pair(kv)
+        try:
+            a.notice("api")
+            a.drain_boundary(3)
+            d = a.debug_state()
+            assert d["departing"] is True and d["reason"] == "api"
+            assert d["plans"] == {"0": 4}
+            _wait_until(lambda: b.debug_state()["draining_ranks"],
+                        msg="peer debug state")
+            assert b.debug_state()["departing"] is False
+        finally:
+            a.stop()
+            b.stop()
+
+
+class TestStallExclusion:
+    """A draining rank is reported, not blamed: no stall abort fires
+    for it during the grace window."""
+
+    def test_strict_rendezvous_holds_abort_for_draining_rank(
+            self, monkeypatch, caplog):
+        from horovod_tpu.comm.stall import SyncStallInspector
+
+        monkeypatch.setattr(preempt, "PENDING", True)
+        monkeypatch.setattr(preempt, "draining_ranks",
+                            lambda: {1: 25.0})
+        kv = FakeKV()
+        insp = SyncStallInspector(kv, rank=0, warn_s=0.05, abort_s=0.15,
+                                  generation=1)
+
+        def late_peer():
+            time.sleep(0.5)  # well past abort_s
+            kv.key_value_set("hvtstall/1/0/0/1", "op")
+
+        import threading
+
+        t = threading.Thread(target=late_peer)
+        t.start()
+        with caplog.at_level(logging.INFO, logger="horovod_tpu"):
+            insp.rendezvous(0, [0, 1], "op")  # must NOT raise
+        t.join()
+        held = [r for r in caplog.records
+                if "draining" in r.getMessage()]
+        assert held and "rank 1" in held[0].getMessage()
+
+    def test_strict_rendezvous_still_aborts_non_draining_rank(
+            self, monkeypatch):
+        from horovod_tpu.comm.stall import SyncStallInspector
+        from horovod_tpu.core.exceptions import HorovodInternalError
+
+        monkeypatch.setattr(preempt, "PENDING", True)
+        monkeypatch.setattr(preempt, "draining_ranks",
+                            lambda: {2: 25.0})  # rank 2, not rank 1
+        insp = SyncStallInspector(FakeKV(), rank=0, warn_s=0.05,
+                                  abort_s=0.15, generation=1)
+        with pytest.raises(HorovodInternalError, match=r"\[1\]"):
+            insp.rendezvous(0, [0, 1], "op")
+
+    def test_amortized_evaluate_holds_abort_for_draining_rank(
+            self, monkeypatch, caplog):
+        from test_stall import _NeverReady
+
+        from horovod_tpu.comm.stall import AmortizedStallInspector
+
+        monkeypatch.setattr(preempt, "PENDING", True)
+        monkeypatch.setattr(preempt, "draining_ranks",
+                            lambda: {1: 25.0})
+        insp = AmortizedStallInspector(
+            FakeKV(), rank=0, warn_s=0.05, abort_s=0.1,
+            heartbeat_s=30.0, generation=1)  # beat never fires
+        try:
+            insp.pre_op(0, [0, 1], "allreduce:x")
+            time.sleep(0.2)  # past abort_s
+            with caplog.at_level(logging.INFO, logger="horovod_tpu"):
+                insp._evaluate(peers={})
+            assert insp.failure is None  # held, not aborted
+            assert any("draining" in r.getMessage()
+                       for r in caplog.records)
+            # once the window expires the hold lifts
+            monkeypatch.setattr(preempt, "draining_ranks", lambda: {})
+            insp._evaluate(peers={})
+            assert insp.failure and "[1]" in insp.failure
+        finally:
+            insp.stop()
+
+
+class TestTermGrace:
+    def test_term_grace_knob(self, monkeypatch):
+        from horovod_tpu.runner import safe_shell_exec as sse
+
+        monkeypatch.delenv("HVTPU_TERM_GRACE_SECONDS", raising=False)
+        assert sse.term_grace_s() == sse.GRACEFUL_TERMINATION_TIME_S
+        monkeypatch.setenv("HVTPU_TERM_GRACE_SECONDS", "45")
+        assert sse.term_grace_s() == 45.0
+        for bad in ("nope", "-1", "0"):
+            monkeypatch.setenv("HVTPU_TERM_GRACE_SECONDS", bad)
+            assert sse.term_grace_s() == sse.GRACEFUL_TERMINATION_TIME_S
+
+    def test_launcher_flags_thread_drain_env(self):
+        from horovod_tpu.runner.launch import parse_args
+
+        args = parse_args([
+            "-np", "2", "--drain-grace", "12.5",
+            "--preempt-notice-file", "/tmp/notice",
+            "--", "python", "train.py"])
+        assert args.drain_grace == 12.5
+        assert args.preempt_notice_file == "/tmp/notice"
+
+
+# ---------------------------------------------------------------------------
+# acceptance: real 2-process elastic runs under an injected preemption
+# ---------------------------------------------------------------------------
+
+
+def _launch_elastic(tmp_path, fault_spec, extra_args=(), epochs=6,
+                    timeout=300):
+    from conftest import make_discovery_script
+
+    _hosts, disc = make_discovery_script(tmp_path, "localhost:2")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_EPOCHS"] = str(epochs)
+    # one full watcher poll (0.2s) fits inside a step, so the K=1
+    # plan lookahead always reaches peers before the agreed boundary
+    env["EPOCH_SLEEP"] = "0.3"
+    env["HVTPU_ELASTIC_DISCOVERY_INTERVAL"] = "0.2"
+    cmd = [
+        sys.executable, "-m", "horovod_tpu.runner",
+        "--host-discovery-script", disc,
+        "--min-np", "2", "--cpu-devices", "1", "--verbose",
+        "--fault-spec", fault_spec,
+        *extra_args,
+        "--", sys.executable, _SCRIPT,
+    ]
+    res = subprocess.run(cmd, env=env, cwd=_REPO, timeout=timeout,
+                         capture_output=True, text=True)
+    return res, res.stdout + res.stderr
+
+
+@pytest.mark.multiprocess
+@pytest.mark.slow
+def test_preempt_drains_and_resizes_without_budget_strike(tmp_path):
+    """ISSUE-8 acceptance: rank 1 gets a preemption notice at its 3rd
+    step.  All ranks must reach the drain commit, rank 1 must exit
+    DRAIN_EXIT_CODE, and the driver must resize WITHOUT a restart-
+    budget strike — proven by --max-restarts 0, under which any
+    budget-charged relaunch would fail the job.  The next incarnation
+    resumes from the drain commit: every epoch appears exactly once
+    (zero lost steps)."""
+    res, out = _launch_elastic(
+        tmp_path, "worker.step:preempt@rank=1,count=3",
+        extra_args=("--max-restarts", "0"))
+    assert res.returncode == 0, out[-4000:]
+    # the departing rank announced the planned exit...
+    assert "exiting 79 for a planned departure" in out, out[-4000:]
+    # ...and the driver classified it as such (no strike, no blacklist)
+    assert "planned departure" in out, out[-4000:]
+    assert "restart budget exhausted" not in out, out[-4000:]
+    # exactly one resize: launch, drain, relaunch
+    assert out.count("launching 2 workers") == 2, out[-4000:]
+    assert "DONE size=2 epoch=6" in out, out[-4000:]
+    # zero lost steps: the next incarnation resumed from the drain
+    # commit, so no epoch was re-run and none was skipped — and no
+    # rank fell back to the collective-failure (rollback) path
+    epochs = [int(line.split("epoch=")[1].split()[0])
+              for line in out.splitlines()
+              if line.split(":", 1)[-1].lstrip().startswith("EPOCH ")]
+    assert epochs == list(range(6)), (epochs, out[-4000:])
+    assert "collective failure" not in out, out[-4000:]
+
+
+@pytest.mark.multiprocess
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_drain_vs_kill_classification(tmp_path):
+    """Chaos matrix: a `kill` and a `preempt` in the same job must be
+    classified differently.  Rank 0 is killed at its 2nd step of
+    incarnation 1 (charges the ONLY budgeted restart); rank 1 is
+    preempted in incarnation 2 (drains, charges nothing).  Under
+    --max-restarts 1 the job completes ONLY if the drain was free."""
+    res, out = _launch_elastic(
+        tmp_path,
+        "worker.step:kill@rank=0,count=2;"
+        "worker.step:preempt@rank=1,count=3",
+        extra_args=("--max-restarts", "1"), epochs=8)
+    assert res.returncode == 0, out[-4000:]
+    # the kill took a crash strike...
+    assert "fault injection: killing rank 0" in out, out[-4000:]
+    assert "strikes)" in out, out[-4000:]
+    # ...the drain did not
+    assert "exiting 79 for a planned departure" in out, out[-4000:]
+    assert "planned departure" in out, out[-4000:]
+    assert "restart budget exhausted" not in out, out[-4000:]
+    # three incarnations: start, post-kill relaunch, post-drain resize
+    assert out.count("launching 2 workers") == 3, out[-4000:]
+    assert "DONE size=2 epoch=8" in out, out[-4000:]

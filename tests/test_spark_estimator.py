@@ -378,6 +378,38 @@ class TestTorchEstimator:
             warnings.simplefilter("error")
             est(weighted)._check_params()
 
+    def test_sample_weight_strict_mode_errors(self, tmp_path,
+                                              monkeypatch):
+        """HVTPU_SPARK_STRICT upgrades the non-weight-third-arg warning
+        to a hard error at fit() time, still naming the parameter —
+        for pipelines that would rather fail than risk a silently
+        misweighted model."""
+        import torch
+        import torch.nn as nn
+
+        from horovod_tpu.spark import TorchEstimator
+
+        model = nn.Sequential(nn.Linear(4, 1))
+
+        def focal(output, target, gamma):
+            return ((output - target) ** 2 * gamma).mean()
+
+        est = TorchEstimator(
+            model=model,
+            optimizer=torch.optim.SGD(model.parameters(), lr=0.1),
+            feature_cols=["features"], label_cols=["label"],
+            batch_size=32, epochs=1, num_proc=2,
+            store=LocalStore(str(tmp_path)),
+            loss=focal, sample_weight_col="w")
+
+        monkeypatch.setenv("HVTPU_SPARK_STRICT", "1")
+        with pytest.raises(ValueError, match="'gamma'"):
+            est._check_params()
+        # falsy spellings keep the warning behavior
+        monkeypatch.setenv("HVTPU_SPARK_STRICT", "0")
+        with pytest.warns(UserWarning, match="HVTPU_SPARK_STRICT"):
+            est._check_params()
+
     def test_lightning_shim_raises_with_guidance(self):
         from horovod_tpu.spark.lightning import LightningEstimator
 
