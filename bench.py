@@ -125,6 +125,13 @@ REQUIRED_METRIC_KEYS = (
     "hvtpu_preempt_notices_total",
     "hvtpu_elastic_drains_total",
     "hvtpu_drain_commit_seconds",
+    # input pipeline (PR 9): per-batch input wait and delivery counters
+    # from data/loader.py — the data-stall half of the straggler
+    # decomposition; the report derives data_stall.stall_fraction from
+    # the wait histogram against wall time.
+    "hvtpu_data_wait_seconds",
+    "hvtpu_data_batches_delivered_total",
+    "hvtpu_data_samples_delivered_total",
 )
 
 
@@ -169,6 +176,18 @@ def build_report(**fields) -> dict:
         "collectives": skew["count"],
         "mean_seconds": round(skew["sum"] / skew["count"], 6)
         if skew["count"] else 0.0,
+    }
+    # Input-stall headline: time the host loop blocked on the data
+    # pipeline vs wall time.  Near-0 stall_fraction with nonzero
+    # batches is the prefetch-overlap proof; null when the caller
+    # passed no elapsed_seconds (schema-stable either way).
+    wait = report["metrics"]["hvtpu_data_wait_seconds"]
+    elapsed = fields.get("elapsed_seconds")
+    report["data_stall"] = {
+        "batches": wait["count"],
+        "wait_seconds": round(wait["sum"], 6),
+        "stall_fraction": round(wait["sum"] / elapsed, 6)
+        if elapsed else None,
     }
     return report
 
@@ -255,18 +274,57 @@ def main():
         # a dependent scalar read cannot.
         return float(loss)
 
+    # Feed dispatches through the elastic input pipeline so the bench
+    # measures (and reports, via data_stall) the prefetch overlap: the
+    # loader's thread places batch k+1 on the mesh while dispatch k
+    # runs.  shuffle=False over exactly one global batch keeps the fed
+    # tensors byte-identical to the direct arrays, so compute — and the
+    # regression floors — are unaffected.  HVTPU_BENCH_DATA_LOADER=0
+    # restores the direct path.
+    loader = None
+    if os.environ.get("HVTPU_BENCH_DATA_LOADER", "1") != "0" \
+            and hvt.size() == 1:
+        # single-controller path only: in a multi-process bench each
+        # process already holds its own per-process global batch, which
+        # the loader's world-sharding would re-split
+        from jax.sharding import NamedSharding
+
+        from horovod_tpu import data as hvt_data
+
+        sharding = NamedSharding(mesh, P("world"))
+
+        def place(batch):
+            return {"x": jax.device_put(batch["x"], sharding),
+                    "y": jax.device_put(batch["y"], sharding)}
+
+        loader = hvt_data.ElasticDataLoader(
+            hvt_data.ArraySource(
+                {"x": np.asarray(images), "y": np.asarray(labels)}),
+            batch_size=global_batch, shuffle=False, device_put=False,
+            transform=place, name="bench")
+        batches = loader.stream()
+
+        def next_batch():
+            b = next(batches)
+            return b["x"], b["y"]
+    else:
+        def next_batch():
+            return images, labels
+
     loss = None
     for _ in range(WARMUP):
+        x, y = next_batch()
         params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels
+            params, batch_stats, opt_state, x, y
         )
     if loss is not None:
         fence(loss)
 
     t0 = time.perf_counter()
     for _ in range(ITERS):
+        x, y = next_batch()
         params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels
+            params, batch_stats, opt_state, x, y
         )
         # jit path: the traced update can't count itself, so the host
         # loop reports steps/examples per dispatch (obs/metrics.py).
@@ -274,6 +332,8 @@ def main():
                               steps=STEPS_PER_CALL)
     final_loss = fence(loss)
     elapsed = time.perf_counter() - t0
+    if loader is not None:
+        loader.close()
 
     if not np.isfinite(final_loss):
         raise RuntimeError(f"non-finite loss {final_loss}; benchmark invalid")
@@ -309,6 +369,7 @@ def main():
                 model=MODEL,
                 batch_per_chip=BATCH_PER_CHIP,
                 mfu_est=round(mfu, 4),
+                elapsed_seconds=round(elapsed, 3),
                 notes=(
                     f"{STEPS_PER_CALL} steps/dispatch via lax.scan"
                 ) if MODEL != "resnet50" else (

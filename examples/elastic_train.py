@@ -4,6 +4,15 @@ The horovod_tpu analog of the reference's elastic examples
 (examples/elastic/pytorch/pytorch_mnist_elastic.py shape): state
 commits every epoch survive worker loss and world resizes.
 
+Input rides :class:`hvt.data.ElasticDataLoader`: the loader's
+``(epoch, cursor, seed)`` state is registered with the elastic state,
+so a resize re-splits only the UNCONSUMED remainder of the epoch across
+the new world (the old naive ``rank/size`` slicing restarted the epoch
+and re-visited samples) and a graceful preemption resumes mid-epoch
+from the drain-committed cursor.  Every full step hands each rank
+exactly ``batch_size`` samples regardless of world size, so compiled
+shapes survive resizes too (only an epoch's ragged tail batch varies).
+
 Run:
   hvtpurun --host-discovery-script ./discover.sh --min-np 2 \
       --cpu-devices 1 python examples/elastic_train.py
@@ -16,6 +25,7 @@ import numpy as np
 
 import horovod_tpu as hvt
 import horovod_tpu.elastic as elastic
+from horovod_tpu.data import ArraySource, ElasticDataLoader
 
 
 def main():
@@ -23,10 +33,13 @@ def main():
     rng = np.random.RandomState(0)
     x = rng.rand(512, 32).astype(np.float32)
     w_true = rng.randn(32, 1).astype(np.float32)
-    y = x @ w_true
+    y = (x @ w_true).astype(np.float32)
+
+    loader = ElasticDataLoader(
+        ArraySource({"x": x, "y": y}), batch_size=64, seed=1234)
 
     params = {"w": jnp.zeros((32, 1))}
-    state = elastic.JaxState(params=params, epoch=0)
+    state = elastic.JaxState(params=params, data=loader.state)
 
     @jax.jit
     def grad_fn(p, bx, by):
@@ -37,27 +50,28 @@ def main():
 
     @elastic.run
     def train(state):
-        while state.epoch < 8:
-            # shard batches by the CURRENT world (resizes survive)
-            n = len(x) // hvt.size()
-            lo = hvt.rank() * n
-            bx, by = jnp.asarray(x[lo:lo + n]), jnp.asarray(y[lo:lo + n])
-            loss, grads = grad_fn(state.params, bx, by)
-            grads = {
-                k: hvt.allreduce(g, op=hvt.Average)
-                for k, g in grads.items()
-            }
-            state.params = jax.tree.map(
-                lambda p, g: p - 0.3 * g, state.params, grads
-            )
-            state.epoch += 1
+        while loader.state.epoch < 8:
+            loss = None
+            # resumes at the committed mid-epoch cursor after a resize
+            for batch in loader:
+                loss, grads = grad_fn(state.params, batch["x"],
+                                      batch["y"])
+                grads = {
+                    k: hvt.allreduce(g, op=hvt.Average)
+                    for k, g in grads.items()
+                }
+                state.params = jax.tree.map(
+                    lambda p, g: p - 0.05 * g, state.params, grads
+                )
             state.commit()
             if hvt.rank() == 0:
                 print(
-                    f"epoch {state.epoch}: loss={float(loss):.5f} "
+                    f"epoch {loader.state.epoch}: "
+                    f"loss={float(loss):.5f} "
                     f"(world size {hvt.size()})",
                     flush=True,
                 )
+        loader.close()
         if hvt.rank() == 0:
             print("elastic training complete", flush=True)
 

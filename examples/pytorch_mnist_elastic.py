@@ -1,7 +1,16 @@
 """Elastic torch training example — the horovod_tpu analog of the
 reference's examples/elastic/pytorch/pytorch_mnist_elastic.py:
-``hvd.elastic.run`` with ``TorchState`` (model + optimizer) and the
-``ElasticSampler``; commits survive worker loss and world resizes.
+``hvd.elastic.run`` with ``TorchState`` (model + optimizer); commits
+survive worker loss and world resizes.
+
+Input rides the framework-agnostic :class:`ElasticDataLoader` instead
+of the reference's ``ElasticSampler`` + ``record_batch`` bookkeeping:
+the loader's ``(epoch, cursor, seed)`` state registers with
+``TorchState`` like any other ``state_dict`` handle, so a resize
+re-splits the unconsumed epoch remainder across the new world and a
+preemption resumes from the drain-committed cursor — no samples
+repeated or dropped, and no per-batch ``record_batch`` calls in the
+loop.
 
 Run:
   hvtpurun --host-discovery-script ./discover.sh --min-np 2 \
@@ -15,6 +24,7 @@ import torch.nn as nn
 import torch.nn.functional as F
 
 import horovod_tpu.torch as hvd
+from horovod_tpu.data import ArraySource, ElasticDataLoader
 
 
 class Net(nn.Module):
@@ -33,9 +43,9 @@ def main():
     torch.manual_seed(42)
 
     rng = np.random.RandomState(0)
-    x = torch.from_numpy(rng.rand(1024, 784).astype(np.float32))
+    x = rng.rand(1024, 784).astype(np.float32)
     w = rng.randn(784, 10).astype(np.float32)
-    y = torch.from_numpy((x.numpy() @ w).argmax(axis=1))
+    y = (x @ w).argmax(axis=1).astype(np.int64)
 
     model = Net()
     # elastic: lr scales with the CURRENT size; rebuilt on reset
@@ -43,43 +53,43 @@ def main():
     opt = hvd.DistributedOptimizer(
         opt, named_parameters=model.named_parameters())
 
-    dataset = torch.utils.data.TensorDataset(x, y)
-    sampler = hvd.elastic.ElasticSampler(dataset, shuffle=True)
+    # device_put=False: torch consumes host numpy batches directly
+    loader = ElasticDataLoader(
+        ArraySource({"x": x, "y": y}), batch_size=64, seed=42,
+        device_put=False)
     state = hvd.elastic.TorchState(
-        model=model, optimizer=opt, sampler=sampler, epoch=0)
+        model=model, optimizer=opt, data=loader.state)
 
     def on_reset():
         for g in opt.param_groups:
             g["lr"] = 0.05 * hvd.size()
 
     state.register_reset_callbacks([on_reset])
-    batch = 64
     epochs = 6
 
     @hvd.elastic.run
     def train(state):
-        while state.epoch < epochs:
-            sampler.set_epoch(state.epoch)
-            loader = torch.utils.data.DataLoader(
-                dataset, batch_size=batch, sampler=sampler)
+        while loader.state.epoch < epochs:
+            epoch = loader.state.epoch
             total, steps = 0.0, 0
-            for bi, (bx, by) in enumerate(loader):
+            for batch in loader:  # resumes mid-epoch after a resize
+                bx = torch.from_numpy(np.ascontiguousarray(batch["x"]))
+                by = torch.from_numpy(np.ascontiguousarray(batch["y"]))
                 opt.zero_grad()
                 loss = F.nll_loss(model(bx), by)
                 loss.backward()
                 opt.step()
-                sampler.record_batch(bi, batch)
                 total += float(loss)
                 steps += 1
             avg = hvd.allreduce(
                 torch.tensor(total / max(steps, 1)), op=hvd.Average)
             if hvd.rank() == 0:
-                print(f"epoch {state.epoch}: loss={float(avg):.4f} "
+                print(f"epoch {epoch}: loss={float(avg):.4f} "
                       f"(world size {hvd.size()})", flush=True)
-            state.epoch += 1
             state.commit()
 
     train(state)
+    loader.close()
     if hvd.rank() == 0:
         print(f"done; ranks consistent ({hvd.size()} ranks)",
               flush=True)

@@ -49,6 +49,7 @@ if not hasattr(jax.lax, "axis_size"):
     jax.lax.axis_size = lambda axis_name: jax.core.axis_frame(axis_name)
 
 from . import comm, core
+from . import data  # noqa: F401  (elastic-aware input pipeline)
 from . import elastic  # noqa: F401  (hvt.elastic.State/run parity surface)
 from .api import functions as _functions
 from .api import optimizer as _optimizer
@@ -548,7 +549,7 @@ __all__ = [
     "ProcessSet", "add_process_set", "remove_process_set",
     "Config", "HorovodTpuError", "HorovodInternalError",
     "HostsUpdatedInterrupt", "HvtpuMismatchError", "HvtpuDivergenceError",
-    "spmd", "comm", "core",
+    "spmd", "comm", "core", "data",
     "mpi_enabled", "mpi_built", "mpi_threads_supported", "gloo_enabled",
     "gloo_built", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
     "rocm_built", "xla_built", "ici_built",

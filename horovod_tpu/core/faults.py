@@ -14,7 +14,7 @@ Grammar (full reference in docs/robustness.md)::
     SPEC   := CLAUSE (";" CLAUSE)*
     CLAUSE := SITE ":" ACTION ("@" SEL ("," SEL)*)?
     SITE   := kv.get | kv.put | heartbeat | collective.pre
-            | collective.post | worker.step
+            | collective.post | worker.step | data.next
     ACTION := drop | delay(MS) | error | kill | preempt
             | corrupt | corrupt(nan) | corrupt(bitflip)
     SEL    := rank=R[|R...] | pset=ID | count=N | prob=P | times=K
@@ -66,8 +66,12 @@ logger = logging.getLogger("horovod_tpu")
 #: ``collective.pre``/``collective.post`` are TENSOR sites: ``corrupt``
 #: clauses there poison the collective's input/result on the selected
 #: ranks (exercising the non-finite guard and the divergence audit).
+#: ``data.next`` fires in the input pipeline's batch-delivery path
+#: (data/loader.py): ``delay`` stalls inside the DATA_WAIT trace span
+#: (an injected input straggler), ``drop`` loses one batch (the cursor
+#: advances past it), ``error`` surfaces a source failure.
 SITES = ("kv.get", "kv.put", "heartbeat", "collective.pre",
-         "collective.post", "worker.step")
+         "collective.post", "worker.step", "data.next")
 
 ACTIONS = ("drop", "delay", "error", "kill", "preempt", "corrupt")
 

@@ -294,6 +294,7 @@ class _DrainCoordinator:
         if tracing.ACTIVE:
             tracing.instant("drain_exit", rank=self.rank,
                             committed=False)
+        self._quiesce_data_loaders()
         try:
             from . import state as core_state
 
@@ -344,6 +345,19 @@ class _DrainCoordinator:
         self._quiesce_controller()
         return True
 
+    def _quiesce_data_loaders(self) -> None:
+        """Stop input prefetch threads before the drain exit so none is
+        mid-``device_put`` when the process leaves.  The drain commit
+        already captured the delivered cursor, so parked batches are
+        simply re-fetched by the next incarnation."""
+        try:
+            from ..data.loader import quiesce_all
+
+            quiesce_all()
+        except Exception:
+            logger.debug("pre-drain data loader quiesce failed",
+                         exc_info=True)
+
     def _quiesce_controller(self) -> None:
         try:
             from . import state as core_state
@@ -387,6 +401,7 @@ class _DrainCoordinator:
             if tracing.ACTIVE:
                 tracing.instant("drain_exit", rank=self.rank,
                                 committed=True)
+            self._quiesce_data_loaders()
             try:
                 from . import state as core_state
 

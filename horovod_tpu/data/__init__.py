@@ -1,0 +1,29 @@
+"""hvtpu.data — elastic-aware sharded input pipeline.
+
+Checkpointable iterators over deterministic sample-space shards:
+``ElasticDataLoader`` prefetches on a background thread, registers its
+``LoaderState`` with the elastic state machinery for exactly-once
+sample delivery across preemptions and resizes, and agrees epoch
+boundaries across ranks.  See docs/data.md.
+"""
+
+from .loader import ElasticDataLoader, LoaderState, quiesce_all
+from .sharder import (Sharder, epoch_permutation, shard_window,
+                      steps_remaining)
+from .sources import (ArraySource, DataSource, FileListSource,
+                      SyntheticSource, map_structure)
+
+__all__ = [
+    "ElasticDataLoader",
+    "LoaderState",
+    "quiesce_all",
+    "Sharder",
+    "epoch_permutation",
+    "shard_window",
+    "steps_remaining",
+    "DataSource",
+    "ArraySource",
+    "FileListSource",
+    "SyntheticSource",
+    "map_structure",
+]

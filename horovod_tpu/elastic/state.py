@@ -225,12 +225,29 @@ class ObjectState(State):
         self.save_to_memory()
 
     # -- payload capture --
+    # Participant protocol: a tracked value exposing
+    # ``hvtpu_state_dict()`` / ``hvtpu_load_state_dict(d)`` (e.g. a
+    # data.LoaderState) is captured via its dict and restored IN PLACE,
+    # so live objects holding a reference to it (the data loader, its
+    # prefetch thread) ride commits/rollbacks without re-registration.
     def _capture(self) -> Dict[str, Any]:
-        return {k: copy.deepcopy(getattr(self, k)) for k in self._tracked}
+        out: Dict[str, Any] = {}
+        for k in self._tracked:
+            v = getattr(self, k)
+            if hasattr(v, "hvtpu_state_dict"):
+                out[k] = copy.deepcopy(v.hvtpu_state_dict())
+            else:
+                out[k] = copy.deepcopy(v)
+        return out
 
     def _apply(self, payload: Dict[str, Any]):
         for k, v in payload.items():
-            setattr(self, k, v)
+            cur = getattr(self, k, None)
+            if cur is not None and hasattr(cur, "hvtpu_load_state_dict") \
+                    and isinstance(v, dict):
+                cur.hvtpu_load_state_dict(v)
+            else:
+                setattr(self, k, v)
 
     def save_to_memory(self):
         self._saved = self._capture()
@@ -290,8 +307,7 @@ class ObjectState(State):
 
         if core_audit.audit_every() <= 0:
             return None
-        return core_audit.verify(
-            {k: getattr(self, k) for k in self._tracked}, label)
+        return core_audit.verify(self._capture(), label)
 
     # -- disk representation hooks (subclasses with non-picklable
     #    payloads override these) --

@@ -22,8 +22,10 @@ Instrumented producers (metric catalog in docs/observability.md):
 compression, allreduce latency), ``eager/controller.py`` (cycle
 duration, queue depth, negotiation latency, cache hits),
 ``comm/stall.py`` (heartbeat age, warnings/aborts), ``elastic/*``
-(rendezvous duration, restarts, live worker gauge), and
-``api/optimizer.py`` (steps, skipped steps, examples/sec).
+(rendezvous duration, restarts, live worker gauge),
+``api/optimizer.py`` (steps, skipped steps, examples/sec), and
+``data/loader.py`` (input wait time, prefetch queue depth,
+samples/batches delivered, resize re-shards).
 
 Cost model: a counter increment is a lock + dict add (~1 µs) — two
 orders of magnitude under the cheapest eager collective — so the

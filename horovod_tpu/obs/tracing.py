@@ -53,6 +53,11 @@ QUEUE = "QUEUE"
 FUSE = "FUSE"
 EXEC = "EXEC"
 DONE = "DONE"
+# Input-pipeline wait (data/loader.py): time the training loop blocked
+# on the prefetch queue.  hvtputrace report buckets it separately from
+# the collective wait phases so stragglers attribute to input vs
+# compute vs comms.
+DATA_WAIT = "DATA_WAIT"
 
 # Module-level fast-path flag: call sites do `if tracing.ACTIVE:` so
 # the disabled path is one attribute load (same contract as

@@ -1,0 +1,59 @@
+"""Elastic data-pipeline script used by the exactly-once integration
+tests: trains over an ArraySource of DATA_SAMPLES identity samples with
+a commit per batch, printing one ``DELIVER`` line per delivered batch
+(sample values double as indices) so the harness can assert every
+sample arrives exactly once across incarnations/resizes.
+"""
+
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu as hvt
+import horovod_tpu.elastic as elastic
+from horovod_tpu.data import ArraySource, ElasticDataLoader
+
+
+def main():
+    hvt.init()
+    epochs = int(os.environ.get("ELASTIC_EPOCHS", "2"))
+    sleep_s = float(os.environ.get("EPOCH_SLEEP", "0.3"))
+    n = int(os.environ.get("DATA_SAMPLES", "48"))
+    batch = int(os.environ.get("DATA_BATCH", "4"))
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    loader = ElasticDataLoader(
+        ArraySource({"x": x}), batch_size=batch, seed=7,
+        device_put=False)
+    state = elastic.ObjectState(data=loader.state, total=0.0)
+
+    @elastic.run
+    def train(state):
+        import jax.numpy as jnp
+
+        gen = os.environ.get("HVTPU_ELASTIC_GENERATION", "0")
+        while loader.state.epoch < epochs:
+            epoch = loader.state.epoch
+            for b in loader:
+                idx = sorted(int(v) for v in np.asarray(b["x"]).ravel())
+                # a real collective per batch: resize mid-epoch must
+                # not deadlock the survivors
+                out = hvt.allreduce(jnp.ones(2), op=hvt.Sum)
+                state.total += float(out[0])
+                print(
+                    f"DELIVER rank={hvt.rank()} size={hvt.size()} "
+                    f"gen={gen} epoch={epoch} idx={idx}",
+                    flush=True,
+                )
+                time.sleep(sleep_s)
+                state.commit()
+        if hvt.rank() == 0:
+            print(f"DONE size={hvt.size()} epoch={loader.state.epoch}",
+                  flush=True)
+
+    train(state)
+    loader.close()
+
+
+if __name__ == "__main__":
+    main()

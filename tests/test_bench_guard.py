@@ -98,6 +98,28 @@ class TestMetricsEmbedding:
             "hvtpu_collective_arrival_skew_seconds"]["count"]
         json.dumps(report)
 
+    def test_required_keys_cover_data_pipeline(self, bench):
+        # PR 9: input-pipeline counters ride in every bench line
+        required = set(bench.REQUIRED_METRIC_KEYS)
+        assert "hvtpu_data_wait_seconds" in required
+        assert "hvtpu_data_batches_delivered_total" in required
+        assert "hvtpu_data_samples_delivered_total" in required
+
+    def test_report_embeds_data_stall_row(self, bench):
+        report = bench.build_report(metric="m", value=1.0, unit="u",
+                                    elapsed_seconds=10.0)
+        stall = report["data_stall"]
+        assert set(stall) == {"batches", "wait_seconds",
+                              "stall_fraction"}
+        assert stall["batches"] == report["metrics"][
+            "hvtpu_data_wait_seconds"]["count"]
+        # derived against the caller's wall time; null without it
+        assert stall["stall_fraction"] == pytest.approx(
+            stall["wait_seconds"] / 10.0)
+        no_elapsed = bench.build_report(metric="m", value=1.0, unit="u")
+        assert no_elapsed["data_stall"]["stall_fraction"] is None
+        json.dumps(report)
+
 
 class TestTorchStepSchema:
     """bench_eager's torch DistributedOptimizer step-time row: the

@@ -1,0 +1,447 @@
+"""hvtpu.data: elastic-aware sharded input pipeline (ISSUE PR 9).
+
+Units: permutation determinism, remainder re-sharding across a resize,
+uneven-tail agreement, prefetch shutdown hygiene, exactly-once delivery
+with rollback/restore, the elastic participant protocol, the
+``data.next`` fault site, and the loader's observability surface.
+
+Acceptance (slow/multiprocess): a 2-proc elastic run preempted
+mid-epoch delivers every sample index exactly once across incarnations
+(resuming from the drain-committed cursor), and ``hvtputrace report``
+attributes an injected ``data.next:delay`` to the input phase of the
+right rank.
+"""
+
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu
+from horovod_tpu import data as hvt_data
+from horovod_tpu.core import faults
+from horovod_tpu.data import (ArraySource, ElasticDataLoader,
+                              FileListSource, LoaderState, Sharder,
+                              SyntheticSource, sharder)
+
+_REPO = os.path.dirname(os.path.dirname(horovod_tpu.__file__))
+_SCRIPT = os.path.join(os.path.dirname(__file__),
+                       "elastic_data_script.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.uninstall()
+
+
+def _make_loader(n=24, batch=4, **kw):
+    kw.setdefault("device_put", False)
+    src = ArraySource({"y": np.arange(n)})
+    return ElasticDataLoader(src, batch_size=batch, **kw)
+
+
+# ---------------------------------------------------------------------------
+# sharder units
+# ---------------------------------------------------------------------------
+
+class TestSharder:
+    def test_permutation_deterministic_per_seed_and_epoch(self):
+        a = sharder.epoch_permutation(100, seed=5, epoch=3)
+        b = sharder.epoch_permutation(100, seed=5, epoch=3)
+        assert np.array_equal(a, b)
+        assert sorted(a.tolist()) == list(range(100))
+        # different epoch (or seed) -> different order, same sample set
+        c = sharder.epoch_permutation(100, seed=5, epoch=4)
+        d = sharder.epoch_permutation(100, seed=6, epoch=3)
+        assert not np.array_equal(a, c)
+        assert not np.array_equal(a, d)
+        assert sorted(c.tolist()) == list(range(100))
+
+    def test_no_shuffle_is_identity(self):
+        p = sharder.epoch_permutation(7, seed=9, epoch=2, shuffle=False)
+        assert p.tolist() == list(range(7))
+
+    def test_world_consumes_disjoint_covering_shards(self):
+        """One step: the per-rank pieces partition the window."""
+        sh = Sharder(40, batch_size=4, seed=1)
+        pieces = [sh.next_indices(epoch=0, cursor=0, rank=r, size=3)[0]
+                  for r in range(3)]
+        cursors = {sh.next_indices(0, 0, r, 3)[1] for r in range(3)}
+        assert cursors == {12}  # all ranks agree on the new cursor
+        flat = np.concatenate(pieces)
+        assert len(flat) == 12 and len(set(flat.tolist())) == 12
+
+    def test_resize_resharding_exactly_once(self):
+        """Consume part of an epoch at size 3, finish it at size 2:
+        the unconsumed remainder is re-split with nothing repeated or
+        dropped — the tentpole's resize contract, in pure math."""
+        n, batch = 40, 4
+        sh = Sharder(n, batch, seed=11)
+        delivered, cursor = [], 0
+        for _ in range(2):  # two steps at size 3 (24 samples)
+            for r in range(3):
+                piece, nxt = sh.next_indices(0, cursor, r, 3)
+                delivered.extend(piece.tolist())
+            cursor = nxt
+        assert cursor == 24
+        # "relaunch" with size 2: a fresh Sharder (new incarnation)
+        sh2 = Sharder(n, batch, seed=11)
+        while cursor < n:
+            for r in range(2):
+                piece, nxt = sh2.next_indices(0, cursor, r, 2)
+                delivered.extend(piece.tolist())
+            cursor = nxt
+        assert sorted(delivered) == list(range(n))
+
+    def test_uneven_tail_agreement(self):
+        """n=10, B=4, size=3: every rank computes the same step count;
+        tail pieces differ by <= 1 and may be empty, and the world
+        still covers every sample exactly once."""
+        n, batch, size = 10, 4, 3
+        assert all(
+            sharder.steps_remaining(n, 0, size, batch) == 1
+            for _ in range(size))
+        sh = Sharder(n, batch, seed=2)
+        pieces = [sh.next_indices(0, 0, r, size)[0] for r in range(size)]
+        sizes = sorted(len(p) for p in pieces)
+        assert max(sizes) - min(sizes) <= 1
+        flat = np.concatenate(pieces)
+        assert sorted(flat.tolist()) == sorted(
+            sh.permutation(0)[:n].tolist())
+        # a tail shorter than the world leaves trailing ranks empty
+        sh5 = Sharder(2, 4, seed=2)
+        tail = [sh5.next_indices(0, 0, r, 5)[0] for r in range(5)]
+        assert [len(p) for p in tail].count(0) == 3
+
+    def test_steps_remaining_is_rank_independent_mid_epoch(self):
+        for cursor in (0, 7, 12, 39, 40):
+            vals = {sharder.steps_remaining(40, cursor, 4, 4)}
+            assert len(vals) == 1
+
+
+# ---------------------------------------------------------------------------
+# sources
+# ---------------------------------------------------------------------------
+
+class TestSources:
+    def test_array_source_structure_gather(self):
+        src = ArraySource({"x": np.arange(12).reshape(6, 2),
+                           "y": np.arange(6)})
+        b = src.fetch(np.array([4, 1]))
+        assert b["x"].tolist() == [[8, 9], [2, 3]]
+        assert b["y"].tolist() == [4, 1]
+
+    def test_array_source_rejects_ragged(self):
+        with pytest.raises(ValueError, match="disagree"):
+            ArraySource({"x": np.zeros(4), "y": np.zeros(5)})
+
+    def test_file_list_source(self, tmp_path):
+        paths = []
+        for i in range(4):
+            p = tmp_path / f"s{i}.npy"
+            np.save(p, np.full((3,), i))
+            paths.append(str(p))
+        src = FileListSource(paths, labels=[10, 11, 12, 13])
+        x, y = src.fetch(np.array([2, 0]))
+        assert x.shape == (2, 3) and x[0, 0] == 2
+        assert y.tolist() == [12, 10]
+
+    def test_synthetic_source_deterministic(self):
+        a = SyntheticSource(100, (4, 4), seed=3)
+        b = SyntheticSource(100, (4, 4), seed=3)
+        ba, bb = a.fetch(np.arange(5)), b.fetch(np.arange(5))
+        assert np.array_equal(ba["x"], bb["x"])
+        assert np.array_equal(ba["y"], bb["y"])
+        assert ba["x"].shape == (5, 4, 4)
+
+
+# ---------------------------------------------------------------------------
+# loader units
+# ---------------------------------------------------------------------------
+
+class TestLoader:
+    def test_epoch_delivers_each_sample_once(self):
+        ld = _make_loader(n=24, batch=4, seed=3)
+        try:
+            seen = [int(v) for b in ld for v in b["y"]]
+            assert sorted(seen) == list(range(24))
+            assert ld.state.epoch == 1 and ld.state.cursor == 0
+        finally:
+            ld.close()
+
+    def test_prefetch_shutdown_leaves_no_thread(self):
+        ld = _make_loader(n=24, batch=4)
+        it = iter(ld)
+        next(it)
+        names = [t.name for t in threading.enumerate()]
+        assert any("hvtpu-data-prefetch" in s for s in names)
+        ld.close()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            names = [t.name for t in threading.enumerate()]
+            if not any("hvtpu-data-prefetch" in s for s in names):
+                break
+            time.sleep(0.05)
+        assert not any("hvtpu-data-prefetch" in s for s in names)
+
+    def test_restore_replays_only_uncommitted_batches(self):
+        """Rollback semantics: restore() rewinds to the last commit;
+        exactly the uncommitted batches are re-delivered (prefetched-
+        but-undelivered data never counts as consumed)."""
+        from horovod_tpu import elastic
+
+        ld = _make_loader(n=24, batch=4, seed=5)
+        try:
+            st = elastic.ObjectState(data=ld.state, step=0)
+            it = iter(ld)
+            committed = [next(it)["y"].tolist(), next(it)["y"].tolist()]
+            st.save_to_memory()
+            lost = [next(it)["y"].tolist(), next(it)["y"].tolist()]
+            st.restore()
+            assert ld.state is st.data, "in-place restore lost identity"
+            assert ld.state.cursor == 8
+            replay = [b["y"].tolist() for b in ld]
+            assert replay[0] == lost[0] and replay[1] == lost[1]
+            everything = [v for b in committed + replay for v in b]
+            assert sorted(everything) == list(range(24))
+        finally:
+            ld.close()
+
+    def test_loader_state_rides_disk_commit(self, tmp_path, monkeypatch):
+        """The participant protocol must survive the durable pickle
+        path: commit in one 'incarnation', load in a fresh one."""
+        from horovod_tpu import elastic
+
+        monkeypatch.setenv("HVTPU_ELASTIC_STATE_DIR", str(tmp_path))
+        ld = _make_loader(n=24, batch=4, seed=9)
+        st = elastic.ObjectState(data=ld.state)
+        it = iter(ld)
+        first = [next(it)["y"].tolist() for _ in range(3)]
+        st.save()
+        ld.close()
+
+        ld2 = _make_loader(n=24, batch=4, seed=9)
+        st2 = elastic.ObjectState(data=ld2.state)
+        try:
+            import pickle
+
+            with open(os.path.join(str(tmp_path), "state_commit.pkl"),
+                      "rb") as f:
+                st2._from_disk_payload(pickle.load(f))
+            assert ld2.state.cursor == 12 and ld2.state.seed == 9
+            rest = [v for b in ld2 for v in b["y"].tolist()]
+            flat = [v for b in first for v in b] + rest
+            assert sorted(flat) == list(range(24))
+        finally:
+            ld2.close()
+
+    def test_stream_crosses_epochs(self):
+        ld = _make_loader(n=8, batch=4, seed=1)
+        try:
+            s = ld.stream()
+            got = [next(s)["y"] for _ in range(5)]
+            assert ld.state.epoch == 2
+            assert all(len(g) == 4 for g in got)
+        finally:
+            ld.close()
+
+    def test_with_indices_and_transform(self):
+        calls = []
+        ld = _make_loader(n=8, batch=4, with_indices=True,
+                          transform=lambda b: calls.append(1) or b)
+        try:
+            idx, batch = next(iter(ld))
+            assert np.array_equal(np.sort(idx), np.sort(batch["y"]))
+            assert calls
+        finally:
+            ld.close()
+
+    def test_debug_state_registered(self):
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        ld = _make_loader(n=8, batch=4, name="dbg")
+        try:
+            next(iter(ld))
+            snap = obs_metrics.debug_snapshot()
+            assert "data" in snap
+            entry = snap["data"]["dbg"]
+            assert entry["samples"] == 8
+            assert entry["delivered_batches"] >= 1
+            assert entry["prefetch_alive"] is True
+        finally:
+            ld.close()
+
+    def test_wait_metric_counts_batches(self):
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        def wait_count():
+            fam = obs_metrics.snapshot().get("hvtpu_data_wait_seconds")
+            if not fam:
+                return 0
+            return sum(c["count"] for c in fam["values"].values())
+
+        ld = _make_loader(n=8, batch=4)
+        try:
+            before = wait_count()
+            list(ld)
+            assert wait_count() - before == 2
+        finally:
+            ld.close()
+
+
+# ---------------------------------------------------------------------------
+# fault site
+# ---------------------------------------------------------------------------
+
+class TestDataFaultSite:
+    def test_grammar_accepts_data_next(self):
+        cs = faults.parse_spec(
+            "data.next:delay(50)@rank=1;data.next:drop;data.next:error")
+        assert [c.site for c in cs] == ["data.next"] * 3
+
+    def test_delay_stalls_delivery(self):
+        faults.install("data.next:delay(120)@times=1", rank=0)
+        ld = _make_loader(n=8, batch=4)
+        try:
+            t0 = time.perf_counter()
+            next(iter(ld))
+            assert time.perf_counter() - t0 >= 0.12
+        finally:
+            ld.close()
+
+    def test_drop_loses_one_batch_and_advances_cursor(self):
+        faults.install("data.next:drop@times=1", rank=0)
+        ld = _make_loader(n=12, batch=4, seed=4)
+        try:
+            seen = [v for b in ld for v in b["y"].tolist()]
+            # one injected drop: 4 of 12 samples lost, none repeated
+            assert len(seen) == 8 and len(set(seen)) == 8
+            assert ld.state.epoch == 1 and ld.state.cursor == 0
+        finally:
+            ld.close()
+
+    def test_error_raises_injected_fault(self):
+        faults.install("data.next:error@times=1", rank=0)
+        ld = _make_loader(n=8, batch=4)
+        try:
+            with pytest.raises(faults.InjectedFault):
+                next(iter(ld))
+        finally:
+            ld.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: 2-proc elastic exactly-once + trace attribution
+# ---------------------------------------------------------------------------
+
+_DELIVER_RE = re.compile(
+    r"DELIVER rank=(\d+) size=(\d+) gen=(\d+) epoch=(\d+) "
+    r"idx=\[([0-9, ]*)\]")
+
+
+def _launch_data_elastic(tmp_path, fault_spec, epochs=2, samples=48,
+                         batch=4, timeout=300):
+    from conftest import make_discovery_script
+
+    _hosts, disc = make_discovery_script(tmp_path, "localhost:2")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_EPOCHS"] = str(epochs)
+    env["DATA_SAMPLES"] = str(samples)
+    env["DATA_BATCH"] = str(batch)
+    env["EPOCH_SLEEP"] = "0.3"
+    env["HVTPU_ELASTIC_DISCOVERY_INTERVAL"] = "0.2"
+    cmd = [
+        sys.executable, "-m", "horovod_tpu.runner",
+        "--host-discovery-script", disc,
+        "--min-np", "2", "--cpu-devices", "1", "--verbose",
+        "--fault-spec", fault_spec,
+        "--", sys.executable, _SCRIPT,
+    ]
+    res = subprocess.run(cmd, env=env, cwd=_REPO, timeout=timeout,
+                         capture_output=True, text=True)
+    return res, res.stdout + res.stderr
+
+
+@pytest.mark.multiprocess
+@pytest.mark.slow
+def test_preempt_mid_epoch_delivers_each_sample_exactly_once(tmp_path):
+    """ISSUE-9 acceptance: rank 1 is preempted at its 3rd per-batch
+    commit (mid-epoch).  The drain commits the loader cursor, the
+    driver resizes 2->2, and across both incarnations every sample
+    index of every epoch is delivered exactly once — no repeats from
+    restarting the epoch, no drops from the in-flight prefetch."""
+    epochs, samples = 2, 48
+    res, out = _launch_data_elastic(
+        tmp_path, "worker.step:preempt@rank=1,count=3", epochs=epochs,
+        samples=samples)
+    assert res.returncode == 0, out[-4000:]
+    assert "exiting 79 for a planned departure" in out, out[-4000:]
+    assert out.count("launching 2 workers") == 2, out[-4000:]
+    assert f"DONE size=2 epoch={epochs}" in out, out[-4000:]
+    per_epoch = {e: [] for e in range(epochs)}
+    gens = set()
+    for m in _DELIVER_RE.finditer(out):
+        gens.add(int(m.group(3)))
+        idx = [int(v) for v in m.group(5).split(",") if v.strip()]
+        per_epoch[int(m.group(4))].extend(idx)
+    assert gens == {0, 1}, (gens, out[-4000:])
+    for e in range(epochs):
+        got = sorted(per_epoch[e])
+        assert got == list(range(samples)), (
+            f"epoch {e}: delivered {len(got)} samples "
+            f"({len(set(got))} unique) — exactly-once violated")
+
+
+@pytest.mark.multiprocess
+@pytest.mark.slow
+def test_trace_attributes_data_delay_to_input_phase(tmp_path):
+    """ISSUE-9 acceptance: an injected ``data.next:delay`` on rank 1
+    must show up in ``hvtputrace report`` as INPUT wait (data_wait) on
+    rank 1 — not as compute, and bigger than rank 0's."""
+    from horovod_tpu.runner import run as run_fn
+    from tools import hvtputrace
+
+    trace_dir = str(tmp_path / "traces")
+    os.makedirs(trace_dir, exist_ok=True)
+
+    def body():
+        import numpy as _np
+
+        import horovod_tpu as _hvt
+        from horovod_tpu.data import ArraySource as _AS
+        from horovod_tpu.data import ElasticDataLoader as _EDL
+
+        _hvt.init()
+        ld = _EDL(_AS({"y": _np.arange(32)}), batch_size=4,
+                  device_put=False, name="traced")
+        for _ in ld:
+            pass
+        ld.close()
+        _hvt.shutdown()
+        return "ok"
+
+    env = {
+        "PYTHONPATH": _REPO + os.pathsep + os.environ.get(
+            "PYTHONPATH", ""),
+        "HVTPU_TRACE": trace_dir,
+        "HVTPU_FAULT_SPEC": "data.next:delay(80)@rank=1,times=3",
+    }
+    assert run_fn(body, np=2, cpu_devices=1, env=env,
+                  start_timeout=300.0) == ["ok", "ok"]
+
+    rep = hvtputrace.report(trace_dir)
+    r0, r1 = rep["per_rank"][0], rep["per_rank"][1]
+    # three 80 ms delays land inside rank 1's DATA_WAIT spans
+    assert r1["data_wait_us"] > 200_000, rep["per_rank"]
+    assert r1["data_wait_us"] > 4 * r0["data_wait_us"], rep["per_rank"]
+    assert r1["data_wait_fraction"] > 0, rep["per_rank"]
+    # the rendered report surfaces the input column
+    text = hvtputrace.render_report(rep)
+    assert "input_ms" in text and "input%" in text

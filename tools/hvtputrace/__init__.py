@@ -38,6 +38,12 @@ _RANK_FILE_RE = re.compile(r"rank(\d+)\.trace\.json$")
 # is treated as compute for the wait-vs-compute split).
 _WAIT_PHASES = {"NEGOTIATE", "QUEUE", "FUSE", "EXEC"}
 
+# Input-pipeline wait (data/loader.py DATA_WAIT spans): bucketed
+# separately so the per-rank decomposition reads input vs compute vs
+# comms — a rank stalled on its host data source attributes to input,
+# not to the collective it subsequently holds up.
+_DATA_PHASES = {"DATA_WAIT"}
+
 
 def _load_events(path: str) -> List[dict]:
     """Parse one per-rank trace, tolerating a truncated file (process
@@ -195,8 +201,9 @@ def report(trace_dir: str, top: int = 10) -> dict:
             "arrival_skew_us": round(skew_us, 1),
         })
 
-    # per-rank wait vs compute: wait = time inside coordination/comm
-    # span phases; compute = rest of that rank's trace extent
+    # per-rank wait vs input vs compute: wait = time inside
+    # coordination/comm span phases, data_wait = time inside the input
+    # pipeline's DATA_WAIT spans; compute = rest of the trace extent
     per_rank: Dict[int, dict] = {}
     for rank in traces:
         ts = [float(e["ts"]) for e in merged
@@ -206,12 +213,19 @@ def report(trace_dir: str, top: int = 10) -> dict:
             s["t1"] - s["t0"]
             for (tid, r), sps in spans.items() if r == rank
             for s in sps if s["phase"] in _WAIT_PHASES)
+        data_wait = sum(
+            s["t1"] - s["t0"]
+            for (tid, r), sps in spans.items() if r == rank
+            for s in sps if s["phase"] in _DATA_PHASES)
         wall_t0, offset, err = clock_metadata(traces[rank])
         per_rank[rank] = {
             "trace_extent_us": round(extent, 1),
             "wait_us": round(wait, 1),
-            "compute_us": round(max(extent - wait, 0.0), 1),
+            "data_wait_us": round(data_wait, 1),
+            "compute_us": round(max(extent - wait - data_wait, 0.0), 1),
             "wait_fraction": round(wait / extent, 4) if extent else 0.0,
+            "data_wait_fraction":
+                round(data_wait / extent, 4) if extent else 0.0,
             "clock_offset_us": offset,
             "clock_error_bound_us": err,
         }
@@ -235,17 +249,22 @@ def render_report(rep: dict) -> str:
     """Human-readable rendering of report()'s dict."""
     lines = [f"hvtputrace report — {rep['trace_dir']} "
              f"(ranks: {rep['ranks']})", ""]
-    lines.append("per-rank wait vs compute:")
+    lines.append("per-rank wait vs input vs compute:")
     lines.append(f"  {'rank':>4}  {'extent_ms':>10}  {'wait_ms':>10}  "
-                 f"{'compute_ms':>10}  {'wait%':>6}  {'clk_off_us':>10}")
+                 f"{'input_ms':>10}  {'compute_ms':>10}  {'wait%':>6}  "
+                 f"{'input%':>6}  {'clk_off_us':>10}")
     for rank in rep["ranks"]:
         row = rep["per_rank"][rank]
         off = row["clock_offset_us"]
+        data_wait_us = row.get("data_wait_us", 0.0)
+        data_frac = row.get("data_wait_fraction", 0.0)
         lines.append(
             f"  {rank:>4}  {row['trace_extent_us'] / 1e3:>10.2f}  "
             f"{row['wait_us'] / 1e3:>10.2f}  "
+            f"{data_wait_us / 1e3:>10.2f}  "
             f"{row['compute_us'] / 1e3:>10.2f}  "
             f"{row['wait_fraction'] * 100:>5.1f}%  "
+            f"{data_frac * 100:>5.1f}%  "
             f"{'n/a' if off is None else f'{off:.0f}':>10}")
     lines.append("")
     lines.append("top stragglers (times last to arrive):")
