@@ -25,6 +25,47 @@ TORCH_STEP_KEYS = (
     "steps_per_s",
 )
 
+# Schedule-prediction columns carried by every controller-driven row
+# since round 7 (enforced by tests/test_bench_guard.py): the fraction
+# of cycles in the timed window that skipped the KV round trip, and
+# the mispredict count/rate — a steady-state row with prediction
+# healthy shows predicted_fraction near 1 and zero mispredicts.
+PREDICT_ROW_KEYS = ("predicted_fraction", "mispredicts",
+                    "mispredict_rate")
+
+
+def snapshot_predict_counters():
+    """Controller cycle/prediction counter values for THIS process
+    (rank 0 when run under the runner: per_rank[0] is what lands in
+    the report)."""
+    from horovod_tpu.obs import metrics as obs_metrics
+
+    return {
+        "cycles": obs_metrics.counter(
+            "hvtpu_controller_cycles_total").value(),
+        "predicted": obs_metrics.counter(
+            "hvtpu_controller_predicted_cycles_total").value(),
+        "mispredicts": obs_metrics.counter(
+            "hvtpu_controller_mispredicts_total").value(),
+    }
+
+
+def build_predict_stats(before, after):
+    """The PREDICT_ROW_KEYS columns from two snapshot_predict_counters
+    readings bracketing a timed window.  Fractions are None when the
+    window ran no controller cycles (e.g. a 1-proc dispatch bench
+    short-circuiting the wire)."""
+    cycles = after["cycles"] - before["cycles"]
+    predicted = after["predicted"] - before["predicted"]
+    mis = after["mispredicts"] - before["mispredicts"]
+    return {
+        "predicted_fraction": (round(predicted / cycles, 3)
+                               if cycles else None),
+        "mispredicts": int(mis),
+        "mispredict_rate": (round(mis / cycles, 4)
+                            if cycles else None),
+    }
+
 
 def build_torch_step_row(np_, param_tensors, param_bytes, ms_per_step):
     """One JSON row for the torch DistributedOptimizer step-time bench
@@ -79,6 +120,7 @@ def run_torch_step(sizes_mb, iters, warmup=3):
 
         for _ in range(warmup):
             step()
+        snap = snapshot_predict_counters()
         t0 = time.perf_counter()
         for _ in range(iters):
             step()
@@ -89,6 +131,7 @@ def run_torch_step(sizes_mb, iters, warmup=3):
             sum(p.numel() * 4 for p in params), dt * 1e3,
         )
         row["dim"] = dim
+        row.update(build_predict_stats(snap, snapshot_predict_counters()))
         results.append(row)
     return results
 
@@ -120,12 +163,18 @@ def run_sweep(sizes_mb, iters, warmup=3):
         # async fused path: 8 tensors of n/8 through the controller
         k = 8
         chunk = torch.ones(max(n // k, 1), dtype=torch.float32)
-        for _ in range(warmup):
+        # warm up on the SAME names the timed loop uses: the row
+        # measures the steady state, and since round 7 that includes
+        # the predictor (first occurrence of a name set is observed,
+        # not predicted — distinct warmup names would bill that
+        # verification to the timed window)
+        for _ in range(2 * warmup):
             hs = [hvd.allreduce_async(chunk, op=hvd.Sum,
-                                      name=f"wa.{n}.{i}")
+                                      name=f"as.{n}.{i}")
                   for i in range(k)]
             for h in hs:
                 hvd.synchronize(h)
+        snap = snapshot_predict_counters()
         t0 = time.perf_counter()
         for it in range(iters):
             hs = [hvd.allreduce_async(chunk, op=hvd.Sum,
@@ -139,6 +188,7 @@ def run_sweep(sizes_mb, iters, warmup=3):
             "bench": "eager_allreduce", "nbytes": total,
             "mode": "async_fused", "gbps": total / dt / 1e9,
             "us_per_op": dt * 1e6 / k,
+            **build_predict_stats(snap, snapshot_predict_counters()),
         })
 
         # pipelined async: iteration k+1's batch is enqueued BEFORE
@@ -156,6 +206,7 @@ def run_sweep(sizes_mb, iters, warmup=3):
         for it in range(2 * warmup):
             for h in batch(it):
                 hvd.synchronize(h)
+        snap = snapshot_predict_counters()
         t0 = time.perf_counter()
         prev = None
         for it in range(iters):
@@ -171,6 +222,7 @@ def run_sweep(sizes_mb, iters, warmup=3):
             "bench": "eager_allreduce", "nbytes": total,
             "mode": "async_fused_pipe", "gbps": total / dt / 1e9,
             "us_per_op": dt * 1e6 / k,
+            **build_predict_stats(snap, snapshot_predict_counters()),
         })
     return results
 

@@ -694,6 +694,35 @@ def _allreduce_plan(st, ps, shape, dtype, nbytes, rop, compression):
     return plan
 
 
+def invalidate_routing_plans() -> int:
+    """Drop every ProcessSet's memoized allreduce routing plans.
+
+    Called by the eager controller on a schedule MISPREDICT: this rank
+    executed a fused grouping the coordinator did not release, so the
+    re-anchored negotiation may split the same tensors into
+    differently-shaped fusion groups.  The plans themselves are pure
+    functions of their keys, but dropping them forces the first
+    post-resync collective of each signature through the full routing
+    derivation (and a fresh ``_jitted`` entry), so no dispatch reuses
+    an artifact jitted for the mispredicted grouping.  Returns the
+    number of plans dropped (0 before init — protocol-level tests run
+    controllers without a world)."""
+    st = core_state.global_state()
+    if not getattr(st, "initialized", False):
+        return 0
+    dropped = 0
+    table = st.process_set_table
+    for psid in table.ids():
+        try:
+            ps = table.get(psid)
+        except ValueError:  # removed concurrently
+            continue
+        plans = ps.__dict__.pop("_eager_ar_plans", None)
+        if plans:
+            dropped += len(plans)
+    return dropped
+
+
 # --------------------------------------------------------------------------
 # public eager ops
 # --------------------------------------------------------------------------

@@ -86,6 +86,12 @@ _M_PREDICTED = obs_metrics.counter(
     "Steady-state bypass cycles whose agreed schedule was predicted "
     "locally from the replicated response cache and executed without "
     "waiting for the coordinator round trip.")
+_M_MISPREDICT = obs_metrics.counter(
+    "hvtpu_controller_mispredicts_total",
+    "Predicted schedules the coordinator did NOT confirm (the released "
+    "schedule differed); every one forces immediate full negotiation "
+    "and a cache-resync re-anchor — fail back to correct, never to "
+    "fast.")
 _M_MISMATCH = obs_metrics.counter(
     "hvtpu_controller_mismatch_errors_total",
     "Error responses for cross-rank tensor-metadata disagreement "
@@ -575,28 +581,50 @@ class EagerController:
         self._local_resp_ev = threading.Event()
         # Steady-state schedule prediction (see _try_predict): names
         # enqueued since the last drain, names drained but not yet
-        # scheduled onto the executor, and the FIFO of predicted
-        # Responses awaiting verification against the real stream.
+        # scheduled onto the executor, and the FIFO of predicted-and-
+        # executed bursts awaiting the coordinator's post-hoc
+        # confirmation — each record {"hash", "responses", "names"}
+        # holds the FNV-1a 64 of the predicted ResponseList blob (what
+        # a fully-predicted burst confirms as on the wire), the
+        # predicted Responses (what a partially-predicted burst streams
+        # back as), and the tensor names (mispredict blast radius).
         self._cache_capacity = cache_capacity
         self._pending_buf: List[str] = []  # hvtpulint: guarded-by(_lock)
         self._unsched: set = set()  # hvtpulint: guarded-by(_lock)
         self._predicted: "collections.deque" = collections.deque()  # hvtpulint: guarded-by(_lock)
+        # Names whose predicted execution already resolved their
+        # futures when a reset/mispredict abandoned the confirmation:
+        # late real responses for them tolerate the missing payload
+        # instead of dying on protocol corruption.
+        self._mispredict_names: set = set()  # hvtpulint: guarded-by(_lock)
         # bit-sets whose predicted schedule has been VERIFIED against
         # the real response stream once (see _try_predict), plus the
         # FIFO of first-occurrence observations awaiting verification
         self._verified_bits: set = set()  # hvtpulint: guarded-by(_lock)
         self._observe: "collections.deque" = collections.deque()  # hvtpulint: guarded-by(_lock)
         self._tuned_seen = False
-        # EXPERIMENTAL opt-in (see _try_predict): local schedule
-        # prediction assumes every rank drains the established steady
-        # burst atomically; a peer whose gate splits a burst under
-        # load diverges the predicted fusion grouping from the real
-        # release.  Sound general-case prediction needs coordinator-
-        # side atomic burst units (tracked as follow-up work in
-        # docs/benchmarks.md); until then the fast path is off unless
-        # HVTPU_EAGER_PREDICT=1.
+        # Schedule prediction (see _try_predict) is ON by default
+        # ("auto") since the coordinator gained atomic burst units
+        # (wire v5): a rank's drained burst ingests as one indivisible
+        # unit and the coordinator never forms a fusion group across a
+        # burst boundary, so a peer splitting a burst under load can
+        # no longer diverge the released schedule from the predicted
+        # one — the release is simply HELD until the unit completes.
+        # "0" disables the fast path entirely.
         self._predict_on = (
-            os.environ.get("HVTPU_EAGER_PREDICT", "0") == "1")
+            os.environ.get("HVTPU_EAGER_PREDICT", "auto") != "0")
+        # Atomic-burst drain cap: once the steady burst size is
+        # established, drain exactly one burst per wire unit even when
+        # the next step's enqueues already started queueing ("0"
+        # restores uncapped drains).
+        self._burst_cap_on = (
+            os.environ.get("HVTPU_EAGER_BURST_CAP", "1") != "0")
+        # Verbose prediction-abort / drain diagnostics to stderr.
+        self._debug = bool(os.environ.get("HVTPU_EAGER_DEBUG"))
+        # Frontend-declared burst size (see hint_burst): lets the gate
+        # hold a drain for a burst whose boundary it could not learn
+        # yet, consumed by the drain that covers it.
+        self._burst_hint = 0  # hvtpulint: guarded-by(_lock)
 
     # ---- lifecycle ----
     def start(self):
@@ -637,15 +665,41 @@ class EagerController:
         """Wait until this rank has no queued or in-flight eager ops —
         the pre-drain-commit barrier for core/preempt.py: the drain
         commit must not race collectives still being negotiated or
-        executed.  Returns True when the controller went idle within
-        ``timeout`` (immediately true when already idle)."""
+        executed.  A predicted cycle still awaiting the coordinator's
+        post-hoc confirmation also blocks idleness: the emergency
+        commit must not checkpoint state an unconfirmed (possibly
+        mispredicted) schedule produced.  If the confirmation does not
+        arrive within ``timeout`` while everything else is idle, the
+        predictor ROLLS BACK to full negotiation — abandon the
+        outstanding confirmations, force a resync re-anchor — and the
+        quiesce succeeds on re-verified ground.  Returns True when the
+        controller went idle within ``timeout`` (immediately true when
+        already idle)."""
         deadline = time.monotonic() + timeout
         while True:
             with self._lock:
-                idle = not self._payloads and self._undrained == 0
-            if idle:
+                busy = bool(self._payloads) or self._undrained != 0
+                unconfirmed = bool(self._predicted)
+            if not busy and not unconfirmed:
                 return True
             if time.monotonic() >= deadline:
+                rolled_back = 0
+                with self._lock:
+                    if (not self._payloads and self._undrained == 0
+                            and self._predicted):
+                        rolled_back = len(self._predicted)
+                        self._reset_predict_state()
+                        force = getattr(self._ctrl, "force_resync", None)
+                        if force is not None:
+                            force()
+                        self._post_needed = True
+                if rolled_back:
+                    logger.warning(
+                        "quiesce: %d predicted cycle(s) unconfirmed at "
+                        "deadline; rolled back to full negotiation",
+                        rolled_back)
+                    self._wake.set()
+                    return True
                 return False
             self._wake.set()
             time.sleep(0.01)
@@ -979,11 +1033,56 @@ class EagerController:
             self._predicted.clear()
             self._observe.clear()
             self._verified_bits.clear()
+            self._mispredict_names.clear()
         for p in payloads:
             p.future.set_error(HorovodInternalError(str(e)))
         self._stop.set()
         self._wake.set()
         self._local_resp_ev.set()
+
+    def _reset_predict_state(self):  # hvtpulint: requires(_lock)
+        """Forget everything the schedule predictor has learned —
+        called (under ``_lock``) on membership change, error
+        responses, coordinator-forced resync, mispredict, and quiesce
+        rollback.  Resets the burst gate's steady state ITSELF
+        (``_expected_burst``), not just the stability counter: after
+        an elastic resize or a mismatch error the old burst size is
+        exactly the wrong thing to keep gating (and predicting) on.
+        Outstanding predicted bursts are abandoned; their names move
+        to the tolerate set so late real responses for them don't
+        read as protocol corruption."""
+        self._expected_burst = 0
+        self._burst_stable = 0
+        self._verified_bits.clear()
+        self._observe.clear()
+        for rec in self._predicted:
+            self._mispredict_names.update(rec["names"])
+        self._predicted.clear()
+
+    def _on_mispredict(self, why: str):  # hvtpulint: requires(_lock)
+        """A predicted-and-executed schedule the coordinator did NOT
+        confirm: fail back to correct, never to fast (callers hold
+        ``_lock``).  Forces the next drain to be a full-entry resync
+        re-anchor, drops the comm layer's memoized routing plans (they
+        may hold artifacts jitted for the mispredicted grouping), and
+        resets the predictor so the pattern must re-verify from
+        scratch."""
+        _M_MISPREDICT.inc()
+        logger.error(
+            "schedule mispredict (%s): forcing full negotiation + "
+            "cache-resync re-anchor", why)
+        if tracing.ACTIVE:
+            tracing.instant("mispredict", why=why)
+        self._reset_predict_state()
+        force = getattr(self._ctrl, "force_resync", None)
+        if force is not None:
+            force()
+        self._post_needed = True
+        self._wake.set()
+        try:
+            eager_comm.invalidate_routing_plans()
+        except Exception:  # pragma: no cover — uninitialized worlds
+            pass
 
     # ---- streamed control plane (multi-process KV transports) ----
     # Three threads instead of one lockstep cycle: the DRAINER gates
@@ -1038,23 +1137,38 @@ class EagerController:
         """Gate, drain and post ONE request blob (rank 0 ingests its
         own blob directly — no KV round trip for the coordinator's own
         ops); in steady state the agreed schedule is predicted and
-        executed before the blob even leaves this host."""
+        executed before the blob even leaves this host, and the blob
+        itself goes out carrying the PREDICTED confirmation flag
+        instead of waiting on a response round trip."""
         t0 = time.monotonic()
         self._gate_burst()
+        # Atomic-burst drain cap: with an established steady burst,
+        # drain exactly one burst per wire unit — enqueues of the NEXT
+        # step that raced in during the gate stay queued for their own
+        # unit instead of riding (and destabilizing) this one.
+        limit = (self._expected_burst
+                 if self._burst_cap_on and self._burst_stable >= 2
+                 else 0)
         with self._lock:
             drained = self._undrained
-            self._undrained = 0
             post_needed = self._post_needed
-            self._post_needed = False
             if drained == 0 and not post_needed:
                 return False
-            names = self._pending_buf
-            self._pending_buf = []
-            req = self._ctrl.drain_requests()
+            take = min(drained, limit) if limit else drained
+            self._undrained -= take
+            self._post_needed = False
+            names = self._pending_buf[:take]
+            del self._pending_buf[:take]
+            req = self._ctrl.drain_requests(limit)
         parsed = None
-        if drained:
-            parsed = self._note_drained(drained, req)
+        if take:
+            parsed = self._note_drained(take, req)
         if parsed is not None and self._try_predict(parsed, names):
+            # Executed locally already: the blob becomes a compact
+            # post-hoc confirmation (flags bit flipped in place) —
+            # the coordinator matches it against its own release and
+            # answers with a confirm hash, not a ResponseList.
+            req = wire.mark_predicted(req)
             names = []
         if names:
             with self._lock:
@@ -1065,6 +1179,8 @@ class EagerController:
         else:
             self._transport.post_request(self._req_idx, req)
             self._req_idx += 1
+        if take < drained:
+            self._wake.set()  # capped remainder drains next pass
         _M_CYCLES.inc()
         _M_CYCLE_S.observe(time.monotonic() - t0)
         return True
@@ -1099,14 +1215,25 @@ class EagerController:
           exactly this fused response for this set.
 
         A rank that predicts and a rank that repeats the verified
-        pattern execute the same collectives in the same order; the
-        only divergence a misprediction could cause is a peer
+        pattern execute the same collectives in the same order, and
+        the coordinator's atomic burst units (wire v5) guarantee the
+        release can never fuse across a burst boundary — a peer whose
+        gate splits a burst merely HOLDS the release until the unit
+        completes.  A misprediction therefore requires a peer
         DEVIATING from a pattern it just established without a cache
-        miss — the strict-SPMD contract the sync API already imposes,
-        caught by the same stall watchdog.  ``HVTPU_EAGER_PREDICT=0``
-        disables the fast path entirely."""
+        miss — the strict-SPMD contract the sync API already imposes —
+        and even then the coordinator's refusal to confirm forces an
+        immediate full negotiation + resync re-anchor (see
+        _fetch_loop): fail back to correct, never to fast.
+        Default-on ("auto"); ``HVTPU_EAGER_PREDICT=0`` disables the
+        fast path entirely."""
         if not (self._stream and self._predict_on
                 and parsed.cache_bypass):
+            return False
+        if preempt.PENDING:
+            # A coordinated drain is in flight: no NEW speculation —
+            # everything from here to the emergency commit runs fully
+            # negotiated (quiesce handles predictions already made).
             return False
         if self._autotuner is not None or self._tuned_seen:
             return False
@@ -1133,6 +1260,10 @@ class EagerController:
                 return False
         got = [n for rs in rl.responses for n in rs.tensor_names]
         if sorted(got) != sorted(names):
+            if self._debug:
+                logger.error(
+                    "predict abort: schedule covers %r, drain holds %r",
+                    sorted(got), sorted(names))
             return False
         key = frozenset(bits)
         with self._lock:
@@ -1147,7 +1278,20 @@ class EagerController:
                 while len(self._observe) > 8:
                     self._observe.popleft()
                 return False
-            self._predicted.extend(rl.responses)
+            # One record per predicted burst: the hash is what a
+            # fully-predicted release confirms as (the coordinator
+            # hashes the bare fused ResponseList of the burst's
+            # component — byte-identical to `blob` by construction);
+            # the responses are what a PARTIALLY-predicted release
+            # (some member observed instead) streams back as.
+            self._predicted.append({
+                "hash": wire.fnv1a64(blob),
+                "responses": list(rl.responses),
+                "names": list(got),
+            })
+        if tracing.ACTIVE:
+            for n in got:
+                tracing.op_phase(n, tracing.PREDICT)
         # retire in-flight NOW: the futures resolve on execution, and
         # the next step re-enqueues the same names before the real
         # response streams in
@@ -1187,7 +1331,11 @@ class EagerController:
         self._drain_arrival_skew()
         rl = wire.parse_response_list(resp)
         tuned = (rl.tuned_fusion_threshold, rl.tuned_cycle_time_us)
-        trivial = (not rl.responses and rl.join_last_rank < 0
+        # confirm_hashes are non-trivial: every predictor's FIFO is
+        # waiting on them (an unposted confirmation would read as a
+        # mispredict-shaped stall at quiesce time)
+        trivial = (not rl.responses and not rl.confirm_hashes
+                   and rl.join_last_rank < 0
                    and not rl.shutdown and not rl.cache_resync_needed
                    and tuned == self._last_tuned)
         if not trivial:
@@ -1232,21 +1380,53 @@ class EagerController:
                     # controller's resync-flush handling)
                     self._post_needed = True
                     self._wake.set()
-                # verify-and-skip responses already executed from a
-                # predicted schedule (FIFO: the response stream and
-                # the prediction order are both drain-ordered); every
-                # other response marks its tensors as scheduled
                 with self._lock:
+                    # Post-hoc confirmations first: the coordinator
+                    # emits burst components in every rank's drain
+                    # order, so each hash must retire the OLDEST
+                    # outstanding prediction.  A hash matching nothing
+                    # in the FIFO belongs to a component this rank is
+                    # not a member of (or is stale after a reset) —
+                    # ignored; a hash matching a LATER record means
+                    # the head burst was released differently:
+                    # mispredict.
+                    for h in rl.confirm_hashes:
+                        if (self._predicted
+                                and h == self._predicted[0]["hash"]):
+                            self._predicted.popleft()
+                        elif any(h == rec["hash"]
+                                 for rec in self._predicted):
+                            self._on_mispredict(
+                                "confirmation skipped the oldest "
+                                "outstanding prediction (hash "
+                                f"{h:#018x} matched a later burst)")
+                    # verify-and-skip responses already executed from
+                    # a predicted schedule (FIFO: the response stream
+                    # and the prediction order are both drain-
+                    # ordered); every other response marks its tensors
+                    # as scheduled
                     keep = []
                     for rs in rl.responses:
-                        if self._predicted and rs == self._predicted[0]:
-                            self._predicted.popleft()
+                        rec = (self._predicted[0] if self._predicted
+                               else None)
+                        if (rec is not None and rec["responses"]
+                                and rs == rec["responses"][0]):
+                            # a partially-predicted burst (some member
+                            # observed instead, so no suppression)
+                            # streams real responses: byte-verify
+                            # against the prediction, skip re-execution
+                            rec["responses"].pop(0)
+                            if not rec["responses"]:
+                                self._predicted.popleft()
                             continue
-                        if self._predicted and os.environ.get(
-                                "HVTPU_EAGER_DEBUG"):
-                            logger.error(
-                                "predict mismatch:\n real=%r\n pred=%r",
-                                rs, self._predicted[0])
+                        if rec is not None and set(
+                                rs.tensor_names) & set(rec["names"]):
+                            # shares tensors with the oldest predicted
+                            # burst but differs from its schedule: the
+                            # coordinator released something else
+                            self._on_mispredict(
+                                "released schedule diverged from the "
+                                f"predicted one for {rs.tensor_names}")
                         for n in rs.tensor_names:
                             self._unsched.discard(n)
                         if self._observe:
@@ -1282,6 +1462,21 @@ class EagerController:
                 return
 
     # ---- shared negotiation plumbing ----
+    def hint_burst(self, n: int):
+        """Frontend burst declaration: the enqueue burst now streaming
+        in will contain ``n`` ops (the torch ``DistributedOptimizer``
+        knows its per-step gradient count; hooks re-arm the hint every
+        backward).  The gate then holds the drain for the whole hinted
+        burst instead of guessing the boundary from quiet gaps — on a
+        loaded host the gap between two backward hooks can exceed any
+        reasonable quiesce window, and every mis-split burst packs
+        novel fusion-buffer shapes (fresh XLA compiles) AND denies the
+        schedule predictor a stable pattern.  Purely a latency gate: a
+        wrong hint costs at most the gate deadline, never correctness.
+        Consumed by the next drain that covers it."""
+        with self._lock:
+            self._burst_hint = max(0, int(n))
+
     def _gate_burst(self):
         """Fusion-coalescing gate (the reference gets this from
         cycle_time batching: ops enqueued within one cycle fuse into
@@ -1315,15 +1510,22 @@ class EagerController:
             # burst deadline to frontend-scale latencies.
             quiesce = max(quiesce, 0.004)
             span = max(span, 0.024)
+        with self._lock:
+            hint = self._burst_hint
         expected = (self._expected_burst
-                    if self._burst_stable >= 2 else 0)
+                    if self._burst_stable >= 2 else hint)
         # Steady mode waits for the WHOLE expected burst (a split
         # burst changes the negotiated fusion groups — recompiles at
         # best, and at worst diverges a predicted schedule from the
         # real one), with a long deadline so only a genuine workload
-        # change (which then resets stability) can split it.
-        deadline = time.monotonic() + (max(span, 0.05) if expected
-                                       else span)
+        # change (which then resets stability) can split it.  A
+        # frontend-hinted burst gets the longest hold: the hint is
+        # declared intent, and the hooks feeding it can be paced by a
+        # slow backward under load.
+        deadline = time.monotonic() + (
+            max(span, 0.25) if hint and expected
+            else max(span, 0.05) if expected
+            else span)
         while True:
             with self._lock:
                 undrained = self._undrained
@@ -1354,6 +1556,9 @@ class EagerController:
         else:
             self._expected_burst = drained
             self._burst_stable = 0
+        with self._lock:
+            if self._burst_hint and drained >= self._burst_hint:
+                self._burst_hint = 0  # consumed; hooks re-arm per step
         parsed = wire.parse_request_list(req)
         if parsed.cache_bypass:
             _M_BYPASS.inc()
@@ -1369,6 +1574,16 @@ class EagerController:
                             finished: List[int]):
         """Run (or hand to the pipelined executor) one applied
         ResponseList, then fold in tuning/shutdown signals."""
+        if (rl.cache_resync_needed or rl.join_last_rank >= 0
+                or any(rs.error for rs in rl.responses)):
+            # Membership changes, coordinator-forced resyncs and error
+            # responses (mismatch diagnostics included) invalidate
+            # everything the predictor learned — including the burst
+            # gate's _expected_burst itself: a stale steady size from
+            # before a resize/mismatch would gate (and predict) the
+            # wrong burst shape until it happened to repeat.
+            with self._lock:
+                self._reset_predict_state()
         if tracing.ACTIVE and rl.responses:
             # Negotiation is over for these tensors: they now wait for
             # executor pickup.  op_phase no-ops for names this rank
@@ -1530,6 +1745,7 @@ class EagerController:
             queue_depth = len(self._payloads)
             undrained = self._undrained
             unscheduled = len(self._unsched)
+            predicted_in_flight = len(self._predicted)
             in_flight = sorted(self._by_name)[:64]
         out: Dict[str, Any] = {
             "rank": self.rank,
@@ -1541,6 +1757,7 @@ class EagerController:
             "queue_depth": queue_depth,
             "undrained": undrained,
             "unscheduled": unscheduled,
+            "predicted_in_flight": predicted_in_flight,
             "in_flight_ops": in_flight,
             "thread_error": (repr(self._thread_error)
                              if self._thread_error else None),
@@ -1621,6 +1838,12 @@ class EagerController:
                     del self._by_name[n]
                     out.append(self._payloads.pop(seq))
                 elif not strict:
+                    continue
+                elif n in self._mispredict_names:
+                    # already executed (and resolved) from a predicted
+                    # schedule whose confirmation was later abandoned;
+                    # the late real response is pure bookkeeping
+                    self._mispredict_names.discard(n)
                     continue
                 elif self._joined_local:
                     out.append(self._zero_payload(rs, i))

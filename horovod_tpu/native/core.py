@@ -72,6 +72,7 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.hvt_controller_set_shutdown.argtypes = [c.c_void_p]
     lib.hvt_controller_set_resync_every.argtypes = [c.c_void_p, c.c_int64]
+    lib.hvt_controller_force_resync.argtypes = [c.c_void_p]
     lib.hvt_controller_predict_responses.restype = c.c_int64
     lib.hvt_controller_predict_responses.argtypes = [
         c.c_void_p, c.POINTER(c.c_uint32), c.c_int64,
@@ -84,7 +85,7 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.hvt_controller_drain_requests.restype = c.c_int64
     lib.hvt_controller_drain_requests.argtypes = [
-        c.c_void_p, c.POINTER(c.c_uint8), c.c_int64,
+        c.c_void_p, c.POINTER(c.c_uint8), c.c_int64, c.c_int64,
     ]
     lib.hvt_controller_ingest.argtypes = [
         c.c_void_p, c.POINTER(c.c_uint8), c.c_int64,
@@ -159,7 +160,7 @@ def load() -> Optional[ctypes.CDLL]:
         _lib = _configure(ctypes.CDLL(path))
     except (OSError, AttributeError):
         return None
-    if _lib.hvt_abi_version() != 4:
+    if _lib.hvt_abi_version() != 5:
         _lib = None
     return _lib
 
@@ -235,8 +236,16 @@ class NativeController:
         fn(self._ptr, _as_u8(buf), n)
         return bytes(buf)
 
-    def drain_requests(self) -> bytes:
-        return self._blob_call(self._lib.hvt_controller_drain_requests)
+    def drain_requests(self, limit: int = 0) -> bytes:
+        """limit > 0 caps the drained entries at the caller's known
+        steady burst size (atomic-burst cap; 0 = drain everything)."""
+        fn = self._lib.hvt_controller_drain_requests
+        n = fn(self._ptr, None, 0, limit)
+        if n == 0:
+            return b""
+        buf = bytearray(n)
+        fn(self._ptr, _as_u8(buf), n, limit)
+        return bytes(buf)
 
     def ingest(self, blob: bytes):
         buf = bytearray(blob)
@@ -286,6 +295,12 @@ class NativeController:
         resync blob (0 disables the bypass fast path entirely)."""
         self.resync_every = int(n)
         self._lib.hvt_controller_set_resync_every(self._ptr, int(n))
+
+    def force_resync(self):
+        """Rank-side re-anchor (mispredict recovery / quiesce rollback):
+        the next drain_requests emits a full-entry resync frame exactly
+        as if the coordinator had requested cache_resync_needed."""
+        self._lib.hvt_controller_force_resync(self._ptr)
 
     def predict_responses(self, bits: Sequence[int]) -> Optional[bytes]:
         """Predicted steady-state ResponseList for a pure bypass cycle

@@ -86,8 +86,22 @@ class PyController:
         # steady-state bypass bookkeeping (see drain_requests)
         self._bypass_streak = 0
         self._resync_flush = False
-        # coordinator state
-        self._message_table: Dict[str, dict] = {}
+        # per-rank monotonic burst-unit counter (drain side)
+        self._burst_seq = 0
+        # coordinator state.  Each key holds an OCCURRENCE QUEUE of
+        # pending coordinations (front = oldest): with prediction on, a
+        # rank's fire-and-forget confirmations can announce the same
+        # tensor names for several bursts before the coordinator
+        # catches up, so one-slot-per-key would collapse distinct
+        # bursts into one release.
+        self._message_table: Dict[str, List[dict]] = {}
+        # (rank, burst_id) -> set of table keys forming that rank's
+        # atomic burst unit; a ready op releases only when every unit
+        # containing it is completely ready, and fusion runs per
+        # connected unit component — never across a burst boundary.
+        self._units: Dict[Tuple[int, int], Set[str]] = {}
+        # monotonic creation index for deterministic component ordering
+        self._pc_seq = 0
         self._joined_ranks: Set[int] = set()
         self._last_joined_rank = -1
         self._tuned_threshold = -1
@@ -143,7 +157,16 @@ class PyController:
     def set_resync_every(self, n: int):
         self.resync_every = int(n)
 
-    def drain_requests(self) -> bytes:
+    def force_resync(self):
+        """Rank-side re-anchor (mispredict recovery / quiesce rollback):
+        the next drain_requests emits a full-entry resync frame —
+        re-announcing in-flight ops — exactly as if the coordinator had
+        requested cache_resync_needed."""
+        with self._lock:
+            self._resync_flush = True
+            self._bypass_streak = 0
+
+    def drain_requests(self, limit: int = 0) -> bytes:
         with self._lock:
             rl = wire.RequestList(rank=self.rank, joined=self._joined,
                                   shutdown=self._shutdown)
@@ -156,8 +179,16 @@ class PyController:
                 sorted(self._in_flight.values(),
                        key=lambda e: self._table_key(e))
                 if resync_flush else [])
-            entries = list(self._pending)
-            self._pending.clear()
+            if limit > 0 and len(self._pending) > limit:
+                # Atomic-burst cap: a caller that knows the steady burst
+                # size drains exactly one burst even when the next one
+                # already started queueing, so each wire unit maps to
+                # exactly one application burst.
+                entries = self._pending[:limit]
+                del self._pending[:limit]
+            else:
+                entries = list(self._pending)
+                self._pending.clear()
             bits: List[int] = []
             for e in entries:
                 self._in_flight[e.name] = e
@@ -177,6 +208,9 @@ class PyController:
                     and self._bypass_streak + 1 < self.resync_every):
                 self._bypass_streak += 1
                 rl.cache_bypass = True
+                self._burst_seq += 1
+                rl.burst_id = self._burst_seq
+                rl.burst_len = len(bits)
                 rl.cache_bits = wire.bits_to_words(sorted(bits))
                 return wire.serialize_request_list(rl)
             self._bypass_streak = 0
@@ -185,6 +219,13 @@ class PyController:
             # and stall inspector authoritative even if caches diverge.
             resync = resync_flush or (all_hit and not membership)
             rl.cache_resync = resync
+            if entries:
+                # Fresh entries form one atomic burst unit; resync
+                # re-announcements (prior_in_flight) ride behind them,
+                # OUTSIDE the unit, and match idempotently at ingest.
+                self._burst_seq += 1
+                rl.burst_id = self._burst_seq
+                rl.burst_len = len(entries)
             for e, bit in zip(entries, bits):
                 rq = wire.Request(rank=self.rank)
                 if bit >= 0:
@@ -265,27 +306,57 @@ class PyController:
         return (f"op={e.type} red_op={e.red_op} dtype={e.dtype} "
                 f"shape=[{dims}] root_rank={e.root_rank}")
 
-    def _table_add(self, e: wire.Entry, rank: int, now: float):
+    def _table_add(self, e: wire.Entry, rank: int, now: float,
+                   occurrence: bool = False) -> Tuple[str, dict]:
         """Record one rank's announcement in the message table,
         tracking conflicting submissions per rank (must match
-        Controller::TableAdd)."""
+        Controller::TableAdd).
+
+        ``occurrence=True`` (burst-unit announcements) treats the
+        announcement as a NEW occurrence relative to any this rank
+        already announced, so back-to-back confirmed bursts of the same
+        tensor names queue instead of collapsing into one release.
+        ``occurrence=False`` (unit-less frames and resync
+        re-announcements past ``burst_len``) matches idempotently: a
+        rank re-announcing an in-flight op lands on the occurrence it
+        already joined, never opening a duplicate."""
         key = self._table_key(e)
-        pc = self._message_table.get(key)
+        q = self._message_table.get(key)
+        if q is None:
+            q = self._message_table[key] = []
+        pc: Optional[dict] = None
+        if occurrence:
+            for cand in q:
+                if rank not in cand["ranks"]:
+                    pc = cand
+                    break
+        else:
+            for cand in q:
+                if rank in cand["ranks"]:
+                    pc = cand
+                    break
+            if pc is None and q:
+                pc = q[0]
         if pc is None:
             # "arrived" (first announcement time per rank) is local
             # bookkeeping for arrival-skew attribution — not part of
             # the C++ parity surface.
-            self._message_table[key] = {
+            pc = {
                 "entry": e, "ranks": {rank}, "first_seen": now,
                 "first_rank": rank, "mismatch": {},
                 "arrived": {rank: now},
+                "units": set(), "predicted": set(),
+                "seq": self._pc_seq,
             }
-            return
+            self._pc_seq += 1
+            q.append(pc)
+            return key, pc
         pc["ranks"].add(rank)
         pc["arrived"].setdefault(rank, now)
         if (rank != pc["first_rank"] and rank not in pc["mismatch"]
                 and not self._same_params(e, pc["entry"])):
             pc["mismatch"][rank] = e
+        return key, pc
 
     def ingest(self, blob: bytes):
         rl = wire.parse_request_list(blob)
@@ -297,26 +368,47 @@ class PyController:
                 self._last_joined_rank = rl.rank
             if rl.shutdown:
                 self._shutdown_ranks.add(rl.rank)
+            ref = ((rl.rank, rl.burst_id)
+                   if rl.burst_id > 0 and rl.burst_len > 0 else None)
+            unit_keys: Set[str] = set()
             if rl.cache_bypass:
                 # Expand the rank's cache-bit vector through the
                 # coordinator's own (identical) cache.  An unknown bit
                 # means the caches diverged (e.g. elastic generations
                 # mixing): request a full resync from every rank.
-                for bit in wire.words_to_bits(rl.cache_bits):
+                for idx, bit in enumerate(wire.words_to_bits(rl.cache_bits)):
                     cached = self._cache.entry_for_bit(bit)
                     if cached is None:
                         self._resync_needed = True
                         continue
                     e = wire.Entry(**{**cached.__dict__, "seq": 0})
-                    self._table_add(e, rl.rank, now)
+                    in_unit = ref is not None and idx < rl.burst_len
+                    key, pc = self._table_add(e, rl.rank, now,
+                                              occurrence=in_unit)
+                    if in_unit:
+                        pc["units"].add(ref)
+                        unit_keys.add(key)
+                        if rl.predicted:
+                            pc["predicted"].add(rl.rank)
+                if ref is not None and unit_keys:
+                    self._units[ref] = unit_keys
                 return
-            for rq in rl.requests:
+            for idx, rq in enumerate(rl.requests):
                 e = rq.entry
                 if rq.cached:
                     cached = self._cache.entry_for_bit(rq.cache_bit)
                     if cached is not None:
                         e = wire.Entry(**{**cached.__dict__, "seq": rq.entry.seq})
-                self._table_add(e, rl.rank, now)
+                in_unit = ref is not None and idx < rl.burst_len
+                key, pc = self._table_add(e, rl.rank, now,
+                                          occurrence=in_unit)
+                if in_unit:
+                    pc["units"].add(ref)
+                    unit_keys.add(key)
+                    if rl.predicted:
+                        pc["predicted"].add(rl.rank)
+            if ref is not None and unit_keys:
+                self._units[ref] = unit_keys
 
     def _required_ranks(self, psid: int) -> int:
         ranks = self._process_sets.get(psid)
@@ -333,6 +425,22 @@ class PyController:
             if r in pc["ranks"] or r in self._joined_ranks
         )
 
+    def _release_front(self, key: str, pc: dict):
+        """Pop a released coordination off its occurrence queue and drop
+        its key from every burst unit that referenced it (so an
+        error-released member doesn't deadlock the rest of its unit)."""
+        q = self._message_table.get(key)
+        if q and q[0] is pc:
+            q.pop(0)
+            if not q:
+                del self._message_table[key]
+        for ref in pc["units"]:
+            s = self._units.get(ref)
+            if s is not None:
+                s.discard(key)
+                if not s:
+                    del self._units[ref]
+
     def compute_responses(self) -> bytes:
         with self._lock:
             out = wire.ResponseList(
@@ -341,92 +449,181 @@ class PyController:
             )
             out.cache_resync_needed = self._resync_needed
             self._resync_needed = False
-            # deterministic (psid, name) order == std::map iteration
+            # deterministic (psid, name) order == std::map iteration;
+            # only the FRONT occurrence of each key is eligible, so
+            # per-key release order always matches announcement order.
+            fronts = {key: q[0]
+                      for key, q in self._message_table.items() if q}
             ready = [
-                key for key in sorted(self._message_table)
-                if self._present_count(self._message_table[key])
-                >= self._required_ranks(
-                    self._message_table[key]["entry"].process_set_id)
+                key for key in sorted(fronts)
+                if self._present_count(fronts[key])
+                >= self._required_ranks(fronts[key]["entry"].process_set_id)
             ]
             group_counts: Dict[int, int] = collections.Counter(
-                self._message_table[n]["entry"].group_id
+                fronts[n]["entry"].group_id
                 for n in ready
-                if self._message_table[n]["entry"].group_id >= 0
+                if fronts[n]["entry"].group_id >= 0
             )
-            responses: List[wire.Response] = []
+            candidates: Dict[str, dict] = {}
+            mismatch_keys: List[str] = []
             for key in ready:
-                pc = self._message_table[key]
+                pc = fronts[key]
                 e = pc["entry"]
                 if e.group_id >= 0:
                     want = self._groups.get(e.group_id, -1)
                     if want > 0 and group_counts[e.group_id] < want:
                         continue
-                rs = wire.Response(
-                    type=e.type, red_op=e.red_op, dtype=e.dtype,
-                    process_set_id=e.process_set_id, root_rank=e.root_rank,
-                    tensor_names=[e.name], tensor_shapes=[tuple(e.shape)],
-                    total_bytes=e.nbytes,
-                )
                 if pc["mismatch"]:
-                    # Cross-rank disagreement: fail LOUDLY on every
-                    # member rank, naming each offender and what it
-                    # submitted (parity: the reference controller's
-                    # "Mismatched ..." error responses; text must match
-                    # Controller::BuildResponseList byte-for-byte).
-                    # The error broadcast also forces a full cache
-                    # resync below, re-anchoring the bypass plane.
-                    parts = [f"rank {pc['first_rank']} submitted "
-                             f"{self._entry_desc(e)}"]
-                    for r in sorted(pc["mismatch"]):
-                        parts.append(
-                            f"rank {r} submitted "
-                            f"{self._entry_desc(pc['mismatch'][r])}")
-                    rs.error = (f"cross-rank tensor mismatch for "
-                                f"'{e.name}': " + "; ".join(parts))
-                    out.cache_resync_needed = True
-                    responses.append(rs)
-                    del self._message_table[key]
+                    mismatch_keys.append(key)
+                else:
+                    candidates[key] = pc
+            # Atomic-unit admission: a ready op releases only when every
+            # burst unit containing it is COMPLETELY ready, and the
+            # transitive closure over shared unit refs partitions the
+            # releasable work into connected components.  Fusion runs
+            # per component (fresh open-group state each time), so the
+            # coordinator can never form a fusion group across a burst
+            # boundary — a peer's split burst holds its whole component
+            # back instead of diverging the fused groupings that
+            # predict_responses() reconstructed locally.
+            components: List[Tuple[int, List[str]]] = []
+            assigned: Set[str] = set()
+            for key in sorted(candidates):
+                if key in assigned:
                     continue
-                # Zero substitution from joined ranks is only sound for
-                # additive semantics (must match Controller's C++ texts
-                # byte-for-byte for the cross-check tests).
-                used_joined = any(
-                    r not in pc["ranks"] and r in self._joined_ranks
-                    for r in self._member_ranks(e.process_set_id)
-                )
-                if used_joined:
-                    if (e.type == wire.BROADCAST and e.root_rank >= 0
-                            and e.root_rank not in pc["ranks"]
-                            and e.root_rank in self._joined_ranks):
-                        rs.error = (f"broadcast root rank {e.root_rank} "
-                                    "has joined")
-                    elif (e.type in (wire.ALLREDUCE, wire.REDUCESCATTER)
-                          and e.red_op in (wire.RED_MIN, wire.RED_MAX,
-                                           wire.RED_PRODUCT,
-                                           wire.RED_ADASUM)):
-                        rs.error = (f"reduction op {e.red_op} does not "
-                                    "support joined-rank zero contribution")
-                    elif (e.type in (wire.ALLREDUCE, wire.REDUCESCATTER)
-                          and e.dtype == wire.DTYPE_IDS["int8"]):
-                        rs.error = ("int8 wire format does not support "
-                                    "joined-rank zero contribution")
-                arrived = pc.get("arrived") or {}
-                if len(arrived) >= 2:
-                    last_rank = max(arrived, key=arrived.get)
-                    skew = max(arrived.values()) - min(arrived.values())
-                    self._skew_events.append((e.name, skew, last_rank))
-                    if len(self._skew_events) > 1024:
-                        del self._skew_events[:-1024]
-                responses.append(rs)
-                del self._message_table[key]
-            out.responses = self._fuse(responses)
+                comp: Set[str] = set()
+                ok = True
+                stack = [key]
+                while stack:
+                    k = stack.pop()
+                    if k in comp:
+                        continue
+                    pc = candidates.get(k)
+                    if pc is None:
+                        ok = False
+                        break
+                    comp.add(k)
+                    for ref in pc["units"]:
+                        for k2 in self._units.get(ref, ()):
+                            if (k2 not in candidates
+                                    or ref not in candidates[k2]["units"]):
+                                ok = False
+                                break
+                            if k2 not in comp:
+                                stack.append(k2)
+                        if not ok:
+                            break
+                    if not ok:
+                        break
+                if not ok:
+                    continue  # a unit is split-pending: hold the component
+                assigned |= comp
+                components.append(
+                    (min(candidates[k]["seq"] for k in comp), sorted(comp)))
+            # Mismatch errors bypass unit gating (fail fast; the forced
+            # resync re-anchors the survivors) as singleton components.
+            for key in mismatch_keys:
+                components.append((fronts[key]["seq"], [key]))
+            # Creation order == per-rank announcement order on every
+            # stream, so component emission order matches every
+            # predictor's confirmation FIFO.
+            components.sort()
+            emitted: List[wire.Response] = []
+            for _, comp_keys in components:
+                responses: List[wire.Response] = []
+                suppress = True
+                for key in comp_keys:
+                    pc = fronts[key]
+                    e = pc["entry"]
+                    rs = wire.Response(
+                        type=e.type, red_op=e.red_op, dtype=e.dtype,
+                        process_set_id=e.process_set_id,
+                        root_rank=e.root_rank,
+                        tensor_names=[e.name],
+                        tensor_shapes=[tuple(e.shape)],
+                        total_bytes=e.nbytes,
+                    )
+                    if pc["mismatch"]:
+                        # Cross-rank disagreement: fail LOUDLY on every
+                        # member rank, naming each offender and what it
+                        # submitted (parity: the reference controller's
+                        # "Mismatched ..." error responses; text must
+                        # match Controller::BuildResponseList
+                        # byte-for-byte).  The error broadcast also
+                        # forces a full cache resync, re-anchoring the
+                        # bypass AND predict planes.
+                        parts = [f"rank {pc['first_rank']} submitted "
+                                 f"{self._entry_desc(e)}"]
+                        for r in sorted(pc["mismatch"]):
+                            parts.append(
+                                f"rank {r} submitted "
+                                f"{self._entry_desc(pc['mismatch'][r])}")
+                        rs.error = (f"cross-rank tensor mismatch for "
+                                    f"'{e.name}': " + "; ".join(parts))
+                        out.cache_resync_needed = True
+                        suppress = False
+                        responses.append(rs)
+                        self._release_front(key, pc)
+                        continue
+                    # Zero substitution from joined ranks is only sound
+                    # for additive semantics (must match Controller's
+                    # C++ texts byte-for-byte for the cross-check tests).
+                    used_joined = any(
+                        r not in pc["ranks"] and r in self._joined_ranks
+                        for r in self._member_ranks(e.process_set_id)
+                    )
+                    if used_joined:
+                        if (e.type == wire.BROADCAST and e.root_rank >= 0
+                                and e.root_rank not in pc["ranks"]
+                                and e.root_rank in self._joined_ranks):
+                            rs.error = (f"broadcast root rank "
+                                        f"{e.root_rank} has joined")
+                        elif (e.type in (wire.ALLREDUCE, wire.REDUCESCATTER)
+                              and e.red_op in (wire.RED_MIN, wire.RED_MAX,
+                                               wire.RED_PRODUCT,
+                                               wire.RED_ADASUM)):
+                            rs.error = (f"reduction op {e.red_op} does "
+                                        "not support joined-rank zero "
+                                        "contribution")
+                        elif (e.type in (wire.ALLREDUCE, wire.REDUCESCATTER)
+                              and e.dtype == wire.DTYPE_IDS["int8"]):
+                            rs.error = ("int8 wire format does not support "
+                                        "joined-rank zero contribution")
+                    arrived = pc.get("arrived") or {}
+                    if len(arrived) >= 2:
+                        last_rank = max(arrived, key=arrived.get)
+                        skew = max(arrived.values()) - min(arrived.values())
+                        self._skew_events.append((e.name, skew, last_rank))
+                        if len(self._skew_events) > 1024:
+                            del self._skew_events[:-1024]
+                    members = self._member_ranks(e.process_set_id)
+                    if (rs.error or used_joined
+                            or pc["predicted"] != set(members)):
+                        suppress = False
+                    responses.append(rs)
+                    self._release_front(key, pc)
+                fused = self._fuse(responses)
+                if suppress and fused and not any(r.error for r in fused):
+                    # Every member rank announced this whole component
+                    # as a PREDICTED confirmation: each already executed
+                    # the identical locally predicted schedule, so emit
+                    # only the hash of the would-be response bytes —
+                    # the response-side half of killing the round trip.
+                    blob = wire.serialize_response_list(
+                        wire.ResponseList(responses=fused))
+                    out.confirm_hashes.append(wire.fnv1a64(blob))
+                else:
+                    emitted.extend(fused)
+            out.responses = emitted
             # pending tensors that can never complete because a REQUIRED
             # rank announced shutdown fail promptly (must match
             # Controller::BuildResponseList step 3b byte-for-byte)
             if self._shutdown_ranks:
-                dead_keys = []
                 for key in sorted(self._message_table):
-                    pc = self._message_table[key]
+                    q = self._message_table.get(key)
+                    if not q:
+                        continue
+                    pc = q[0]
                     e = pc["entry"]
                     dead_rank = -1
                     for r in self._member_ranks(e.process_set_id):
@@ -445,9 +642,7 @@ class PyController:
                         tensor_shapes=[tuple(e.shape)],
                         error=f"rank {dead_rank} has shut down",
                     ))
-                    dead_keys.append(key)
-                for k in dead_keys:
-                    del self._message_table[k]
+                    self._release_front(key, pc)
             if len(self._joined_ranks) >= self.size and self.size > 0:
                 out.join_last_rank = self._last_joined_rank
                 self._joined_ranks.clear()
@@ -567,7 +762,10 @@ class PyController:
             for key in sorted(self._message_table):
                 if len(out) >= limit:
                     break
-                pc = self._message_table[key]
+                q = self._message_table[key]
+                if not q:
+                    continue
+                pc = q[0]
                 members = self._member_ranks(pc["entry"].process_set_id)
                 present = [r for r in members
                            if r in pc["ranks"] or r in self._joined_ranks]
@@ -586,7 +784,10 @@ class PyController:
         out = []
         with self._lock:
             for key in sorted(self._message_table):
-                pc = self._message_table[key]
+                q = self._message_table[key]
+                if not q:
+                    continue
+                pc = q[0]
                 waited = now - pc["first_seen"]
                 if waited < self.stall_warn_s:
                     continue

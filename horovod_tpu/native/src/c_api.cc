@@ -61,7 +61,11 @@ extern "C" {
 // v3: wire v3 cache_bits bypass frame + hvt_controller_set_resync_every
 // v4: cross-rank mismatch diagnostics (named-rank error responses +
 //     forced cache resync on disagreement)
-int hvt_abi_version() { return 4; }
+// v5: wire v5 atomic burst units (burst_id/burst_len delimiter,
+//     predicted confirmation flag, confirm_hashes) +
+//     hvt_controller_drain_requests gains a limit argument +
+//     hvt_controller_force_resync (mispredict re-anchor)
+int hvt_abi_version() { return 5; }
 
 // ---- controller ----------------------------------------------------------
 void* hvt_controller_new(int rank, int size, int64_t fusion_threshold,
@@ -111,9 +115,12 @@ void hvt_controller_set_joined(void* c) {
   Ctrl(c)->SetJoined();
 }
 
-int64_t hvt_controller_drain_requests(void* c, uint8_t* buf, int64_t cap) {
+// limit > 0 caps the drained entries at the caller's known steady
+// burst size (atomic-burst cap; 0 = drain everything).
+int64_t hvt_controller_drain_requests(void* c, uint8_t* buf, int64_t cap,
+                                      int64_t limit) {
   return Staged(&Handle(c)->staged_requests, buf, cap,
-                [c] { return Ctrl(c)->DrainRequests(); });
+                [c, limit] { return Ctrl(c)->DrainRequests(limit); });
 }
 
 void hvt_controller_ingest(void* c, const uint8_t* data, int64_t len) {
@@ -165,6 +172,8 @@ void hvt_controller_set_shutdown(void* c) { Ctrl(c)->SetShutdown(); }
 void hvt_controller_set_resync_every(void* c, int64_t n) {
   Ctrl(c)->SetResyncEvery(n);
 }
+
+void hvt_controller_force_resync(void* c) { Ctrl(c)->ForceResync(); }
 
 // Steady-state schedule prediction (two-call size-probe protocol like
 // drain/compute).  Returns 0 when a bit is unknown (caller must not

@@ -27,14 +27,16 @@ bool TensorQueue::Add(Entry e) {
   return true;
 }
 
-std::vector<Entry> TensorQueue::Drain() {
+std::vector<Entry> TensorQueue::Drain(size_t limit) {
   std::lock_guard<std::mutex> g(mu_);
-  std::vector<Entry> out(pending_.begin(), pending_.end());
+  size_t n = pending_.size();
+  if (limit > 0 && limit < n) n = limit;
+  std::vector<Entry> out(pending_.begin(), pending_.begin() + n);
   for (const Entry& e : out) {
     in_flight_.emplace(e.name, e);
     pending_names_.erase(e.name);
   }
-  pending_.clear();
+  pending_.erase(pending_.begin(), pending_.begin() + n);
   return out;
 }
 
@@ -179,7 +181,7 @@ uint64_t Controller::Enqueue(Entry e, Status* status) {
   return seq;
 }
 
-std::vector<uint8_t> Controller::DrainRequests() {
+std::vector<uint8_t> Controller::DrainRequests(int64_t limit) {
   RequestList rl;
   rl.rank = rank_;
   rl.joined = joined_;
@@ -197,7 +199,8 @@ std::vector<uint8_t> Controller::DrainRequests() {
                 return TableKey(a) < TableKey(b);
               });
   }
-  std::vector<Entry> entries = queue_.Drain();
+  std::vector<Entry> entries =
+      queue_.Drain(limit > 0 ? static_cast<size_t>(limit) : 0);
   std::vector<int64_t> bits;
   bits.reserve(entries.size());
   bool all_hit = !entries.empty();
@@ -218,6 +221,8 @@ std::vector<uint8_t> Controller::DrainRequests() {
       bypass_streak_ + 1 < resync_every_) {
     bypass_streak_++;
     rl.cache_bypass = true;
+    rl.burst_id = ++burst_seq_;
+    rl.burst_len = static_cast<uint32_t>(bits.size());
     std::vector<uint32_t> sorted_bits;
     sorted_bits.reserve(bits.size());
     for (int64_t b : bits) sorted_bits.push_back(static_cast<uint32_t>(b));
@@ -231,6 +236,13 @@ std::vector<uint8_t> Controller::DrainRequests() {
   // inspector authoritative even if caches diverge.
   bool resync = resync_flush || (all_hit && !membership);
   rl.cache_resync = resync;
+  if (!entries.empty()) {
+    // Fresh entries form one atomic burst unit; resync re-announcements
+    // (prior_in_flight) ride behind them, OUTSIDE the unit, and match
+    // idempotently at ingest.
+    rl.burst_id = ++burst_seq_;
+    rl.burst_len = static_cast<uint32_t>(entries.size());
+  }
   for (size_t i = 0; i < entries.size(); ++i) {
     Entry& e = entries[i];
     int64_t bit = bits[i];
@@ -287,24 +299,71 @@ std::string Controller::EntryDesc(const Entry& e) {
   return ss.str();
 }
 
-void Controller::TableAdd(Entry e, int32_t rank, double now) {
+Controller::PendingCoordination* Controller::TableAdd(Entry e, int32_t rank,
+                                                      double now,
+                                                      bool occurrence,
+                                                      std::string* out_key) {
   std::string key = TableKey(e);
-  auto it = message_table_.find(key);
-  if (it == message_table_.end()) {
-    // Parity: MessageTable insertion on first Request for a name.
-    PendingCoordination pc;
-    pc.entry = std::move(e);
-    pc.first_seen_s = now;
-    pc.first_rank = rank;
-    pc.ranks.insert(rank);
-    message_table_.emplace(std::move(key), std::move(pc));
-    return;
+  if (out_key) *out_key = key;
+  std::deque<PendingCoordination>& q = message_table_[key];
+  PendingCoordination* pc = nullptr;
+  if (occurrence) {
+    // Burst-unit announcement: a NEW occurrence relative to ones this
+    // rank already announced, so back-to-back confirmed bursts of the
+    // same tensor names queue instead of collapsing into one release.
+    for (PendingCoordination& cand : q) {
+      if (!cand.ranks.count(rank)) {
+        pc = &cand;
+        break;
+      }
+    }
+  } else {
+    // Legacy/idempotent matching (unit-less frames and resync
+    // re-announcements): a re-announcing rank lands on the occurrence
+    // it already joined, never opening a duplicate.
+    for (PendingCoordination& cand : q) {
+      if (cand.ranks.count(rank)) {
+        pc = &cand;
+        break;
+      }
+    }
+    if (pc == nullptr && !q.empty()) pc = &q.front();
   }
-  PendingCoordination& pc = it->second;
-  pc.ranks.insert(rank);
-  if (rank != pc.first_rank && !pc.mismatched.count(rank) &&
-      !SameParams(e, pc.entry)) {
-    pc.mismatched.emplace(rank, std::move(e));
+  if (pc == nullptr) {
+    // Parity: MessageTable insertion on first Request for a name.
+    PendingCoordination fresh;
+    fresh.entry = std::move(e);
+    fresh.first_seen_s = now;
+    fresh.first_rank = rank;
+    fresh.ranks.insert(rank);
+    fresh.seq = pc_seq_++;
+    q.push_back(std::move(fresh));
+    return &q.back();
+  }
+  pc->ranks.insert(rank);
+  if (rank != pc->first_rank && !pc->mismatched.count(rank) &&
+      !SameParams(e, pc->entry)) {
+    pc->mismatched.emplace(rank, std::move(e));
+  }
+  return pc;
+}
+
+void Controller::ReleaseFront(const std::string& key,
+                              const PendingCoordination& pc) {
+  // Drop the key from every burst unit that referenced this occurrence
+  // (so an error-released member doesn't deadlock the rest of its
+  // unit), then pop the occurrence queue.
+  for (const UnitRef& ref : pc.units) {
+    auto it = units_.find(ref);
+    if (it != units_.end()) {
+      it->second.erase(key);
+      if (it->second.empty()) units_.erase(it);
+    }
+  }
+  auto qit = message_table_.find(key);
+  if (qit != message_table_.end() && !qit->second.empty()) {
+    qit->second.pop_front();
+    if (qit->second.empty()) message_table_.erase(qit);
   }
 }
 
@@ -328,23 +387,37 @@ void Controller::Ingest(const uint8_t* data, size_t len) {
     last_joined_rank_ = rl.rank;
   }
   if (rl.shutdown) shutdown_ranks_.insert(rl.rank);
+  const bool has_unit = rl.burst_id > 0 && rl.burst_len > 0;
+  const UnitRef ref{rl.rank, rl.burst_id};
+  std::set<std::string> unit_keys;
   if (rl.cache_bypass) {
     // Expand the rank's cache-bit vector through the coordinator's own
     // (identical) cache.  An unknown bit means the caches diverged
     // (e.g. elastic generations mixing): request a full resync from
     // every rank via the next ResponseList.
-    for (uint32_t bit : UnpackBits(rl.cache_bits)) {
+    std::vector<uint32_t> bits = UnpackBits(rl.cache_bits);
+    for (size_t idx = 0; idx < bits.size(); ++idx) {
       Entry cached;
-      if (!cache_.GetEntryForBit(bit, &cached)) {
+      if (!cache_.GetEntryForBit(bits[idx], &cached)) {
         resync_needed_ = true;
         continue;
       }
       cached.seq = 0;
-      TableAdd(std::move(cached), rl.rank, now);
+      bool in_unit = has_unit && idx < rl.burst_len;
+      std::string key;
+      PendingCoordination* pc =
+          TableAdd(std::move(cached), rl.rank, now, in_unit, &key);
+      if (in_unit) {
+        pc->units.insert(ref);
+        unit_keys.insert(key);
+        if (rl.predicted) pc->predicted.insert(rl.rank);
+      }
     }
+    if (has_unit && !unit_keys.empty()) units_[ref] = std::move(unit_keys);
     return;
   }
-  for (const Request& rq : rl.requests) {
+  for (size_t idx = 0; idx < rl.requests.size(); ++idx) {
+    const Request& rq = rl.requests[idx];
     Entry e = rq.entry;
     if (rq.cached) {
       // Expand the bit back into the full entry via the coordinator's
@@ -355,8 +428,16 @@ void Controller::Ingest(const uint8_t* data, size_t len) {
         e = cached;
       }
     }
-    TableAdd(std::move(e), rl.rank, now);
+    bool in_unit = has_unit && idx < rl.burst_len;
+    std::string key;
+    PendingCoordination* pc = TableAdd(std::move(e), rl.rank, now, in_unit, &key);
+    if (in_unit) {
+      pc->units.insert(ref);
+      unit_keys.insert(key);
+      if (rl.predicted) pc->predicted.insert(rl.rank);
+    }
   }
+  if (has_unit && !unit_keys.empty()) units_[ref] = std::move(unit_keys);
 }
 
 int32_t Controller::PresentCount(const PendingCoordination& pc) const {
@@ -380,12 +461,17 @@ ResponseList Controller::BuildResponseList() {
   resync_needed_ = false;
 
   // 1. collect globally-ready keys (every member rank reported, or is
-  //    joined).  message_table_ is a std::map → deterministic
-  //    (process set, name) order, the analog of FuseResponses' stable
-  //    response ordering.
-  std::vector<std::string> ready;
+  //    joined).  Only the FRONT occurrence of each key is eligible, so
+  //    per-key release order always matches announcement order.
+  //    message_table_ is a std::map → deterministic (process set,
+  //    name) order, the analog of FuseResponses' stable ordering.
+  std::map<std::string, PendingCoordination*> fronts;
   for (auto& kv : message_table_) {
-    const PendingCoordination& pc = kv.second;
+    if (!kv.second.empty()) fronts[kv.first] = &kv.second.front();
+  }
+  std::vector<std::string> ready;
+  for (auto& kv : fronts) {
+    const PendingCoordination& pc = *kv.second;
     if (PresentCount(pc) >= RequiredRanks(pc.entry.process_set_id)) {
       ready.push_back(kv.first);
     }
@@ -395,104 +481,215 @@ ResponseList Controller::BuildResponseList() {
   //    executes when the whole group is ready).
   std::unordered_map<int64_t, int32_t> group_ready_counts;
   for (const std::string& n : ready) {
-    const Entry& e = message_table_[n].entry;
+    const Entry& e = fronts[n]->entry;
     if (e.group_id >= 0) group_ready_counts[e.group_id]++;
   }
-  std::vector<std::string> admitted;
+  std::map<std::string, PendingCoordination*> candidates;
+  std::vector<std::string> mismatch_keys;
   for (const std::string& n : ready) {
-    const Entry& e = message_table_[n].entry;
+    PendingCoordination* pc = fronts[n];
+    const Entry& e = pc->entry;
     if (e.group_id >= 0) {
       int32_t want = group_table_.GroupSize(e.group_id);
       if (want > 0 && group_ready_counts[e.group_id] < want) continue;
     }
-    admitted.push_back(n);
+    if (!pc->mismatched.empty()) {
+      mismatch_keys.push_back(n);
+    } else {
+      candidates[n] = pc;
+    }
   }
 
-  // 3. one Response per tensor, then fuse.  Responses carry the BARE
-  //    tensor name; the set scope travels in process_set_id.
-  for (const std::string& n : admitted) {
-    const PendingCoordination& pc = message_table_[n];
-    const Entry& e = pc.entry;
-    Response rs;
-    rs.type = e.type;
-    rs.red_op = e.red_op;
-    rs.dtype = e.dtype;
-    rs.process_set_id = e.process_set_id;
-    rs.root_rank = e.root_rank;
-    rs.tensor_names.push_back(e.name);
-    rs.tensor_shapes.push_back(e.shape);
-    rs.total_bytes = e.nbytes();
-    if (!pc.mismatched.empty()) {
-      // Cross-rank disagreement: fail LOUDLY on every member rank,
-      // naming each offender and what it submitted (text must match
-      // fallback.PyController byte-for-byte).  The error broadcast
-      // also forces a full cache resync, re-anchoring the bypass
-      // plane.
-      std::ostringstream ss;
-      ss << "cross-rank tensor mismatch for '" << e.name << "': rank "
-         << pc.first_rank << " submitted " << EntryDesc(e);
-      for (const auto& kv : pc.mismatched) {
-        ss << "; rank " << kv.first << " submitted "
-           << EntryDesc(kv.second);
+  // 3. atomic-unit admission: a ready op releases only when every
+  //    burst unit containing it is COMPLETELY ready, and the
+  //    transitive closure over shared unit refs partitions the
+  //    releasable work into connected components.  Fusion runs per
+  //    component (fresh open-group state each time), so the
+  //    coordinator can never form a fusion group across a burst
+  //    boundary — a peer's split burst holds its whole component back
+  //    instead of diverging the fused groupings that
+  //    PredictResponses() reconstructed locally.
+  struct Component {
+    uint64_t seq;
+    std::vector<std::string> keys;  // sorted
+  };
+  std::vector<Component> components;
+  std::set<std::string> assigned;
+  for (auto& kv : candidates) {
+    const std::string& seed = kv.first;
+    if (assigned.count(seed)) continue;
+    std::set<std::string> comp;
+    bool comp_ok = true;
+    std::vector<std::string> stack{seed};
+    while (!stack.empty() && comp_ok) {
+      std::string k = stack.back();
+      stack.pop_back();
+      if (comp.count(k)) continue;
+      auto cit = candidates.find(k);
+      if (cit == candidates.end()) {
+        comp_ok = false;
+        break;
       }
-      rs.error = ss.str();
-      out.cache_resync_needed = true;
-      out.responses.push_back(std::move(rs));
-      message_table_.erase(n);
-      continue;
-    }
-    // Zero substitution from joined ranks is only sound for additive
-    // semantics; reject ops it would silently corrupt (min/max/product
-    // zeroed, adasum NaN from zero norms, broadcast root with no data,
-    // int8 wire needing the two-phase quantized kernel on every rank).
-    bool used_joined = false;
-    for (int32_t r : ProcessSetRanks(e.process_set_id)) {
-      if (!pc.ranks.count(r) && joined_ranks_.count(r)) used_joined = true;
-    }
-    if (used_joined) {
-      if (e.type == OpType::kBroadcast && e.root_rank >= 0 &&
-          !pc.ranks.count(e.root_rank) && joined_ranks_.count(e.root_rank)) {
-        rs.error = "broadcast root rank " + std::to_string(e.root_rank) +
-                   " has joined";
-      } else if ((e.type == OpType::kAllreduce ||
-                  e.type == OpType::kReducescatter) &&
-                 (e.red_op == RedOp::kMin || e.red_op == RedOp::kMax ||
-                  e.red_op == RedOp::kProduct ||
-                  e.red_op == RedOp::kAdasum)) {
-        rs.error = "reduction op " +
-                   std::to_string(static_cast<int>(e.red_op)) +
-                   " does not support joined-rank zero contribution";
-      } else if ((e.type == OpType::kAllreduce ||
-                  e.type == OpType::kReducescatter) &&
-                 e.dtype == DataType::kInt8) {
-        rs.error =
-            "int8 wire format does not support joined-rank zero "
-            "contribution";
+      comp.insert(k);
+      for (const UnitRef& ref : cit->second->units) {
+        auto uit = units_.find(ref);
+        if (uit == units_.end()) continue;
+        for (const std::string& k2 : uit->second) {
+          auto c2 = candidates.find(k2);
+          if (c2 == candidates.end() || !c2->second->units.count(ref)) {
+            comp_ok = false;
+            break;
+          }
+          if (!comp.count(k2)) stack.push_back(k2);
+        }
+        if (!comp_ok) break;
       }
     }
-    out.responses.push_back(std::move(rs));
-    message_table_.erase(n);
+    if (!comp_ok) continue;  // a unit is split-pending: hold the component
+    uint64_t min_seq = UINT64_MAX;
+    for (const std::string& k : comp) {
+      min_seq = std::min(min_seq, candidates[k]->seq);
+      assigned.insert(k);
+    }
+    components.push_back(
+        Component{min_seq, std::vector<std::string>(comp.begin(), comp.end())});
   }
-  FuseResponses(&out.responses);
+  // Mismatch errors bypass unit gating (fail fast; the forced resync
+  // re-anchors the survivors) as singleton components.
+  for (const std::string& key : mismatch_keys) {
+    components.push_back(Component{fronts[key]->seq, {key}});
+  }
+  // Creation order == per-rank announcement order on every stream, so
+  // component emission order matches every predictor's confirmation
+  // FIFO.
+  std::sort(components.begin(), components.end(),
+            [](const Component& a, const Component& b) {
+              return a.seq < b.seq;
+            });
 
-  // 3b. pending tensors that can never complete because a REQUIRED
+  // 4. one Response per tensor, fused PER COMPONENT.  Responses carry
+  //    the BARE tensor name; the set scope travels in process_set_id.
+  //    A component whose every member rank announced as a PREDICTED
+  //    confirmation is suppressed down to a confirm hash.
+  for (const Component& component : components) {
+    std::vector<Response> comp_responses;
+    bool suppress = true;
+    for (const std::string& n : component.keys) {
+      // Take the front occurrence off its queue; ReleaseFront below
+      // needs the units copy after the pop.
+      PendingCoordination pc = std::move(message_table_[n].front());
+      const Entry& e = pc.entry;
+      Response rs;
+      rs.type = e.type;
+      rs.red_op = e.red_op;
+      rs.dtype = e.dtype;
+      rs.process_set_id = e.process_set_id;
+      rs.root_rank = e.root_rank;
+      rs.tensor_names.push_back(e.name);
+      rs.tensor_shapes.push_back(e.shape);
+      rs.total_bytes = e.nbytes();
+      if (!pc.mismatched.empty()) {
+        // Cross-rank disagreement: fail LOUDLY on every member rank,
+        // naming each offender and what it submitted (text must match
+        // fallback.PyController byte-for-byte).  The error broadcast
+        // also forces a full cache resync, re-anchoring the bypass
+        // AND predict planes.
+        std::ostringstream ss;
+        ss << "cross-rank tensor mismatch for '" << e.name << "': rank "
+           << pc.first_rank << " submitted " << EntryDesc(e);
+        for (const auto& kv : pc.mismatched) {
+          ss << "; rank " << kv.first << " submitted "
+             << EntryDesc(kv.second);
+        }
+        rs.error = ss.str();
+        out.cache_resync_needed = true;
+        suppress = false;
+        comp_responses.push_back(std::move(rs));
+        ReleaseFront(n, pc);
+        continue;
+      }
+      // Zero substitution from joined ranks is only sound for additive
+      // semantics; reject ops it would silently corrupt (min/max/
+      // product zeroed, adasum NaN from zero norms, broadcast root
+      // with no data, int8 wire needing the two-phase quantized kernel
+      // on every rank).
+      bool used_joined = false;
+      for (int32_t r : ProcessSetRanks(e.process_set_id)) {
+        if (!pc.ranks.count(r) && joined_ranks_.count(r)) used_joined = true;
+      }
+      if (used_joined) {
+        if (e.type == OpType::kBroadcast && e.root_rank >= 0 &&
+            !pc.ranks.count(e.root_rank) && joined_ranks_.count(e.root_rank)) {
+          rs.error = "broadcast root rank " + std::to_string(e.root_rank) +
+                     " has joined";
+        } else if ((e.type == OpType::kAllreduce ||
+                    e.type == OpType::kReducescatter) &&
+                   (e.red_op == RedOp::kMin || e.red_op == RedOp::kMax ||
+                    e.red_op == RedOp::kProduct ||
+                    e.red_op == RedOp::kAdasum)) {
+          rs.error = "reduction op " +
+                     std::to_string(static_cast<int>(e.red_op)) +
+                     " does not support joined-rank zero contribution";
+        } else if ((e.type == OpType::kAllreduce ||
+                    e.type == OpType::kReducescatter) &&
+                   e.dtype == DataType::kInt8) {
+          rs.error =
+              "int8 wire format does not support joined-rank zero "
+              "contribution";
+        }
+      }
+      std::vector<int32_t> mv = ProcessSetRanks(e.process_set_id);
+      std::set<int32_t> members(mv.begin(), mv.end());
+      if (!rs.error.empty() || used_joined || pc.predicted != members) {
+        suppress = false;
+      }
+      comp_responses.push_back(std::move(rs));
+      ReleaseFront(n, pc);
+    }
+    FuseResponses(&comp_responses);
+    bool any_error = false;
+    for (const Response& r : comp_responses) {
+      if (!r.error.empty()) any_error = true;
+    }
+    if (suppress && !comp_responses.empty() && !any_error) {
+      // Every member rank announced this whole component as a
+      // PREDICTED confirmation: each already executed the identical
+      // locally predicted schedule, so emit only the hash of the
+      // would-be response bytes — the response-side half of killing
+      // the round trip.
+      ResponseList bare;
+      bare.responses = std::move(comp_responses);
+      std::vector<uint8_t> blob = SerializeResponseList(bare);
+      out.confirm_hashes.push_back(Fnv1a64(blob.data(), blob.size()));
+    } else {
+      for (Response& r : comp_responses) {
+        out.responses.push_back(std::move(r));
+      }
+    }
+  }
+
+  // 4b. pending tensors that can never complete because a REQUIRED
   //     rank announced shutdown fail promptly with an error response
   //     (parity: the reference's "Horovod has been shut down" error)
   //     instead of stalling the remaining ranks to the transport
   //     timeout.
   if (!shutdown_ranks_.empty()) {
-    std::vector<std::string> dead_keys;
-    for (auto& kv : message_table_) {
-      const PendingCoordination& pc = kv.second;
+    std::vector<std::string> keys;
+    for (const auto& kv : message_table_) keys.push_back(kv.first);
+    for (const std::string& key : keys) {
+      auto qit = message_table_.find(key);
+      if (qit == message_table_.end() || qit->second.empty()) continue;
+      const PendingCoordination& front = qit->second.front();
       int32_t dead_rank = -1;
-      for (int32_t r : ProcessSetRanks(pc.entry.process_set_id)) {
-        if (!pc.ranks.count(r) && !joined_ranks_.count(r) &&
+      for (int32_t r : ProcessSetRanks(front.entry.process_set_id)) {
+        if (!front.ranks.count(r) && !joined_ranks_.count(r) &&
             shutdown_ranks_.count(r)) {
           dead_rank = r;
           break;
         }
       }
       if (dead_rank < 0) continue;
+      PendingCoordination pc = std::move(qit->second.front());
       const Entry& e = pc.entry;
       Response rs;
       rs.type = e.type;
@@ -504,9 +701,8 @@ ResponseList Controller::BuildResponseList() {
       rs.tensor_shapes.push_back(e.shape);
       rs.error = "rank " + std::to_string(dead_rank) + " has shut down";
       out.responses.push_back(std::move(rs));
-      dead_keys.push_back(kv.first);
+      ReleaseFront(key, pc);
     }
-    for (const std::string& k : dead_keys) message_table_.erase(k);
   }
 
   // 4. join: once every rank joined, emit the last joiner (parity:
@@ -655,7 +851,8 @@ std::vector<StallEntry> Controller::CheckStalls() const {
   std::vector<StallEntry> out;
   double now = NowSeconds();
   for (const auto& kv : message_table_) {
-    const PendingCoordination& pc = kv.second;
+    if (kv.second.empty()) continue;
+    const PendingCoordination& pc = kv.second.front();
     double waited = now - pc.first_seen_s;
     if (waited < stall_warn_s_) continue;
     StallEntry se;

@@ -45,7 +45,10 @@ class TensorQueue {
   bool Add(Entry e);
   // Pop up to the full pending list for this cycle (parity:
   // PopMessagesFromQueue); entries move to in-flight keyed by name.
-  std::vector<Entry> Drain();
+  // limit > 0 caps the drain at that many entries (atomic-burst cap:
+  // one wire unit == one application burst even when the next burst
+  // already started queueing).
+  std::vector<Entry> Drain(size_t limit = 0);
   // Remove finished entries by name; returns their seq ids (parity:
   // GetTensorEntriesFromResponse + PopMessagesFromQueue bookkeeping).
   std::vector<uint64_t> Finish(const std::vector<std::string>& names);
@@ -159,8 +162,17 @@ class Controller {
   // full-resync request blob instead of the compact bit vector (0
   // disables bypass entirely).  Cycle-thread + init-time only.
   void SetResyncEvery(int64_t n) { resync_every_ = n; }
-  // Serialize this cycle's RequestList (drains the queue into in-flight).
-  std::vector<uint8_t> DrainRequests();
+  // Rank-side re-anchor (mispredict recovery / quiesce rollback): the
+  // next DrainRequests emits a full-entry resync frame — re-announcing
+  // in-flight ops — exactly as if the coordinator had requested
+  // cache_resync_needed.
+  void ForceResync() {
+    resync_flush_ = true;
+    bypass_streak_ = 0;
+  }
+  // Serialize this cycle's RequestList (drains the queue into
+  // in-flight); limit > 0 caps the drained entries (atomic-burst cap).
+  std::vector<uint8_t> DrainRequests(int64_t limit = 0);
   // Apply an agreed ResponseList: update cache + queue; out_finished gets
   // the seq ids completed by this response list, in response order.
   ResponseList ApplyResponses(const uint8_t* data, size_t len,
@@ -191,6 +203,10 @@ class Controller {
   int64_t fusion_threshold() const { return fusion_threshold_; }
 
  private:
+  // (rank, burst_id) reference into units_: the atomic burst unit this
+  // coordination belongs to on that rank's stream.
+  using UnitRef = std::pair<int32_t, uint32_t>;
+
   struct PendingCoordination {
     Entry entry;                 // from the first rank that reported it
     std::set<int32_t> ranks;     // ranks that reported ready
@@ -200,6 +216,13 @@ class Controller {
     // surface (SameParams), with what they submitted — turned into a
     // named-rank error response instead of a silent mis-fuse/stall.
     std::map<int32_t, Entry> mismatched;
+    // burst units referencing this occurrence; release is gated on
+    // every one being completely ready (see BuildResponseList).
+    std::set<UnitRef> units;
+    // ranks whose announcement carried the PREDICTED confirmation flag
+    std::set<int32_t> predicted;
+    // creation index — deterministic component emission order
+    uint64_t seq = 0;
   };
 
   static std::string TableKey(const Entry& e);
@@ -211,7 +234,15 @@ class Controller {
   // fallback._entry_desc.
   static std::string EntryDesc(const Entry& e);
   // Record one rank's announcement, tracking per-rank conflicts.
-  void TableAdd(Entry e, int32_t rank, double now);
+  // occurrence=true (burst-unit announcements) opens a NEW occurrence
+  // relative to ones this rank already announced; occurrence=false
+  // matches idempotently (legacy / resync re-announcements).  Must
+  // match fallback._table_add.
+  PendingCoordination* TableAdd(Entry e, int32_t rank, double now,
+                                bool occurrence, std::string* out_key);
+  // Pop a released coordination off its occurrence queue and drop its
+  // key from every burst unit that referenced it.
+  void ReleaseFront(const std::string& key, const PendingCoordination& pc);
   int32_t RequiredRanks(int32_t psid) const;
   std::vector<int32_t> ProcessSetRanks(int32_t psid) const;
   int32_t PresentCount(const PendingCoordination& pc) const;
@@ -235,12 +266,21 @@ class Controller {
   int64_t resync_every_ = 64;
   int64_t bypass_streak_ = 0;
   bool resync_flush_ = false;
+  // per-rank monotonic burst-unit counter (drain side)
+  uint32_t burst_seq_ = 0;
 
-  // coordinator state
+  // coordinator state.  Each key holds an OCCURRENCE QUEUE of pending
+  // coordinations (front = oldest): with prediction on, a rank's
+  // fire-and-forget confirmations can announce the same tensor names
+  // for several bursts before the coordinator catches up.
   bool resync_needed_ = false;
   int64_t tuned_threshold_ = -1;
   int32_t tuned_cycle_us_ = -1;
-  std::map<std::string, PendingCoordination> message_table_;  // by name (ordered for determinism)
+  std::map<std::string, std::deque<PendingCoordination>>
+      message_table_;  // by (psid, name), ordered for determinism
+  // (rank, burst_id) -> table keys forming that rank's atomic unit
+  std::map<UnitRef, std::set<std::string>> units_;
+  uint64_t pc_seq_ = 0;
   std::set<int32_t> joined_ranks_;
   int32_t last_joined_rank_ = -1;
   std::set<int32_t> shutdown_ranks_;

@@ -55,6 +55,15 @@ std::vector<uint32_t> UnpackBits(const std::vector<uint64_t>& words) {
   return bits;
 }
 
+uint64_t Fnv1a64(const uint8_t* data, size_t n) {
+  uint64_t h = 0xCBF29CE484222325ull;
+  for (size_t i = 0; i < n; ++i) {
+    h ^= data[i];
+    h *= 0x100000001B3ull;
+  }
+  return h;
+}
+
 std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
   Writer w;
   w.u32(kRequestMagic);
@@ -62,7 +71,10 @@ std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
   w.i32(rl.rank);
   w.u8(rl.joined ? 1 : 0);
   w.u8(rl.shutdown ? 1 : 0);
-  w.u8((rl.cache_bypass ? 1 : 0) | (rl.cache_resync ? 2 : 0));
+  w.u8((rl.cache_bypass ? 1 : 0) | (rl.cache_resync ? 2 : 0) |
+       (rl.predicted ? 4 : 0));
+  w.u32(rl.burst_id);
+  w.u32(rl.burst_len);
   w.u32(static_cast<uint32_t>(rl.cache_bits.size()));
   for (uint64_t word : rl.cache_bits) w.u64(word);
   w.u32(static_cast<uint32_t>(rl.cache_hits.size()));
@@ -88,6 +100,9 @@ RequestList ParseRequestList(const uint8_t* data, size_t len) {
   uint8_t flags = r.u8();
   rl.cache_bypass = (flags & 1) != 0;
   rl.cache_resync = (flags & 2) != 0;
+  rl.predicted = (flags & 4) != 0;
+  rl.burst_id = r.u32();
+  rl.burst_len = r.u32();
   uint32_t nwords = r.u32();
   rl.cache_bits.resize(nwords);
   for (uint32_t i = 0; i < nwords; ++i) rl.cache_bits[i] = r.u64();
@@ -114,6 +129,8 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
   w.u8(rl.cache_resync_needed ? 1 : 0);
   w.i64(rl.tuned_fusion_threshold);
   w.i32(rl.tuned_cycle_time_us);
+  w.u32(static_cast<uint32_t>(rl.confirm_hashes.size()));
+  for (uint64_t h : rl.confirm_hashes) w.u64(h);
   w.u32(static_cast<uint32_t>(rl.responses.size()));
   for (const Response& rs : rl.responses) {
     w.u8(static_cast<uint8_t>(rs.type));
@@ -143,6 +160,9 @@ ResponseList ParseResponseList(const uint8_t* data, size_t len) {
   rl.cache_resync_needed = r.u8() != 0;
   rl.tuned_fusion_threshold = r.i64();
   rl.tuned_cycle_time_us = r.i32();
+  uint32_t nconfirm = r.u32();
+  rl.confirm_hashes.resize(nconfirm);
+  for (uint32_t i = 0; i < nconfirm; ++i) rl.confirm_hashes[i] = r.u64();
   uint32_t n = r.u32();
   rl.responses.resize(n);
   for (uint32_t i = 0; i < n; ++i) {

@@ -23,7 +23,12 @@ constexpr uint32_t kResponseMagic = 0x50545648;  // "HVTP"
 // cycles send a per-rank cache-bit vector instead of serialized
 // requests) + bypass/resync flags; ResponseList carries
 // `cache_resync_needed` to force full-request cycles on divergence.
-constexpr uint32_t kWireVersion = 3;
+// v5 (v4 was an ABI-only bump): RequestList carries the atomic
+// burst-unit delimiter (burst_id/burst_len right after the flags byte)
+// and a `predicted` confirmation flag (bit 4); ResponseList carries
+// `confirm_hashes` (FNV-1a 64 of each suppressed fully-predicted
+// component's would-be response bytes).
+constexpr uint32_t kWireVersion = 5;
 
 // A request as sent rank -> coordinator. Parity: message.h Request.
 struct Request {
@@ -48,8 +53,23 @@ struct RequestList {
   // Periodic full resync: requests carry FULL entries so the
   // coordinator's message table / stall inspector re-anchor on truth.
   bool cache_resync = false;
+  // Post-hoc confirmation of a locally predicted schedule: the rank
+  // already executed PredictResponses(cache_bits) and only expects a
+  // confirm hash back, not a ResponseList.
+  bool predicted = false;
+  // Atomic burst unit: this drain's first burst_len requests (or, on a
+  // bypass blob, its first burst_len cache bits in ascending order)
+  // form one indivisible unit — released and fused together, never
+  // across the boundary.  0 = no unit (empty drains, membership
+  // frames, resync re-announcements).
+  uint32_t burst_id = 0;
+  uint32_t burst_len = 0;
   std::vector<uint64_t> cache_bits;
 };
+
+// Confirm-hash function for suppressed predicted components.  Must
+// match wire.py's fnv1a64 byte-for-byte.
+uint64_t Fnv1a64(const uint8_t* data, size_t n);
 
 // Pack ascending bit ids into a u64-word bitvector / back.  The byte
 // layout (and therefore the bit order produced by UnpackBits) must
@@ -89,6 +109,11 @@ struct ResponseList {
   // coordinator-tuned parameters (-1 = unset)
   int64_t tuned_fusion_threshold = -1;
   int32_t tuned_cycle_time_us = -1;
+  // One FNV-1a 64 hash per suppressed fully-predicted burst component
+  // (in release order): every announcing rank predicted the identical
+  // schedule, so the coordinator emits the hash of the would-be
+  // response bytes instead of the responses themselves.
+  std::vector<uint64_t> confirm_hashes;
 };
 
 // ---------------------------------------------------------------------------

@@ -24,7 +24,15 @@ RESPONSE_MAGIC = 0x50545648  # "HVTP"
 # Controller::CoordinateCacheAndState) plus bypass/resync flags, and
 # ResponseList carries `cache_resync_needed` so the coordinator can
 # force every rank back to a full-request cycle.
-WIRE_VERSION = 3
+# v5 (v4 was an ABI-only bump): RequestList carries the atomic
+# burst-unit delimiter (`burst_id`/`burst_len` right after the flags
+# byte, covering the leading requests or cache bits of this drain) and
+# a `predicted` flag (bit 4) marking the blob as a post-hoc
+# confirmation of a locally predicted schedule; ResponseList carries
+# `confirm_hashes` (FNV-1a 64 of each suppressed fully-predicted
+# component's would-be response bytes) so predictors verify without a
+# response round trip.
+WIRE_VERSION = 5
 
 # OpType (native/src/common.h)
 ALLREDUCE, ALLGATHER, BROADCAST, ALLTOALL, REDUCESCATTER, ADASUM, BARRIER, JOIN = range(8)
@@ -93,6 +101,46 @@ class RequestList:
     # table and stall inspector re-anchor on ground truth.
     cache_resync: bool = False
     cache_bits: List[int] = dataclasses.field(default_factory=list)
+    # Post-hoc confirmation of a locally predicted schedule: the rank
+    # already executed predict_responses(cache_bits) and is not waiting
+    # for a ResponseList (it only expects a confirm hash).
+    predicted: bool = False
+    # Atomic burst unit: this drain's first `burst_len` requests (or,
+    # on a bypass blob, its first `burst_len` cache bits in ascending
+    # order) form one indivisible unit — the coordinator releases and
+    # fuses them together, never across the unit boundary.  0 = no
+    # unit (empty drains, membership frames, resync re-announcements).
+    burst_id: int = 0
+    burst_len: int = 0
+
+
+# Confirm-hash function for suppressed predicted components.  Must
+# match Fnv1a64() in native/src/message.cc byte-for-byte.
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+
+
+def fnv1a64(data: bytes) -> int:
+    h = _FNV_OFFSET
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+# Byte offset of the RequestList flags byte: magic u32 + version u32 +
+# rank i32 + joined u8 + shutdown u8.
+_FLAGS_OFFSET = 4 + 4 + 4 + 1 + 1
+
+
+def mark_predicted(blob: bytes) -> bytes:
+    """Flip the `predicted` flag on an already-serialized RequestList.
+
+    Turns a drained bypass blob into the compact post-hoc confirmation
+    the drainer posts after executing a locally predicted schedule
+    (byte-identical to serializing with predicted=True)."""
+    return (blob[:_FLAGS_OFFSET]
+            + bytes([blob[_FLAGS_OFFSET] | 4])
+            + blob[_FLAGS_OFFSET + 1:])
 
 
 def bits_to_words(bits: List[int]) -> List[int]:
@@ -143,6 +191,11 @@ class ResponseList:
     # coordinator-tuned parameters (-1 = unset)
     tuned_fusion_threshold: int = -1
     tuned_cycle_time_us: int = -1
+    # One FNV-1a 64 hash per suppressed fully-predicted burst
+    # component (in release order): every announcing rank predicted the
+    # identical schedule, so the coordinator emits the hash of the
+    # would-be response bytes instead of the responses themselves.
+    confirm_hashes: List[int] = dataclasses.field(default_factory=list)
 
 
 class _W:
@@ -223,7 +276,10 @@ def serialize_request_list(rl: RequestList) -> bytes:
     w.i32(rl.rank)
     w.u8(1 if rl.joined else 0)
     w.u8(1 if rl.shutdown else 0)
-    w.u8((1 if rl.cache_bypass else 0) | (2 if rl.cache_resync else 0))
+    w.u8((1 if rl.cache_bypass else 0) | (2 if rl.cache_resync else 0)
+         | (4 if rl.predicted else 0))
+    w.u32(rl.burst_id)
+    w.u32(rl.burst_len)
     w.u32(len(rl.cache_bits))
     for word in rl.cache_bits:
         w.u64(word)
@@ -252,6 +308,9 @@ def parse_request_list(data: bytes) -> RequestList:
     flags = r.u8()
     rl.cache_bypass = bool(flags & 1)
     rl.cache_resync = bool(flags & 2)
+    rl.predicted = bool(flags & 4)
+    rl.burst_id = r.u32()
+    rl.burst_len = r.u32()
     rl.cache_bits = [r.u64() for _ in range(r.u32())]
     rl.cache_hits = [r.u32() for _ in range(r.u32())]
     n = r.u32()
@@ -274,6 +333,9 @@ def serialize_response_list(rl: ResponseList) -> bytes:
     w.u8(1 if rl.cache_resync_needed else 0)
     w.i64(rl.tuned_fusion_threshold)
     w.i32(rl.tuned_cycle_time_us)
+    w.u32(len(rl.confirm_hashes))
+    for h in rl.confirm_hashes:
+        w.u64(h)
     w.u32(len(rl.responses))
     for rs in rl.responses:
         w.u8(rs.type)
@@ -305,6 +367,7 @@ def parse_response_list(data: bytes) -> ResponseList:
     rl.cache_resync_needed = r.u8() != 0
     rl.tuned_fusion_threshold = r.i64()
     rl.tuned_cycle_time_us = r.i32()
+    rl.confirm_hashes = [r.u64() for _ in range(r.u32())]
     n = r.u32()
     for _ in range(n):
         rs = Response()

@@ -53,6 +53,12 @@ QUEUE = "QUEUE"
 FUSE = "FUSE"
 EXEC = "EXEC"
 DONE = "DONE"
+# Predicted fast path (eager/controller._try_predict): the agreed
+# schedule was reconstructed locally and execution started without
+# waiting for the coordinator round trip; the span jumps
+# NEGOTIATE -> PREDICT -> QUEUE and the post-hoc confirmation rides
+# the request stream.
+PREDICT = "PREDICT"
 # Input-pipeline wait (data/loader.py): time the training loop blocked
 # on the prefetch queue.  hvtputrace report buckets it separately from
 # the collective wait phases so stragglers attribute to input vs

@@ -104,7 +104,22 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             )
         self._passes[p] += 1
         if self._passes[p] == self.backward_passes_per_step:
+            # Declare the burst to the controller's coalescing gate:
+            # this step will stream one allreduce per registered param,
+            # but the gaps between hooks are paced by backward compute,
+            # so the gate's quiet-gap heuristic alone mis-splits the
+            # burst under load (novel fusion shapes -> recompiles, and
+            # the schedule predictor never sees a stable pattern).
+            self._hint_burst()
             self._handles[p] = self._allreduce_grad_async(p)
+
+    def _hint_burst(self):
+        from horovod_tpu.eager import get_controller
+
+        try:
+            get_controller().hint_burst(len(self._requires_update))
+        except Exception:
+            pass  # gate hint only; never fail a backward over it
 
     def _allreduce_grad_async(self, p):
         name = self._parameter_names[p]

@@ -14,6 +14,11 @@ std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
   w.u32(kRequestMagic);
   w.u32(kWireVersion);
   w.i32(rl.rank);
+  w.u32(rl.burst_id);
+  w.u32(rl.burst_len);
+  w.u8(rl.joined ? 1 : 0);
+  w.u8(rl.shutdown ? 1 : 0);
+  w.u8(rl.cache_bypass ? 1 : 0);
   for (const Request& rq : rl.requests) {
     WriteEntry(w, rq.entry);
   }

@@ -27,6 +27,14 @@ def serialize_request_list(rl):
     w.u32(REQUEST_MAGIC)
     w.u32(WIRE_VERSION)
     w.i32(rl.rank)
+    # delimiter drift seed: both twins agree (so the generic order
+    # check stays quiet) but the burst u32 pair sits BEFORE the flag
+    # bytes — only the absolute-position check may catch this.
+    w.u32(rl.burst_id)
+    w.u32(rl.burst_len)
+    w.u8(1 if rl.joined else 0)
+    w.u8(1 if rl.shutdown else 0)
+    w.u8(1 if rl.cache_bypass else 0)
     for rq in rl.requests:
         _write_entry(w, rq.entry)
     return w.bytes()

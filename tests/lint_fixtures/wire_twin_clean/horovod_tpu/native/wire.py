@@ -27,6 +27,11 @@ def serialize_request_list(rl):
     w.u32(REQUEST_MAGIC)
     w.u32(WIRE_VERSION)
     w.i32(rl.rank)
+    w.u8(1 if rl.joined else 0)
+    w.u8(1 if rl.shutdown else 0)
+    w.u8(1 if rl.cache_bypass else 0)
+    w.u32(rl.burst_id)
+    w.u32(rl.burst_len)
     for rq in rl.requests:
         _write_entry(w, rq.entry)
     return w.bytes()

@@ -1,0 +1,61 @@
+"""Elastic training script driving the ASYNC eager controller with
+schedule prediction on (the default): used by the preempt-vs-prediction
+chaos test.  Each epoch runs a steady same-shape burst of async
+allreduces through the controller — so by the time the injected
+preemption notice lands on rank 1, predicted cycles are in flight —
+then commits.  The drain commit quiesces the controller: in-flight
+predictions must confirm (or roll back to full negotiation) before the
+emergency commit persists anything they produced.
+"""
+
+import os
+import sys
+import time
+
+import horovod_tpu as hvt
+import horovod_tpu.elastic as elastic
+
+
+def main():
+    hvt.init()
+    epochs = int(os.environ.get("ELASTIC_EPOCHS", "6"))
+    sleep_s = float(os.environ.get("EPOCH_SLEEP", "0.3"))
+    state = elastic.ObjectState(epoch=0, total=0.0)
+
+    @elastic.run
+    def train(state):
+        import jax.numpy as jnp
+        import numpy as np
+
+        while state.epoch < epochs:
+            hs = [
+                hvt.allreduce_async(jnp.full((8,), 1.0), name=f"pd/{i}",
+                                    op=hvt.Sum)
+                for i in range(3)
+            ]
+            for h in hs:
+                out = hvt.synchronize(h)
+                got = float(np.asarray(out)[0])
+                want = float(hvt.size())
+                assert got == want, (got, want, state.epoch)
+            state.epoch += 1
+            time.sleep(sleep_s)
+            state.commit()
+        if hvt.rank() == 0:
+            from horovod_tpu.obs import metrics as obs_metrics
+
+            pred = obs_metrics.counter(
+                "hvtpu_controller_predicted_cycles_total").value()
+            misp = obs_metrics.counter(
+                "hvtpu_controller_mispredicts_total").value()
+            print(
+                f"DONE size={hvt.size()} epoch={state.epoch} "
+                f"predicted={pred:.0f} mispredicts={misp:.0f}",
+                flush=True,
+            )
+
+    train(state)
+
+
+if __name__ == "__main__":
+    main()

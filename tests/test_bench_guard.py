@@ -150,3 +150,54 @@ class TestTorchStepSchema:
         for key in bench_eager.TORCH_STEP_KEYS:
             assert key in row, key
         assert row["ms_per_step"] > 0
+
+
+class TestPredictSchema:
+    """Round 7: every controller-driven async row carries the
+    schedule-prediction columns (predicted_fraction, mispredicts,
+    mispredict_rate), and the recorded steady-state rows prove the
+    default-on fast path actually engaged — predicted_fraction above
+    0.8 with zero unrecovered mispredicts."""
+
+    @pytest.fixture
+    def bench_eager(self):
+        import importlib
+
+        import bench_eager as mod
+
+        return importlib.reload(mod)
+
+    def test_stats_builder_schema(self, bench_eager):
+        before = {"cycles": 10, "predicted": 2, "mispredicts": 0}
+        after = {"cycles": 74, "predicted": 58, "mispredicts": 1}
+        stats = bench_eager.build_predict_stats(before, after)
+        assert set(stats) == set(bench_eager.PREDICT_ROW_KEYS)
+        assert stats["predicted_fraction"] == pytest.approx(56 / 64)
+        assert stats["mispredicts"] == 1
+        assert stats["mispredict_rate"] == pytest.approx(
+            1 / 64, abs=1e-4)
+        json.dumps(stats)
+
+    def test_zero_cycle_window_is_null_not_crash(self, bench_eager):
+        snap = {"cycles": 5, "predicted": 1, "mispredicts": 0}
+        stats = bench_eager.build_predict_stats(snap, dict(snap))
+        assert stats["predicted_fraction"] is None
+        assert stats["mispredict_rate"] is None
+        assert stats["mispredicts"] == 0
+
+    def test_recorded_steady_rows_predicted_without_mispredicts(
+            self, bench_eager):
+        with open(os.path.join(_ROOT, "BENCH_EAGER.json")) as f:
+            data = json.load(f)
+        async_np4 = [r for r in data["results"]
+                     if r.get("np") == 4
+                     and r["mode"].startswith("async")]
+        assert async_np4
+        for row in async_np4:
+            for key in bench_eager.PREDICT_ROW_KEYS:
+                assert key in row, (row["mode"], row["nbytes"], key)
+            assert row["predicted_fraction"] > 0.8, row
+            assert row["mispredicts"] == 0, row
+        # the torch e2e step row rides the same schema
+        for key in bench_eager.PREDICT_ROW_KEYS:
+            assert key in data["torch_step"], key

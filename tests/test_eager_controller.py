@@ -430,3 +430,190 @@ class TestMultiRankNegotiation:
         stop_world(ctrls)
         with pytest.raises(HorovodInternalError):
             f0.result(timeout=5)
+
+
+# --------------------------------------------------------------------------
+# default-on schedule prediction (atomic burst units make it sound)
+# --------------------------------------------------------------------------
+
+class TestPredictedSchedules:
+    def _run_steady(self, ctrls, steps, start=0, names=2, width=2):
+        for step in range(start, start + steps):
+            futs = [c.enqueue("allreduce", jnp.full((4,), float(step)),
+                              name=f"ps/{i}")
+                    for c in ctrls for i in range(names)]
+            for f in futs:
+                np.testing.assert_allclose(
+                    np.asarray(f.result(timeout=20)), float(step))
+
+    def test_predicted_default_on_confirms_and_drains(self, hvt):
+        """HVTPU_EAGER_PREDICT defaults to auto: a steady same-shape
+        loop predicts schedules, the post-hoc confirm hashes drain the
+        outstanding-prediction FIFO, and nothing mispredicts."""
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        pred = obs_metrics.counter(
+            "hvtpu_controller_predicted_cycles_total")
+        misp = obs_metrics.counter("hvtpu_controller_mispredicts_total")
+        base_p, base_m = pred.value(), misp.value()
+        ctrls = make_world(2)
+        try:
+            self._run_steady(ctrls, steps=30)
+            assert pred.value() > base_p
+            assert misp.value() == base_m
+            # quiesce waits for outstanding confirmations, then idles
+            for c in ctrls:
+                assert c.quiesce(timeout=10) is True
+                assert not c._predicted
+        finally:
+            stop_world(ctrls)
+
+    def test_gate_and_predict_state_reset_across_cache_resync(self, hvt):
+        """Satellite: a coordinator-forced resync must reset the burst
+        gate's _expected_burst ITSELF (and the predict eligibility
+        latch), not just the stability counter — a stale steady size
+        from before a resize would gate the wrong burst shape."""
+        ctrl = EagerController(0, 1, manual=True)
+        try:
+            with ctrl._lock:
+                ctrl._expected_burst = 4
+                ctrl._burst_stable = 5
+                ctrl._verified_bits.add((1, 2, 3))
+                ctrl._observe.append(((1, 2), [], []))
+                ctrl._predicted.append(
+                    {"hash": 0x1234, "responses": [], "names": ["rx"]})
+            ctrl._dispatch_execution(
+                wire.ResponseList(cache_resync_needed=True), [])
+            assert ctrl._expected_burst == 0
+            assert ctrl._burst_stable == 0
+            assert not ctrl._verified_bits
+            assert not ctrl._observe
+            assert not ctrl._predicted
+            # abandoned predicted names are tolerated, not fatal, if
+            # their real responses arrive later
+            assert "rx" in ctrl._mispredict_names
+        finally:
+            ctrl.stop()
+
+    def test_gate_and_predict_state_reset_on_membership_change(self, hvt):
+        """Same latch reset on an elastic membership change
+        (join_last_rank >= 0) and on a mismatch error response."""
+        for rl in (
+            wire.ResponseList(join_last_rank=1),
+            wire.ResponseList(responses=[wire.Response(
+                tensor_names=["e"], tensor_shapes=[(2,)],
+                error="cross-rank mismatch")]),
+        ):
+            ctrl = EagerController(0, 1, manual=True)
+            try:
+                with ctrl._lock:
+                    ctrl._expected_burst = 3
+                    ctrl._burst_stable = 7
+                ctrl._dispatch_execution(rl, [])
+                assert ctrl._expected_burst == 0
+                assert ctrl._burst_stable == 0
+            finally:
+                ctrl.stop()
+
+    def test_mispredict_forces_resync_and_converges(self, hvt):
+        """Satellite: the mispredict recovery path — counter bump,
+        forced full negotiation + cache-resync re-anchor — converges:
+        the world keeps producing correct results afterwards."""
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        pred = obs_metrics.counter(
+            "hvtpu_controller_predicted_cycles_total")
+        misp = obs_metrics.counter("hvtpu_controller_mispredicts_total")
+        ctrls = make_world(2)
+        try:
+            base_p, base_m = pred.value(), misp.value()
+            self._run_steady(ctrls, steps=30)
+            assert pred.value() > base_p  # steady state reached
+            with ctrls[0]._lock:
+                ctrls[0]._on_mispredict("test-injected disagreement")
+            assert misp.value() == base_m + 1
+            # forced resync converges: further steps correct, threads
+            # healthy, and the gate latch was dropped
+            self._run_steady(ctrls, steps=10, start=30)
+            for c in ctrls:
+                assert c._thread_error is None
+                assert c.quiesce(timeout=10) is True
+        finally:
+            stop_world(ctrls)
+
+    def test_preempt_pending_blocks_new_predictions(self, hvt, monkeypatch):
+        """Satellite: once a drain is pending, no NEW speculation may
+        start (quiesce handles predictions already in flight)."""
+        from horovod_tpu.core import preempt
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        pred = obs_metrics.counter(
+            "hvtpu_controller_predicted_cycles_total")
+        monkeypatch.setattr(preempt, "PENDING", True)
+        base = pred.value()
+        ctrls = make_world(2)
+        try:
+            self._run_steady(ctrls, steps=20)
+            assert pred.value() == base
+        finally:
+            stop_world(ctrls)
+
+    def test_quiesce_rolls_back_unconfirmed_predictions(
+            self, hvt, monkeypatch):
+        """Satellite: a predicted cycle whose confirmation never
+        arrives must not block the emergency commit forever — at the
+        quiesce deadline the predictor rolls back to full negotiation
+        and re-anchors exactly as if the coordinator had requested
+        cache_resync_needed."""
+        monkeypatch.setenv("HVTPU_FORCE_PY_CONTROLLER", "1")
+        ctrl = EagerController(0, 1, manual=True)
+        try:
+            with ctrl._lock:
+                ctrl._predicted.append(
+                    {"hash": 0xDEAD, "responses": [], "names": ["q1"]})
+            t0 = time.monotonic()
+            assert ctrl.quiesce(timeout=0.4) is True
+            # it WAITED for the confirmation before giving up on it
+            assert time.monotonic() - t0 >= 0.35
+            assert not ctrl._predicted
+            assert "q1" in ctrl._mispredict_names
+            # rollback re-anchors: next drain is a full resync frame
+            assert ctrl._ctrl._resync_flush
+        finally:
+            ctrl.stop()
+
+    def test_burst_hint_arms_gate_and_is_consumed_by_drain(self, hvt):
+        """The frontend burst hint (torch optimizer's per-step grad
+        count) arms the gate before stability forms, and a drain that
+        covers the hinted count consumes it — a partial drain keeps
+        the hint armed for the rest of the burst."""
+        ctrl = EagerController(0, 1, manual=True)
+        try:
+            ctrl.hint_burst(4)
+            assert ctrl._burst_hint == 4
+            blob = wire.serialize_request_list(wire.RequestList(rank=0))
+            ctrl._note_drained(2, blob)  # burst split: hint survives
+            assert ctrl._burst_hint == 4
+            ctrl._note_drained(4, blob)  # full burst: hint consumed
+            assert ctrl._burst_hint == 0
+            ctrl.hint_burst(-3)  # defensive clamp, never negative
+            assert ctrl._burst_hint == 0
+        finally:
+            ctrl.stop()
+
+    def test_burst_cap_drains_one_unit(self, hvt, monkeypatch):
+        """With a verified steady burst, each drain is capped at the
+        burst size so one wire unit == one application burst; the
+        opt-out knob restores unbounded drains."""
+        monkeypatch.setenv("HVTPU_EAGER_BURST_CAP", "0")
+        ctrl = EagerController(0, 1, manual=True)
+        try:
+            assert ctrl._burst_cap_on is False
+        finally:
+            ctrl.stop()
+        monkeypatch.delenv("HVTPU_EAGER_BURST_CAP")
+        ctrl = EagerController(0, 1, manual=True)
+        try:
+            assert ctrl._burst_cap_on is True
+        finally:
+            ctrl.stop()

@@ -47,6 +47,7 @@ class TestWireTwin:
             "enum:OpType:Barrier",
             "order:SerializeResponseList",
             "table-key-separator",
+            "burst-delimiter",
         }
         by_key = {f.key: f for f in findings}
         ver = by_key["const:kWireVersion"]
@@ -54,6 +55,17 @@ class TestWireTwin:
         assert ver.path == "horovod_tpu/native/wire.py"
         assert ver.line == 5  # the WIRE_VERSION assignment
         assert "kWireVersion=0x4" in ver.message
+
+    def test_bad_twin_burst_delimiter_fires_for_both_twins(self):
+        # The bad fixture moves the burst_id/burst_len pair before the
+        # flag bytes IDENTICALLY in both twins: the generic order
+        # check is blind to it, so only the absolute-position check
+        # stands between that edit and silent v5 framing drift.
+        findings = run_pass(wire_twin, "wire_twin_bad")
+        burst = [f for f in findings if f.key == "burst-delimiter"]
+        assert {f.path for f in burst} == {
+            wire_twin.MESSAGE_CC, wire_twin.WIRE_PY}
+        assert all("burst-unit delimiter" in f.message for f in burst)
 
     def test_missing_surface_fails_closed(self, tmp_path):
         # An empty tree must produce missing-file findings, not a
@@ -77,8 +89,8 @@ class TestWireTwin:
 
         hdr = tmp_path / wire_twin.MESSAGE_H
         text = hdr.read_text(encoding="utf-8")
-        assert "kWireVersion = 3" in text
-        hdr.write_text(text.replace("kWireVersion = 3", "kWireVersion = 4"),
+        assert "kWireVersion = 5" in text
+        hdr.write_text(text.replace("kWireVersion = 5", "kWireVersion = 6"),
                        encoding="utf-8")
 
         findings = wire_twin.run(Project(tmp_path))

@@ -1100,6 +1100,83 @@ def test_eager_multidevice_optout_2proc_x_4dev():
         assert no_lanes
 
 
+def _split_burst_body():
+    """SPMD body for the split-burst divergence matrix: records the
+    fused groupings each rank APPLIES (in order) while an injected
+    mid-burst delay on rank 1 splits its drained bursts — the exact
+    scenario that made v4 schedule prediction unsound.  With atomic
+    burst units the coordinator never fuses across a burst boundary,
+    so the applied groupings (predicted or negotiated) must stay
+    byte-identical across ranks."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvt
+    from horovod_tpu.eager import get_controller
+    from horovod_tpu.obs import metrics as obs_metrics
+
+    hvt.init()
+    r = hvt.rank()
+    ctrl = get_controller()
+    groupings = []
+    orig = ctrl._execute_one
+
+    def spy(rs, payloads):
+        groupings.append(list(rs.tensor_names))
+        return orig(rs, payloads)
+
+    ctrl._execute_one = spy
+    for step in range(14):
+        hs = [hvt.allreduce_async(jnp.full((64,), float(step)),
+                                  name=f"sb/{i}", op=hvt.Sum)
+              for i in range(4)]
+        for h in hs:
+            out = hvt.synchronize(h)
+            assert float(np.asarray(out)[0]) == 2.0 * step, (step, out)
+    assert ctrl.quiesce(timeout=20)
+    pred = obs_metrics.counter(
+        "hvtpu_controller_predicted_cycles_total").value()
+    misp = obs_metrics.counter(
+        "hvtpu_controller_mispredicts_total").value()
+    return (r, groupings, pred, misp, len(ctrl._predicted))
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("force_py", ["0", "1"])
+@pytest.mark.parametrize("stream", ["0", "1"])
+def test_split_burst_groupings_identical_2proc(force_py, stream):
+    """Split-burst divergence matrix over {native, py} × {lockstep,
+    streamed} with prediction on by default: a 20ms delay injected on
+    rank 1 mid-run splits its bursts across drain boundaries; fused
+    groupings must stay identical on both ranks, every predicted cycle
+    must be confirmed, and nothing may mispredict."""
+    import sys
+
+    import cloudpickle
+
+    env = {
+        **_ENV,
+        "HVTPU_EAGER_STREAM": stream,
+        "HVTPU_FAULT_SPEC": "collective.pre:delay(20)@rank=1,count=6,times=4",
+    }
+    if force_py == "1":
+        env["HVTPU_FORCE_PY_CONTROLLER"] = "1"
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    try:
+        results = run(_split_burst_body, np=2, cpu_devices=1, env=env,
+                      start_timeout=300.0)
+    finally:
+        cloudpickle.unregister_pickle_by_value(sys.modules[__name__])
+    (r0, g0, p0, m0, out0), (r1, g1, p1, m1, out1) = sorted(results)
+    assert (r0, r1) == (0, 1)
+    assert g0 == g1, (g0, g1)
+    assert m0 == 0 and m1 == 0  # zero mispredicts, recovered or not
+    assert out0 == 0 and out1 == 0  # every prediction confirmed
+    # every tensor of every step was applied exactly once on each rank
+    applied = sorted(n for grp in g0 for n in grp)
+    assert applied == sorted([f"sb/{i}" for i in range(4)] * 14)
+
+
 def test_eager_collectives_8proc():
     """World-size-8 smoke across REAL processes — the largest world
     this sandbox launches (multi-host shape at process granularity):

@@ -407,7 +407,7 @@ class TestCacheBitsWire:
         nfull = len(wire.serialize_request_list(full))
         nbyp = len(wire.serialize_request_list(bypass))
         assert nbyp < nfull / 20
-        assert nbyp < 40
+        assert nbyp < 48  # v5: +8 bytes of burst-unit delimiter
 
 
 @pytest.mark.skipif(not NATIVE, reason="no C++ toolchain")
@@ -442,6 +442,85 @@ class TestNativePythonAgreement:
                 nat_fins = [c.apply_responses(nat_resp) for c in nat]
                 py_fins = [c.apply_responses(py_resp) for c in py]
                 assert nat_fins == py_fins
+
+    def test_predicted_confirmation_bytes_identical(self):
+        """v5 acceptance: a fully-predicted steady cycle — every rank
+        posts a `predicted` bypass confirmation — suppresses to a
+        confirm hash instead of a response stream, and the native and
+        Python coordinators must emit byte-identical ResponseLists
+        whose hash equals the FNV-1a64 of the predicted bytes."""
+        nat = make_pair(ncore.NativeController, size=2, fusion=1 << 20)
+        py = make_pair(fallback.PyController, size=2, fusion=1 << 20)
+
+        def cycle(pairs, seq0):
+            for pair in pairs:
+                for c in pair:
+                    c.enqueue(seq0 + c.rank, "pc/a", wire.ALLREDUCE,
+                              wire.RED_SUM, 6, (8,))
+                    c.enqueue(seq0 + 10 + c.rank, "pc/b", wire.ALLREDUCE,
+                              wire.RED_SUM, 6, (8,))
+            nat_blobs = [c.drain_requests() for c in pairs[0]]
+            py_blobs = [c.drain_requests() for c in pairs[1]]
+            assert nat_blobs == py_blobs
+            return nat_blobs, py_blobs
+
+        # two warm-up cycles establish the cache; cycle 3 is steady
+        for step in range(2):
+            nat_blobs, py_blobs = cycle((nat, py), step * 100 + 1)
+            for b in nat_blobs:
+                nat[0].ingest(b)
+            for b in py_blobs:
+                py[0].ingest(b)
+            nat_resp = nat[0].compute_responses()
+            py_resp = py[0].compute_responses()
+            assert nat_resp == py_resp
+            for c in nat:
+                c.apply_responses(nat_resp)
+            for c in py:
+                c.apply_responses(py_resp)
+
+        # steady cycle: every rank predicts locally, then posts its
+        # drained bypass blob with the predicted flag set (the compact
+        # post-hoc confirmation) instead of waiting for responses
+        for pair in (nat, py):
+            for c in pair:
+                c.enqueue(300 + c.rank, "pc/a", wire.ALLREDUCE,
+                          wire.RED_SUM, 6, (8,))
+                c.enqueue(310 + c.rank, "pc/b", wire.ALLREDUCE,
+                          wire.RED_SUM, 6, (8,))
+        nat_pred = [c.predict_responses([0, 1]) for c in nat]
+        py_pred = [c.predict_responses([0, 1]) for c in py]
+        assert nat_pred[0] is not None
+        assert nat_pred == py_pred
+        nat_blobs = [wire.mark_predicted(c.drain_requests()) for c in nat]
+        py_blobs = [wire.mark_predicted(c.drain_requests()) for c in py]
+        assert nat_blobs == py_blobs
+        parsed = wire.parse_request_list(py_blobs[0])
+        assert parsed.predicted and parsed.cache_bypass
+        assert parsed.burst_len == 2 and parsed.burst_id > 0
+        for b in nat_blobs:
+            nat[0].ingest(b)
+        for b in py_blobs:
+            py[0].ingest(b)
+        nat_resp = nat[0].compute_responses()
+        py_resp = py[0].compute_responses()
+        assert nat_resp == py_resp
+        rl = wire.parse_response_list(py_resp)
+        assert rl.responses == []  # suppressed: nobody needs the bytes
+        assert rl.confirm_hashes == [wire.fnv1a64(py_pred[0])]
+        # force_resync (mispredict re-anchor) agrees byte-for-byte too:
+        # the next drain is a full-entry resync frame in both impls
+        for pair in (nat, py):
+            for c in pair:
+                c.force_resync()
+                c.enqueue(400 + c.rank, "pc/a", wire.ALLREDUCE,
+                          wire.RED_SUM, 6, (8,))
+        nat_blobs = [c.drain_requests() for c in nat]
+        py_blobs = [c.drain_requests() for c in py]
+        assert nat_blobs == py_blobs
+        parsed = wire.parse_request_list(py_blobs[0])
+        assert parsed.cache_resync and not parsed.cache_bypass
+        assert parsed.requests[0].entry.shape == (8,)
 
     def test_join_semantics_bytes_identical(self):
         """Joined-rank implicit readiness, the per-set table keys, and
@@ -810,4 +889,4 @@ class TestWheelBuild:
         zipfile.ZipFile(whl).extractall(site)
         lib = ctypes.CDLL(str(site / "horovod_tpu/native/libhvt_core.so"))
         lib.hvt_abi_version.restype = ctypes.c_int
-        assert lib.hvt_abi_version() == 4
+        assert lib.hvt_abi_version() == 5

@@ -11,6 +11,9 @@ Surfaces checked (all byte-layout-relevant):
     WriteEntry / SerializeRequestList / SerializeResponseList
     (message.cc) vs _write_entry / serialize_request_list /
     serialize_response_list (wire.py)
+  * burst-unit delimiter position (wire v5): the burst_id/burst_len
+    u32 pair must sit immediately after the flags byte of the
+    RequestList header in both twins
   * ResponseCache::Signature field order (controller.cc) vs
     Entry.signature, and the '\\x01' message-table key separator
     (controller.cc vs native/fallback.py)
@@ -352,6 +355,32 @@ def run(project: Project) -> List[Finding]:
                 PASS, WIRE_PY, 0, f"order:{cpp_fn}",
                 f"field order of {py_fn} {py_seq} disagrees with "
                 f"{cpp_fn} {cpp_seq} — serialized byte layout drift"))
+
+    # -- burst-unit delimiter position (wire v5) -----------------------
+    # The atomic-burst delimiter (burst_id u32 + burst_len u32) must be
+    # emitted directly after the flags byte — the third u8 of the
+    # RequestList header — in BOTH twins.  The generic order check above
+    # only fires when the twins disagree with *each other*; this check
+    # pins the absolute position, so a "both twins moved it" regression
+    # (which would silently break coordinator burst-unit ingest of v5
+    # frames from older peers) is also caught.
+    def _burst_delimiter_ok(seq: List[str]) -> bool:
+        u8s = [i for i, op in enumerate(seq) if op == "u8"]
+        return (len(u8s) >= 3
+                and seq[u8s[2] + 1:u8s[2] + 3] == ["u32", "u32"])
+
+    rl_body = cppscan.function_body(msg_cc, "SerializeRequestList")
+    rl_cpp_seq = cppscan.write_sequence(rl_body) if rl_body is not None else []
+    rl_py_seq = _py_write_sequence(wire_ast, "serialize_request_list") or []
+    for rel, seq, label in (
+            (MESSAGE_CC, rl_cpp_seq, "SerializeRequestList"),
+            (WIRE_PY, rl_py_seq, "serialize_request_list")):
+        if not _burst_delimiter_ok(seq):
+            findings.append(Finding(
+                PASS, rel, 0, "burst-delimiter",
+                f"{label} does not emit the burst-unit delimiter "
+                "(burst_id u32, burst_len u32) immediately after the "
+                "flags byte — v5 atomic-burst framing drift"))
 
     # -- response-cache signature field order --------------------------
     sig_body = cppscan.function_body(ctrl_cc, "ResponseCache::Signature")

@@ -36,7 +36,7 @@ _RANK_FILE_RE = re.compile(r"rank(\d+)\.trace\.json$")
 # Span phases that are communication/coordination wait from the
 # submitting rank's perspective (everything else in the trace extent
 # is treated as compute for the wait-vs-compute split).
-_WAIT_PHASES = {"NEGOTIATE", "QUEUE", "FUSE", "EXEC"}
+_WAIT_PHASES = {"NEGOTIATE", "QUEUE", "FUSE", "EXEC", "PREDICT"}
 
 # Input-pipeline wait (data/loader.py DATA_WAIT spans): bucketed
 # separately so the per-rank decomposition reads input vs compute vs
