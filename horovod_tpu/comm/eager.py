@@ -55,6 +55,7 @@ from ..core import faults
 from ..core import state as core_state
 from ..core.topology import DCN_AXIS, ICI_AXIS, LDEV_AXIS, PROC_AXIS
 from ..obs import metrics as obs_metrics
+from ..obs import stepprof
 from ..obs import tracing
 from . import spmd
 from . import stall
@@ -758,6 +759,10 @@ def allreduce(
     x = _record_collective("allreduce", x, p, compression,
                            pset=ps.process_set_id)
     t_dispatch = time.monotonic()
+    # Wall-clock dispatch window for the overlap profiler
+    # (obs/stepprof): joins against XLA device-profile timestamps,
+    # which are wall-based, so this is time.time() not monotonic.
+    t_wall0 = time.time()
 
     timeline = st.timeline
     tname = name or f"allreduce.{x.shape}.{x.dtype}"
@@ -829,8 +834,19 @@ def allreduce(
         return _post_collective("allreduce", out,
                                 pset=ps.process_set_id)
     finally:
+        t_wall1 = time.time()
+        if stepprof.ACTIVE:
+            # Executor-thread (controller-driven) windows are recorded
+            # too: they are the wire collectives the step overlaps.
+            stepprof.note_comm(tname, t_wall0, t_wall1,
+                               nbytes=int(x.nbytes))
         if traced:
-            tracing.op_done(tname, bytes=int(x.nbytes))
+            # The DONE instant carries the device-joinable wall window
+            # so hvtputrace overlap can align spans with xplane
+            # timestamps even across monotonic/wall drift.
+            tracing.op_done(tname, bytes=int(x.nbytes),
+                            wall_t0_us=int(t_wall0 * 1e6),
+                            wall_t1_us=int(t_wall1 * 1e6))
         if timeline is not None:
             timeline.end(tname)
 

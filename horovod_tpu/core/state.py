@@ -359,6 +359,17 @@ def init(config: Optional[Config] = None) -> GlobalState:
                     exc_info=True)
         # Live /debug job identity (rank/world/elastic generation).
         _metrics.register_debug_provider("job", _job_debug_state)
+        # Overlap profiler (obs/stepprof): per-step exposed-comm /
+        # overlap / MFU metrics plus a /debug provider.  Collection is
+        # passive (comm windows + step boundaries); HVTPU_STEPPROF=0
+        # disables it.
+        try:
+            from ..obs import stepprof as _stepprof
+
+            if _stepprof.ACTIVE:
+                _stepprof.install()
+        except Exception:
+            pass
         if cfg.autotune:
             from ..obs.autotune import Autotuner
 
@@ -401,6 +412,12 @@ def shutdown():
             from ..obs import metrics as _m
 
             _m.unregister_debug_provider("job")
+        except Exception:
+            pass
+        try:
+            from ..obs import stepprof as _stepprof
+
+            _stepprof.uninstall()
         except Exception:
             pass
         try:

@@ -58,6 +58,7 @@ import numpy as np
 
 from ..core import faults
 from ..obs import metrics as obs_metrics
+from ..obs import stepprof
 from ..obs import tracing
 from .sharder import Sharder
 from .sources import DataSource, map_structure
@@ -376,6 +377,7 @@ class ElasticDataLoader:
 
     def _deliver(self) -> Tuple[np.ndarray, Any]:
         t0 = time.perf_counter()
+        t_wall0 = time.time()
         if tracing.ACTIVE:
             tracing.op_begin(f"data/{self.name}", kind="data",
                              phase=tracing.DATA_WAIT,
@@ -401,6 +403,10 @@ class ElasticDataLoader:
         finally:
             if tracing.ACTIVE:
                 tracing.op_done(f"data/{self.name}")
+            if stepprof.ACTIVE:
+                # Wall-clock window for the overlap profiler's
+                # per-step data-wait bucket (obs/stepprof).
+                stepprof.note_data_wait(t_wall0, time.time())
         _M_WAIT.observe(time.perf_counter() - t0)
         if item.cursor_before != self.state.cursor \
                 or item.epoch != self.state.epoch:

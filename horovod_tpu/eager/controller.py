@@ -1393,7 +1393,15 @@ class EagerController:
                     for h in rl.confirm_hashes:
                         if (self._predicted
                                 and h == self._predicted[0]["hash"]):
-                            self._predicted.popleft()
+                            rec = self._predicted.popleft()
+                            if tracing.ACTIVE:
+                                # confirmation instant: the predicted
+                                # burst's PREDICT spans were real —
+                                # hvtputrace overlap attributes them
+                                # as coordination, not compute
+                                tracing.instant(
+                                    "predict_confirm", how="hash",
+                                    names=list(rec["names"]))
                         elif any(h == rec["hash"]
                                  for rec in self._predicted):
                             self._on_mispredict(
@@ -1418,6 +1426,13 @@ class EagerController:
                             rec["responses"].pop(0)
                             if not rec["responses"]:
                                 self._predicted.popleft()
+                                if tracing.ACTIVE:
+                                    # stream byte-verify drained the
+                                    # whole predicted burst
+                                    tracing.instant(
+                                        "predict_confirm",
+                                        how="byte-verify",
+                                        names=list(rec["names"]))
                             continue
                         if rec is not None and set(
                                 rs.tensor_names) & set(rec["names"]):

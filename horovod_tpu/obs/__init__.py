@@ -1,8 +1,10 @@
 from . import metrics
 from . import profile
+from . import stepprof
 from .autotune import Autotuner
 from .metrics import REGISTRY as metrics_registry
-from .profile import device_time_ms, op_summary, plane_names, trace
+from .profile import (device_time_ms, load_profile, op_summary,
+                      plane_names, trace)
 from .timeline import Timeline, start_jax_profiler, stop_jax_profiler
 
 __all__ = [
@@ -16,6 +18,9 @@ __all__ = [
     "op_summary",
     "device_time_ms",
     "plane_names",
+    "load_profile",
+    # step-level overlap profiler (obs/stepprof.py)
+    "stepprof",
     # metrics registry + Prometheus exposition (obs/metrics.py)
     "metrics",
     "metrics_registry",

@@ -340,6 +340,11 @@ def note_step(examples: float = 0.0, steps: float = 1.0):
         REGISTRY.counter(
             "hvtpu_examples_total", "Training examples processed."
         ).inc(examples)
+    # Step-boundary hook for the overlap profiler (import deferred:
+    # stepprof imports this module for its registry).
+    from . import stepprof as _stepprof
+    if _stepprof.ACTIVE:
+        _stepprof.note_step_boundary(steps=steps)
     now = time.monotonic()
     with _STEP_LOCK:
         prev = _STEP_STATE["t"]

@@ -34,16 +34,29 @@ from typing import Dict, Iterator, List, Optional, Tuple
 # --- minimal protobuf wire reader ------------------------------------
 
 
+class TruncatedProfile(ValueError):
+    """An xplane file ended mid-message (partial profiler flush, e.g.
+    the process died while jax.profiler was still writing).  Raised
+    with a position so callers can report how far the parse got;
+    :func:`load_profile` converts it into a ``status="truncated"``
+    result instead of propagating."""
+
+
 def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
     result = 0
     shift = 0
+    n = len(buf)
     while True:
+        if pos >= n:
+            raise TruncatedProfile(f"varint ran off buffer at byte {pos}")
         b = buf[pos]
         pos += 1
         result |= (b & 0x7F) << shift
         if not b & 0x80:
             return result, pos
         shift += 7
+        if shift > 63:
+            raise TruncatedProfile(f"varint wider than 64 bits at {pos}")
 
 
 def _fields(buf: bytes) -> Iterator[Tuple[int, int, bytes]]:
@@ -60,12 +73,20 @@ def _fields(buf: bytes) -> Iterator[Tuple[int, int, bytes]]:
             yield field, wt, val
         elif wt == 2:        # length-delimited
             ln, pos = _read_varint(buf, pos)
+            if pos + ln > n:
+                raise TruncatedProfile(
+                    f"length-delimited field {field} ({ln} bytes) runs "
+                    f"off buffer at byte {pos}")
             yield field, wt, buf[pos:pos + ln]
             pos += ln
         elif wt == 1:        # 64-bit
+            if pos + 8 > n:
+                raise TruncatedProfile(f"64-bit field truncated at {pos}")
             yield field, wt, buf[pos:pos + 8]
             pos += 8
         elif wt == 5:        # 32-bit
+            if pos + 4 > n:
+                raise TruncatedProfile(f"32-bit field truncated at {pos}")
             yield field, wt, buf[pos:pos + 4]
             pos += 4
         else:  # pragma: no cover - groups unused by xplane
@@ -75,7 +96,8 @@ def _fields(buf: bytes) -> Iterator[Tuple[int, int, bytes]]:
 # xplane.proto field numbers (tsl/profiler/protobuf/xplane.proto):
 # XSpace.planes=1; XPlane.name=2, .lines=3, .event_metadata=4 (map;
 # field 5 is the STAT metadata map — do not confuse the two);
-# XLine.events=4, .name=2; XEvent.metadata_id=1, .duration_ps=3;
+# XLine.events=4, .name=2, .timestamp_ns=3;
+# XEvent.metadata_id=1, .offset_ps=2, .duration_ps=3;
 # XEventMetadata.id=1, .name=2, .display_name=4.
 
 
@@ -114,23 +136,28 @@ def _parse_plane(buf: bytes):
 
 def _parse_line(buf: bytes):
     name = ""
+    ts_ns = 0
     events: List[bytes] = []
     for f, wt, v in _fields(buf):
         if f == 2 and wt == 2:
             name = v.decode("utf-8", "replace")
+        elif f == 3 and wt == 0:
+            ts_ns = v
         elif f == 4 and wt == 2:
             events.append(v)
-    return name, events
+    return name, ts_ns, events
 
 
-def _parse_event(buf: bytes) -> Tuple[int, int]:
-    mid, dur_ps = 0, 0
+def _parse_event(buf: bytes) -> Tuple[int, int, int]:
+    mid, off_ps, dur_ps = 0, 0, 0
     for f, wt, v in _fields(buf):
         if f == 1 and wt == 0:
             mid = v
+        elif f == 2 and wt == 0:
+            off_ps = v
         elif f == 3 and wt == 0:
             dur_ps = v
-    return mid, dur_ps
+    return mid, off_ps, dur_ps
 
 
 # --- public API --------------------------------------------------------
@@ -194,11 +221,11 @@ def op_summary(logdir: str, *, plane_substr: str = "/device:",
         if plane_substr not in pname:
             continue
         for line_buf in lines:
-            lname, events = _parse_line(line_buf)
+            lname, _ts_ns, events = _parse_line(line_buf)
             if lname != line_name:
                 continue
             for ev in events:
-                mid, dur_ps = _parse_event(ev)
+                mid, _off_ps, dur_ps = _parse_event(ev)
                 name = emeta.get(mid, f"op{mid}")
                 if name.startswith("while"):
                     continue  # container; children are separate events
@@ -219,3 +246,97 @@ def op_summary(logdir: str, *, plane_substr: str = "/device:",
 def device_time_ms(logdir: str, **kw) -> float:
     """Total device busy time in the trace (sum over op rows)."""
     return round(sum(r["total_ms"] for r in op_summary(logdir, **kw)), 3)
+
+
+# --- timestamped intervals for the overlap join (obs/stepprof.py) -----
+
+# HLO op names that are wire collectives.  XLA spells them
+# all-reduce/all-gather/... (plus -start/-done pairs for async and
+# fusion.NNN wrappers whose display name keeps the root op); hvtpu's
+# Pallas ring kernels surface as collective-permute chains.
+_COMM_OP_RE = re.compile(
+    r"(all[-_]?reduce|all[-_]?gather|all[-_]?to[-_]?all|"
+    r"reduce[-_]?scatter|collective[-_]?permute|"
+    r"(^|[^a-z])(send|recv)([^a-z]|$))",
+    re.IGNORECASE)
+
+
+def is_comm_op(name: str) -> bool:
+    """True when an XLA op name denotes a communication op."""
+    return bool(_COMM_OP_RE.search(name))
+
+
+def load_profile(logdir: str, *, plane_substr: str = "/device:",
+                 line_name: str = "XLA Ops") -> dict:
+    """Timestamped device op intervals from the newest trace — the
+    overlap profiler's device-truth input.  NEVER raises: CPU-only CI
+    (no xplane written), an empty capture, or a truncated flush all
+    come back as an explicit status so callers degrade to host-only
+    attribution instead of crashing mid-varint.
+
+    Returns::
+
+        {"status": "ok" | "no-profile" | "empty" | "truncated",
+         "reason": str,          # human-readable when status != ok
+         "path":   str | None,   # xplane file parsed (newest)
+         "planes": {plane_name: [interval, ...]}}
+
+    where each interval is ``{"op", "t0_us", "t1_us", "comm"}`` with
+    timestamps in the profiler's wall clock (XLine.timestamp_ns +
+    XEvent.offset_ps), and ``comm`` flags wire-collective ops.  Only
+    planes matching ``plane_substr`` and lines named ``line_name`` are
+    scanned (the device op line); a ``status="ok"`` result can still
+    carry zero planes when the capture saw no matching device plane —
+    callers should treat that the same as "empty".
+    """
+    paths = _find_xplanes(logdir)
+    if not paths:
+        return {"status": "no-profile", "path": None, "planes": {},
+                "reason": f"no *.xplane.pb under {logdir}"}
+    path = paths[-1]
+    try:
+        with open(path, "rb") as f:
+            space = f.read()
+    except OSError as e:
+        return {"status": "no-profile", "path": path, "planes": {},
+                "reason": f"unreadable xplane: {e}"}
+    if not space:
+        return {"status": "empty", "path": path, "planes": {},
+                "reason": "zero-byte xplane file"}
+    planes: Dict[str, List[dict]] = {}
+    try:
+        for f_no, wt, plane_buf in _fields(space):
+            if f_no != 1 or wt != 2:
+                continue
+            pname, lines, emeta = _parse_plane(plane_buf)
+            if plane_substr not in pname:
+                continue
+            ivs = planes.setdefault(pname, [])
+            for line_buf in lines:
+                lname, ts_ns, events = _parse_line(line_buf)
+                if lname != line_name:
+                    continue
+                base_us = ts_ns / 1e3
+                for ev in events:
+                    mid, off_ps, dur_ps = _parse_event(ev)
+                    name = emeta.get(mid, f"op{mid}")
+                    if name.startswith("while"):
+                        continue  # container; children are separate
+                    t0 = base_us + off_ps / 1e6
+                    ivs.append({
+                        "op": name,
+                        "t0_us": t0,
+                        "t1_us": t0 + dur_ps / 1e6,
+                        "comm": is_comm_op(name),
+                    })
+    except (TruncatedProfile, ValueError) as e:
+        return {"status": "truncated", "path": path, "planes": {},
+                "reason": str(e)}
+    for ivs in planes.values():
+        ivs.sort(key=lambda r: r["t0_us"])
+    if not any(planes.values()):
+        return {"status": "empty", "path": path, "planes": planes,
+                "reason": (f"no events on line {line_name!r} of planes "
+                           f"matching {plane_substr!r} (CPU-only "
+                           "capture?)")}
+    return {"status": "ok", "path": path, "planes": planes, "reason": ""}

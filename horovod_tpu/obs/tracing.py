@@ -248,6 +248,21 @@ def instant(name: str, **args):
         t.instant(name, **args)
 
 
+# Step-boundary instants: the host loop (metrics.note_step via
+# obs/stepprof) marks the end of each training-step window so
+# ``hvtputrace overlap`` can cut the span timeline into per-step
+# decompositions.  ``wall_us`` carries the local wall clock of the
+# boundary — the merge tool's clock_anchor/clock_offset pipeline maps
+# trace timestamps the same way, so the two stay joinable.
+STEP_BOUNDARY = "step_boundary"
+
+
+def step_boundary(wall_us: float, steps: float = 1.0, **args):
+    t = _tracer
+    if t is not None:
+        t.instant(STEP_BOUNDARY, wall_us=wall_us, steps=steps, **args)
+
+
 def install(trace_dir: str, rank: int = 0, size: int = 1, client=None,
             pings: int = 8) -> Tracer:
     """Create the process tracer and flip the ACTIVE fast-path flag.

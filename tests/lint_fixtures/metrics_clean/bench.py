@@ -2,4 +2,6 @@
 
 REQUIRED_METRIC_KEYS = [
     "hvtpu_fixture_steps_total",
+    "hvtpu_fixture_exposed_seconds",
+    "hvtpu_fixture_overlap_fraction",
 ]

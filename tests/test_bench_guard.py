@@ -121,6 +121,61 @@ class TestMetricsEmbedding:
         json.dumps(report)
 
 
+class TestOverlapSchema:
+    """PR 12: the measured overlap/MFU columns ride in every bench
+    line, distinguish measured-zero from never-measured, and the
+    recorded BENCH_MODELS rows carry them (mfu_est retained for
+    comparison against the analytic estimate)."""
+
+    def test_required_keys_cover_overlap(self, bench):
+        required = set(bench.REQUIRED_METRIC_KEYS)
+        assert "hvtpu_step_exposed_comm_seconds" in required
+        assert "hvtpu_step_overlap_fraction" in required
+        assert "hvtpu_mfu" in required
+
+    def test_report_embeds_overlap_row(self, bench):
+        report = bench.build_report(metric="m", value=1.0, unit="u")
+        row = report["overlap"]
+        assert set(row) == {"steps", "exposed_comm_seconds",
+                            "overlap_fraction", "mfu"}
+        assert row["steps"] == report["metrics"][
+            "hvtpu_step_exposed_comm_seconds"]["count"]
+        json.dumps(report)
+
+    def test_unmeasured_gauges_report_null_not_zero(self, bench):
+        from horovod_tpu.obs import stepprof
+
+        stepprof.OVERLAP_FRACTION.set(0.0)
+        stepprof.MFU.set(0.0)
+        row = bench.build_report(metric="m", value=1.0,
+                                 unit="u")["overlap"]
+        # 0 means "never joined / no FLOPs provided", reported null so
+        # a recorded 0.31 always means measured-0.31
+        assert row["overlap_fraction"] is None
+        assert row["mfu"] is None
+        stepprof.OVERLAP_FRACTION.set(0.31)
+        stepprof.MFU.set(0.42)
+        try:
+            row = bench.build_report(metric="m", value=1.0,
+                                     unit="u")["overlap"]
+            assert row["overlap_fraction"] == 0.31
+            assert row["mfu"] == 0.42
+        finally:
+            stepprof.OVERLAP_FRACTION.set(0.0)
+            stepprof.MFU.set(0.0)
+
+    def test_recorded_rows_carry_measured_columns(self, bench):
+        with open(os.path.join(_ROOT, "BENCH_MODELS.json")) as f:
+            data = json.load(f)
+        assert data["results"]
+        for row in data["results"]:
+            assert "mfu_est" in row, row["model"]  # retained
+            assert 0.0 < row["mfu_measured"] < 1.0, row["model"]
+            # null until a device-profile round records it on hardware
+            assert "overlap_fraction" in row, row["model"]
+            assert row["exposed_comm_ms"] >= 0.0, row["model"]
+
+
 class TestTorchStepSchema:
     """bench_eager's torch DistributedOptimizer step-time row: the
     schema is enforced so future rounds stay comparable, and

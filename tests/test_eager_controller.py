@@ -468,6 +468,35 @@ class TestPredictedSchedules:
         finally:
             stop_world(ctrls)
 
+    def test_predict_confirm_instants_traced(self, hvt, tmp_path):
+        """PR 12: every drained prediction leaves a ``predict_confirm``
+        instant (how=hash for suppressed bursts, how=byte-verify for
+        streamed ones) naming its tensors, so hvtputrace can tell
+        confirmed PREDICT spans from aborted ones; a clean steady run
+        traces zero mispredict instants."""
+        import json as _json
+
+        from horovod_tpu.obs import tracing
+
+        ctrls = make_world(2)
+        tracing.install(str(tmp_path), rank=0, size=1)
+        try:
+            self._run_steady(ctrls, steps=30)
+            for c in ctrls:
+                assert c.quiesce(timeout=10) is True
+        finally:
+            stop_world(ctrls)
+            tracing.uninstall()
+        with open(tmp_path / "rank0.trace.json") as f:
+            evs = _json.load(f)
+        confirms = [e for e in evs
+                    if e.get("name") == "predict_confirm"]
+        assert confirms, "no predict_confirm instants traced"
+        for e in confirms:
+            assert e["args"]["how"] in ("hash", "byte-verify")
+            assert e["args"]["names"]
+        assert not any(e.get("name") == "mispredict" for e in evs)
+
     def test_gate_and_predict_state_reset_across_cache_resync(self, hvt):
         """Satellite: a coordinator-forced resync must reset the burst
         gate's _expected_burst ITSELF (and the predict eligibility

@@ -245,6 +245,312 @@ def report(trace_dir: str, top: int = 10) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# overlap analysis: six-way step decomposition (joined against an XLA
+# device profile when one is available)
+# ---------------------------------------------------------------------------
+# Interval helpers mirror horovod_tpu/obs/stepprof.py (the runtime
+# side); duplicated here so the offline tool needs no jax — equality of
+# the two decompositions is pinned by tests/test_stepprof.py.
+
+_HOST_PHASES = {"NEGOTIATE", "QUEUE", "FUSE", "PREDICT"}
+_COMM_PHASES = {"EXEC"}
+
+
+def _iv_union(ivs):
+    out = []
+    for t0, t1 in sorted((a, b) for a, b in ivs if b > a):
+        if out and t0 <= out[-1][1]:
+            if t1 > out[-1][1]:
+                out[-1] = (out[-1][0], t1)
+        else:
+            out.append((t0, t1))
+    return out
+
+
+def _iv_intersect(a, b):
+    out = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        t0, t1 = max(a[i][0], b[j][0]), min(a[i][1], b[j][1])
+        if t1 > t0:
+            out.append((t0, t1))
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def _iv_subtract(a, b):
+    out = []
+    for t0, t1 in a:
+        cur = t0
+        for b0, b1 in b:
+            if b1 <= cur or b0 >= t1:
+                continue
+            if b0 > cur:
+                out.append((cur, b0))
+            cur = max(cur, b1)
+        if cur < t1:
+            out.append((cur, t1))
+    return out
+
+
+def _iv_total(ivs):
+    return sum(t1 - t0 for t0, t1 in ivs)
+
+
+def decompose_window(t0, t1, *, compute=(), comm=(), data=(), host=()):
+    """Six-way split of [t0, t1); same priority order and invariant
+    (parts sum to the wall) as stepprof.decompose."""
+    window = [(t0, t1)]
+    comp_u = _iv_intersect(_iv_union(compute), window)
+    comm_u = _iv_intersect(_iv_union(comm), window)
+    overlapped = _iv_intersect(comp_u, comm_u)
+    busy = _iv_union(list(comp_u) + list(comm_u))
+    data_w = _iv_subtract(_iv_intersect(_iv_union(data), window), busy)
+    host_w = _iv_subtract(
+        _iv_intersect(_iv_union(host), window),
+        _iv_union(list(busy) + list(data_w)))
+    parts = {
+        "compute": _iv_total(_iv_subtract(comp_u, comm_u)),
+        "overlapped_comm": _iv_total(overlapped),
+        "exposed_comm": _iv_total(_iv_subtract(comm_u, comp_u)),
+        "data_wait": _iv_total(data_w),
+        "host": _iv_total(host_w),
+    }
+    parts["idle"] = max((t1 - t0) - sum(parts.values()), 0.0)
+    parts["step_wall"] = t1 - t0
+    return parts
+
+
+def _load_xplane_parser():
+    """Standalone-load horovod_tpu/obs/profile.py (it is stdlib-only;
+    importing it through the horovod_tpu package would pull in jax,
+    which this offline tool must not require)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "horovod_tpu", "obs", "profile.py")
+    spec = importlib.util.spec_from_file_location(
+        "_hvtputrace_xplane", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# Device timestamps joined on the wall clock when plausible; anything
+# else (relative clocks, fixtures) is re-anchored onto the rank's
+# first comm span.
+_CLOCK_SANITY_US = 3600e6
+
+
+def _device_intervals(xplane_dir, ranks):
+    """rank -> (compute_ivs, comm_ivs) in device wall µs, plus the
+    load_profile status dict.  Sorted device planes map onto sorted
+    ranks by index; ranks beyond the plane count degrade to host-only
+    attribution."""
+    prof = _load_xplane_parser().load_profile(xplane_dir)
+    per_rank = {}
+    if prof["status"] == "ok":
+        planes = sorted(prof["planes"])
+        for i, rank in enumerate(sorted(ranks)):
+            if i >= len(planes):
+                break
+            comp, comm = [], []
+            for iv in prof["planes"][planes[i]]:
+                (comm if iv["comm"] else comp).append(
+                    (iv["t0_us"], iv["t1_us"]))
+            per_rank[rank] = (comp, comm)
+    return per_rank, {"status": prof["status"],
+                      "reason": prof.get("reason", ""),
+                      "path": prof.get("path")}
+
+
+def overlap(trace_dir: str, xplane_dir: Optional[str] = None,
+            top: int = 10) -> dict:
+    """Measured compute/communication overlap decomposition.
+
+    Joins the merged rank traces (EXEC comm spans, DATA_WAIT spans,
+    NEGOTIATE/QUEUE/FUSE/PREDICT coordination spans, step_boundary
+    instants) with an optional XLA device profile.  With a device
+    profile, compute and comm come off the device timeline and EXEC
+    span remainders attribute to host; without one the tool degrades
+    gracefully: EXEC spans are comm (all of it exposed — the host
+    cannot observe overlap), and non-span time is inferred compute.
+
+    Every rank's six parts sum to its step-window wall time.
+    """
+    merged = merge(trace_dir)
+    traces = load_rank_traces(trace_dir)
+    spans = _collect_spans(merged)
+    ranks = sorted(traces)
+
+    dev, xplane_info = (_device_intervals(xplane_dir, ranks)
+                        if xplane_dir else
+                        ({}, {"status": "no-profile", "path": None,
+                              "reason": "no --xplane directory given"}))
+
+    # Re-derive each rank's merge shift (wall µs -> merged timeline)
+    # the same way merge() does, so device wall timestamps and DONE
+    # wall annotations can be placed on the merged clock.
+    bases = {}
+    for rank, events in traces.items():
+        wall_t0_us, offset_us, _err = clock_metadata(events)
+        bases[rank] = (None if wall_t0_us is None
+                       else float(wall_t0_us) + float(offset_us or 0.0))
+    known = [b for b in bases.values() if b is not None]
+    epoch = min(known) if known else 0.0
+
+    per_rank = {}
+    exposed_rows = []
+    for rank in ranks:
+        events = [e for e in merged if e.get("pid") == rank]
+        ts = [float(e["ts"]) for e in events if "ts" in e]
+        extent = (min(ts), max(ts)) if len(ts) > 1 else (0.0, 0.0)
+        bounds = sorted(float(e["ts"]) for e in events
+                        if e.get("ph") == "i"
+                        and e.get("name") == "step_boundary")
+        windows = (list(zip(bounds, bounds[1:])) if len(bounds) >= 2
+                   else ([extent] if extent[1] > extent[0] else []))
+
+        comm_sp, host_iv, data_iv = [], [], []
+        for (tid, r), sps in spans.items():
+            if r != rank:
+                continue
+            for s in sps:
+                if s["phase"] in _COMM_PHASES:
+                    comm_sp.append((s["t0"], s["t1"], tid, s["tensor"]))
+                elif s["phase"] in _HOST_PHASES:
+                    host_iv.append((s["t0"], s["t1"]))
+                elif s["phase"] == "DATA_WAIT":
+                    data_iv.append((s["t0"], s["t1"]))
+        comm_iv = [(t0, t1) for t0, t1, _tid, _tn in comm_sp]
+
+        mode = "host-only"
+        comp_u = []
+        if rank in dev:
+            dev_comp, dev_comm = dev[rank]
+            # device wall µs -> merged timeline: merged ts = wall +
+            # offset − epoch (what merge() applies to span
+            # timestamps, whose wall_t0 anchor cancels); fixtures and
+            # relative profiler clocks re-anchor onto the first comm
+            # span below.
+            off = float(clock_metadata(traces[rank])[1] or 0.0)
+            shift = off - epoch
+            dev_all = dev_comp + dev_comm
+            if dev_all:
+                first = min(t0 for t0, _t1 in dev_all) + shift
+                anchor = (comm_iv[0][0] if comm_iv
+                          else (windows[0][0] if windows else 0.0))
+                if abs(first - anchor) > _CLOCK_SANITY_US:
+                    shift += anchor - first
+            comp_u = _iv_union([(a + shift, b + shift)
+                                for a, b in dev_comp])
+            dev_comm_shifted = [(a + shift, b + shift)
+                                for a, b in dev_comm]
+            if dev_comm_shifted:
+                comm_iv = dev_comm_shifted
+                # EXEC span remainders (host-side dispatch wait)
+                # attribute to host once device comm is the comm truth
+                host_iv = host_iv + [(t0, t1)
+                                     for t0, t1, _i, _n in comm_sp]
+            mode = "device"
+
+        agg = {k: 0.0 for k in ("compute", "overlapped_comm",
+                                "exposed_comm", "data_wait", "host",
+                                "idle", "step_wall")}
+        for w0, w1 in windows:
+            if mode == "device":
+                parts = decompose_window(
+                    w0, w1, compute=comp_u, comm=comm_iv,
+                    data=data_iv, host=host_iv)
+            else:
+                busy = _iv_union(comm_iv + data_iv + host_iv)
+                inferred = _iv_subtract([(w0, w1)], busy)
+                parts = decompose_window(
+                    w0, w1, compute=inferred, comm=comm_iv,
+                    data=data_iv, host=host_iv)
+            for k in agg:
+                agg[k] += parts[k]
+        comm_total = agg["overlapped_comm"] + agg["exposed_comm"]
+        per_rank[rank] = dict(
+            {k: round(v, 1) for k, v in agg.items()},
+            steps=max(len(windows), 0),
+            mode=mode,
+            overlap_fraction=(
+                round(agg["overlapped_comm"] / comm_total, 4)
+                if (mode == "device" and comm_total > 0) else None),
+        )
+
+        for t0, t1, tid, tensor in comm_sp:
+            if mode == "device":
+                exp = _iv_total(_iv_subtract([(t0, t1)], comp_u))
+            else:
+                exp = t1 - t0
+            exposed_rows.append({
+                "trace_id": tid, "tensor": tensor, "rank": rank,
+                "exposed_us": round(exp, 1),
+                "span_us": round(t1 - t0, 1),
+            })
+
+    exposed_rows.sort(key=lambda r: -r["exposed_us"])
+    return {
+        "trace_dir": trace_dir,
+        "xplane": xplane_info,
+        "ranks": ranks,
+        "per_rank": per_rank,
+        "top_exposed": exposed_rows[:top],
+    }
+
+
+def render_overlap(rep: dict) -> str:
+    """Human-readable rendering of overlap()'s dict."""
+    lines = [f"hvtputrace overlap — {rep['trace_dir']} "
+             f"(ranks: {rep['ranks']})"]
+    xp = rep["xplane"]
+    if xp["status"] == "ok":
+        lines.append(f"device profile: {xp['path']}")
+    else:
+        lines.append(
+            f"device profile: none ({xp['status']}: {xp['reason']}) — "
+            "host-only attribution: EXEC spans count as exposed comm, "
+            "compute is inferred from non-span time")
+    lines.append("")
+    cols = ("compute", "overlapped_comm", "exposed_comm", "data_wait",
+            "host", "idle")
+    lines.append(
+        f"  {'rank':>4}  {'steps':>5}  {'wall_ms':>9}  "
+        + "  ".join(f"{c[:10]:>10}" for c in cols)
+        + f"  {'overlap':>8}  {'mode':>9}")
+    for rank in rep["ranks"]:
+        row = rep["per_rank"][rank]
+        frac = row["overlap_fraction"]
+        pct = []
+        wall = row["step_wall"] or 1.0
+        for c in cols:
+            pct.append(f"{row[c] / 1e3:7.2f}ms" if wall else "")
+        lines.append(
+            f"  {rank:>4}  {row['steps']:>5}  "
+            f"{row['step_wall'] / 1e3:>9.2f}  "
+            + "  ".join(f"{p:>10}" for p in pct)
+            + f"  {'n/a' if frac is None else f'{frac:.2%}':>8}"
+            + f"  {row['mode']:>9}")
+    lines.append("")
+    lines.append("top exposed collectives:")
+    if not rep["top_exposed"]:
+        lines.append("  (none)")
+    for r in rep["top_exposed"]:
+        lines.append(
+            f"  {r['trace_id']} (rank {r['rank']}): "
+            f"{r['exposed_us'] / 1e3:.2f} ms exposed of "
+            f"{r['span_us'] / 1e3:.2f} ms span")
+    return "\n".join(lines)
+
+
 def render_report(rep: dict) -> str:
     """Human-readable rendering of report()'s dict."""
     lines = [f"hvtputrace report — {rep['trace_dir']} "

@@ -7,7 +7,7 @@ import json
 import os
 import sys
 
-from . import merge, render_report, report
+from . import merge, overlap, render_overlap, render_report, report
 
 
 def main(argv=None) -> int:
@@ -35,7 +35,28 @@ def main(argv=None) -> int:
     pr.add_argument("--json", action="store_true",
                     help="emit the raw report dict as JSON")
 
+    po = sub.add_parser(
+        "overlap", help="measured compute/comm overlap: six-way "
+                        "per-rank step decomposition (optionally "
+                        "joined against an XLA device profile) and a "
+                        "top-N exposed-collective list")
+    po.add_argument("trace_dir")
+    po.add_argument("--xplane", default=None,
+                    help="directory holding *.xplane.pb device "
+                         "profiles (jax.profiler/obs.profile.trace "
+                         "output); omit for host-only attribution")
+    po.add_argument("--top", type=int, default=10,
+                    help="exposed-collective table size (default 10)")
+    po.add_argument("--json", action="store_true",
+                    help="emit the raw overlap dict as JSON")
+
     args = p.parse_args(argv)
+    if args.cmd == "overlap":
+        rep = overlap(args.trace_dir, xplane_dir=args.xplane,
+                      top=args.top)
+        print(json.dumps(rep, indent=2) if args.json
+              else render_overlap(rep))
+        return 0
     if args.cmd == "merge":
         events = merge(args.trace_dir)
         out = args.output or os.path.join(args.trace_dir,
