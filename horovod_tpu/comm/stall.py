@@ -65,10 +65,10 @@ import json
 import logging
 import queue
 import threading
-import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..core import clock
 from ..core import faults
 from ..core import preempt
 from ..core.exceptions import HorovodInternalError
@@ -268,7 +268,7 @@ class SyncStallInspector:
         self._kv.key_value_set(self._key(set_id, seq, self.rank), desc)
 
         pending = [r for r in member_ranks if r != self.rank]
-        start = time.monotonic()
+        start = clock.monotonic()
         next_warn = self.warn_s
         sleep = 0.0
         use_dir = True
@@ -294,13 +294,13 @@ class SyncStallInspector:
             pending = still
             if not pending:
                 break
-            elapsed = time.monotonic() - start
+            elapsed = clock.monotonic() - start
             # A rank inside its drain grace window (core/preempt.py) is
             # late BY DESIGN — it is heading for the drain commit, not
             # stuck.  Hold the abort and report it as draining; once
             # the window expires, draining_ranks() empties and normal
             # abort semantics resume.
-            draining = preempt.draining_ranks() if preempt.PENDING \
+            draining = preempt.draining_ranks() if preempt.pending() \
                 else {}
             blamable = [r for r in pending if r not in draining]
             if self.abort_s > 0 and elapsed > self.abort_s and blamable:
@@ -332,7 +332,7 @@ class SyncStallInspector:
             # back off from a near-spin (normal skew is sub-ms) to a
             # 20ms poll for genuinely late peers
             sleep = min(0.02, sleep * 2 if sleep else 0.0002)
-            time.sleep(sleep)
+            clock.sleep(sleep)
 
         # rolling cleanup: every member has posted seq, so nobody can
         # still be waiting on marks older than seq — drop our own
@@ -371,7 +371,8 @@ class AmortizedStallInspector:
 
     def __init__(self, client, rank: int, warn_s: float, abort_s: float,
                  heartbeat_s: float = 0.5, generation: int = 0,
-                 stale_s: Optional[float] = None):
+                 stale_s: Optional[float] = None,
+                 start_heartbeat: bool = True):
         self._kv = client
         self.rank = rank
         self.warn_s = warn_s
@@ -399,9 +400,14 @@ class AmortizedStallInspector:
         # free to observe the failure latch and raise.
         self._exec_q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._exec_thread: Optional[threading.Thread] = None
-        self._thread = threading.Thread(
-            target=self._beat_loop, name="hvt-stall-heartbeat", daemon=True)
-        self._thread.start()
+        # start_heartbeat=False (fabric simulator): no background
+        # thread — the sim pumps _beat_once() itself on virtual time.
+        self._thread: Optional[threading.Thread] = None
+        if start_heartbeat:
+            self._thread = threading.Thread(
+                target=self._beat_loop, name="hvt-stall-heartbeat",
+                daemon=True)
+            self._thread.start()
 
     # -- data-plane hooks (hot path: no RPCs) --------------------------
     def pre_op(self, set_id, members, desc: str) -> str:
@@ -416,7 +422,7 @@ class AmortizedStallInspector:
             if tr is None:
                 tr = self._tracks[str(set_id)] = _SetTrack()
             tr.members = tuple(members)
-            now = time.monotonic()
+            now = clock.monotonic()
             tr.ring.append((tr.seq, desc, now))
             tr.inflight = desc
             tr.t0 = now
@@ -520,7 +526,7 @@ class AmortizedStallInspector:
             waited += sleep
             cap = 5e-4 if waited < 0.02 else 5e-3
             sleep = min(cap, sleep * 2 if sleep else 5e-5)
-            time.sleep(sleep)
+            clock.sleep(sleep)
         self._clear_inflight(set_id)
         if self.failure:
             # the collective completed but the job is already failed
@@ -539,7 +545,7 @@ class AmortizedStallInspector:
         written only by the heartbeat thread; the snapshot below is an
         intentional racy read of a dict whose values are immutable
         tuples."""
-        now = time.monotonic()
+        now = clock.monotonic()
         ages = {str(r): round(now - t, 3)
                 for r, (_b, t) in list(self._peer_seen.items())}
         return {
@@ -557,7 +563,8 @@ class AmortizedStallInspector:
         if self._exec_thread is not None and self._exec_thread.is_alive():
             self._exec_q.put(None)
             self._exec_thread.join(timeout=2.0)
-        self._thread.join(timeout=2.0)
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
         # a goodbye tombstone, NOT a plain delete: peers must be able
         # to tell a clean exit (don't blame this rank for a stall —
         # e.g. a stall_guard(block=False) marker legitimately left
@@ -601,7 +608,7 @@ class AmortizedStallInspector:
         if faults.ACTIVE and faults.inject("heartbeat"):
             return
         with self._lock:
-            now = time.monotonic()
+            now = clock.monotonic()
             sets = {
                 sid: {
                     "seq": tr.seq,
@@ -639,7 +646,7 @@ class AmortizedStallInspector:
                 continue
             if r not in latest or b > latest[r][0]:
                 latest[r] = (b, v)
-        now = time.monotonic()
+        now = clock.monotonic()
         for r, (b, _v) in latest.items():
             prev = self._peer_seen.get(r)
             if prev is None or b != prev[0]:
@@ -671,7 +678,7 @@ class AmortizedStallInspector:
                   bye_fails: Optional[list] = None) -> None:
         stale = stale or set()
         bye = bye or set()
-        now = time.monotonic()
+        now = clock.monotonic()
         fail: Optional[str] = None
         warns: List[tuple] = []
         drain_notes: List[tuple] = []
@@ -722,7 +729,7 @@ class AmortizedStallInspector:
                     if not (want_abort or want_warn):
                         continue
                     draining = (preempt.draining_ranks()
-                                if preempt.PENDING else {})
+                                if preempt.pending() else {})
                     behind = []
                     drain_behind = []
                     for r in tr.members:
@@ -889,13 +896,13 @@ def _map_backend_error(insp, err):
     msg = str(err)
     if not any(m in msg for m in _TRANSPORT_MARKERS):
         raise err
-    deadline = time.monotonic() + 2 * getattr(insp, "heartbeat_s", 0.5)
-    while insp is not None and time.monotonic() < deadline:
+    deadline = clock.monotonic() + 2 * getattr(insp, "heartbeat_s", 0.5)
+    while insp is not None and clock.monotonic() < deadline:
         if insp.failure:
             raise HorovodInternalError(
                 f"{insp.failure} (surfaced via backend error: "
                 f"{msg})") from err
-        time.sleep(0.02)
+        clock.sleep(0.02)
     raise HorovodInternalError(
         f"collective transport failure (a peer likely aborted or "
         f"died): {msg}") from err

@@ -45,9 +45,9 @@ import json
 import logging
 import os
 import threading
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import clock
 from ..obs import metrics as obs_metrics
 from .exceptions import HvtpuDivergenceError
 
@@ -118,31 +118,34 @@ def digest_tree(tree: Any) -> Dict[str, str]:
 
 
 def _exchange(digests: Dict[str, str], label: str, st,
-              timeout_s: float) -> Dict[int, Dict[str, str]]:
+              timeout_s: float, client=None) -> Dict[int, Dict[str, str]]:
     """Allgather every rank's digest map over the coordination KV
-    (mirrors ``obs.metrics.aggregate``'s sequence-numbered exchange)."""
-    from jax._src import distributed as _jd
-
+    (mirrors ``obs.metrics.aggregate``'s sequence-numbered exchange).
+    ``client`` injects the KV store (fabric simulator); the default is
+    the process's jax coordination client."""
     from . import retry as core_retry
 
-    client = _jd.global_state.client
+    if client is None:
+        from jax._src import distributed as _jd
+
+        client = _jd.global_state.client
     if client is None:
         return {st.rank: digests}
     kv = core_retry.resilient_kv(client, rank=st.rank)
     with _seq_lock:
-        key = (st.init_generation, 0, label)
+        key = (st.init_generation, st.rank, label)
         seq = _seq.get(key, 0)
         _seq[key] = seq + 1
     prefix = f"{_NS}/{st.init_generation}/{label}/{seq}/"
     kv.key_value_set(prefix + str(st.rank), json.dumps(digests))
 
     per_rank: Dict[int, Dict[str, str]] = {st.rank: digests}
-    deadline = time.monotonic() + timeout_s
+    deadline = clock.monotonic() + timeout_s
     for r in range(st.size):
         if r == st.rank:
             continue
         while True:
-            remaining = deadline - time.monotonic()
+            remaining = deadline - clock.monotonic()
             if remaining <= 0:
                 raise TimeoutError(
                     f"audit digests from rank {r} not posted within "
@@ -165,6 +168,16 @@ def _exchange(digests: Dict[str, str], label: str, st,
         except Exception:
             pass
     return per_rank
+
+
+def reset_sequences() -> None:
+    """Forget every (generation, rank, label) sequence counter.  The
+    fabric simulator starts a fresh virtual world per run inside ONE
+    process; without the reset a second run's exchange keys would
+    continue the first run's sequence and never rendezvous with a
+    fresh fabric."""
+    with _seq_lock:
+        _seq.clear()
 
 
 def _find_divergence(per_rank: Dict[int, Dict[str, str]]
@@ -205,23 +218,31 @@ def format_report(label: str, divergent: Dict[str, Dict[int, str]]) -> str:
 
 
 def verify(tree: Any, label: str = "params", *, action: Optional[str] = None,
-           timeout_s: float = 60.0) -> dict:
+           timeout_s: float = 60.0, client=None, world=None) -> dict:
     """Audit ``tree`` across all ranks; returns the report dict
     ``{"label", "divergent": {tensor: {rank: digest}}, "ranks": [...]}``.
 
     COLLECTIVE: every rank must call with the same ``label`` at the
-    same point.  ``action`` overrides ``HVTPU_AUDIT_ACTION``."""
-    from . import state as core_state
-
+    same point.  ``action`` overrides ``HVTPU_AUDIT_ACTION``.
+    ``world`` (an object with rank/size/init_generation, treated as
+    initialized) and ``client`` (the KV store) inject the exchange's
+    endpoints — the fabric simulator's per-rank view; production leaves
+    both None and uses the process's global state."""
     action = audit_action() if action is None else action
     if action not in ("abort", "warn"):
         raise ValueError(f"audit action must be abort|warn, got {action!r}")
     digests = digest_tree(tree)
-    st = core_state.global_state()
-    if st is None or not st.initialized or st.size <= 1:
+    if world is not None:
+        st, initialized = world, True
+    else:
+        from . import state as core_state
+
+        st = core_state.global_state()
+        initialized = st is not None and st.initialized
+    if not initialized or st.size <= 1:
         per_rank = {getattr(st, "rank", 0) or 0: digests}
     else:
-        per_rank = _exchange(digests, label, st, timeout_s)
+        per_rank = _exchange(digests, label, st, timeout_s, client=client)
     divergent = _find_divergence(per_rank)
     _M_RUNS.inc()
     report = {

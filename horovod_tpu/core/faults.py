@@ -56,8 +56,9 @@ import os
 import random
 import re
 import threading
-import time
 from typing import Dict, List, Optional, Sequence
+
+from . import clock
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -80,6 +81,14 @@ ACTIVE = False
 
 _registry: Optional["FaultRegistry"] = None
 _lock = threading.Lock()
+
+# Thread-local registry override (fabric simulator): each virtual-rank
+# thread gets its own FaultRegistry so clauses with rank= selectors fire
+# per VIRTUAL rank inside one process.  _tls_installs keeps the ACTIVE
+# fast path truthful while any thread-local registry is armed.
+_tls = threading.local()
+_tls_installs = 0  # every mutation holds _lock (module-level, so the
+# thread-safety pass cannot track it; uninstall()/use() enforce this)
 
 
 class FaultSpecError(ValueError):
@@ -251,10 +260,14 @@ class FaultRegistry:
     """
 
     def __init__(self, clauses: Sequence[FaultClause], rank: int = 0,
-                 seed: int = 0, state_dir: Optional[str] = None):
+                 seed: int = 0, state_dir: Optional[str] = None,
+                 exit_fn=None):
         self.rank = rank
         self.seed = seed
         self.state_dir = state_dir
+        # sim seam: ``kill`` calls exit_fn(1) instead of os._exit so a
+        # virtual rank can die without taking the host process with it
+        self._exit_fn = exit_fn
         self._lock = threading.Lock()
         self._by_site: Dict[str, List[FaultClause]] = {}
         for c in clauses:
@@ -317,7 +330,7 @@ class FaultRegistry:
             fired.source, site, self.rank,
             f", op {detail}" if detail else "")
         if fired.action == "delay":
-            time.sleep(fired.delay_ms / 1000.0)
+            clock.sleep(fired.delay_ms / 1000.0)
             return False
         if fired.action == "drop":
             return True
@@ -340,6 +353,9 @@ class FaultRegistry:
         print(f"hvtpu fault injection: killing rank {self.rank} "
               f"([{fired.source}] at {site})", file=sys.stderr, flush=True)
         sys.stdout.flush()
+        if self._exit_fn is not None:
+            self._exit_fn(1)
+            return False
         os._exit(1)
 
     def inject(self, site: str, pset=None, detail: Optional[str] = None
@@ -402,7 +418,8 @@ def install(spec: str, rank: int = 0, seed: int = 0,
     global _registry, ACTIVE
     with _lock:
         if not spec or not spec.strip():
-            _registry, ACTIVE = None, False
+            _registry = None
+            ACTIVE = _tls_installs > 0
             return None
         _registry = FaultRegistry(
             parse_spec(spec), rank=rank, seed=seed, state_dir=state_dir)
@@ -427,7 +444,31 @@ def install_from_config(cfg, rank: int) -> Optional[FaultRegistry]:
 def uninstall() -> None:
     global _registry, ACTIVE
     with _lock:
-        _registry, ACTIVE = None, False
+        _registry = None
+        ACTIVE = _tls_installs > 0
+
+
+def use(reg: Optional[FaultRegistry]) -> None:
+    """Install ``reg`` as the CALLING THREAD's fault registry (None to
+    uninstall).  The fabric simulator arms one registry per virtual-rank
+    thread this way; :func:`inject` / :func:`inject_tensor` on that
+    thread then route to it instead of the process-wide registry, and
+    the module ``ACTIVE`` fast path stays truthful while any
+    thread-local registry is armed."""
+    global _tls_installs, ACTIVE
+    prev = getattr(_tls, "registry", None)
+    _tls.registry = reg
+    with _lock:
+        if reg is not None and prev is None:
+            _tls_installs += 1
+        elif reg is None and prev is not None:
+            _tls_installs = max(0, _tls_installs - 1)
+        ACTIVE = _registry is not None or _tls_installs > 0
+
+
+def _current() -> Optional[FaultRegistry]:
+    reg = getattr(_tls, "registry", None)
+    return reg if reg is not None else _registry
 
 
 def inject(site: str, pset=None, detail: Optional[str] = None) -> bool:
@@ -436,7 +477,7 @@ def inject(site: str, pset=None, detail: Optional[str] = None) -> bool:
     :class:`InjectedFault` (error), or never return (kill).  A no-op
     returning False when nothing is installed — but hot paths should
     guard on ``faults.ACTIVE`` and skip the call entirely."""
-    reg = _registry
+    reg = _current()
     if reg is None:
         return False
     return reg.inject(site, pset=pset, detail=detail)
@@ -448,7 +489,7 @@ def inject_tensor(site: str, tensor, pset=None,
     ``corrupt``-poisoned) tensor; other actions behave as in
     :func:`inject` except ``drop``, which is a no-op at tensor sites.
     Hot paths guard on ``faults.ACTIVE`` before calling."""
-    reg = _registry
+    reg = _current()
     if reg is None:
         return tensor
     return reg.inject_tensor(site, tensor, pset=pset, detail=detail)

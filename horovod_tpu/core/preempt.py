@@ -54,9 +54,9 @@ import os
 import signal
 import sys
 import threading
-import time
 from typing import Dict, Optional
 
+from . import clock
 from ..obs import metrics as obs_metrics
 from ..obs import tracing
 
@@ -95,6 +95,12 @@ _M_DRAIN_COMMIT_S = obs_metrics.histogram(
 _coord: Optional["_DrainCoordinator"] = None
 _module_lock = threading.Lock()
 
+# Thread-local coordinator override: the fabric simulator installs one
+# _DrainCoordinator per virtual-rank thread (see horovod_tpu/sim) so N
+# ranks' drain protocols coexist in one process without sharing the
+# module-global fast path.  Production never touches this.
+_tls = threading.local()
+
 
 def resolve_signal(name) -> Optional[signal.Signals]:
     """'SIGTERM' / 'TERM' / '15' -> signal.Signals, None if unknown."""
@@ -127,13 +133,20 @@ class _DrainCoordinator:
 
     def __init__(self, rank: int, size: int, grace_s: float,
                  notice_file: Optional[str], generation: int,
-                 client=None):
+                 client=None, *, start_watcher: bool = True,
+                 shared_pending: bool = True, exit_fn=None):
         self._kv = client
         self.rank = rank
         self.size = size
         self.grace_s = max(0.5, float(grace_s))
         self.notice_file = notice_file
         self.gen = generation
+        # shared_pending=False (sim): drain state stays per-instance so
+        # N coordinators in one process never see each other's notices
+        # through the module global.  exit_fn (sim) replaces os._exit.
+        self._shared_pending = shared_pending
+        self._exit_fn = exit_fn
+        self._pending_local = False
         self._lock = threading.Lock()
         # Set from the signal handler WITHOUT the lock (a handler runs
         # on the main thread between bytecodes; taking a non-reentrant
@@ -145,7 +158,12 @@ class _DrainCoordinator:
         self._notice_t = 0.0
         # watcher-thread-only bookkeeping
         self._notice_posted = False
-        self._grace_timer: Optional[threading.Timer] = None
+        # The notice KEY may be posted from either the watcher or the
+        # commit thread (see drain_boundary) — separate flag, lock-
+        # guarded; a benign double-post of the identical value is the
+        # worst a race here can produce.
+        self._notice_key_posted = False  # hvtpulint: guarded-by(_lock)
+        self._grace_timer: Optional[clock.Timer] = None
         # rank -> first-seen monotonic time of a peer's drain notice
         self._peer_notices: Dict[int, float] = {}  # hvtpulint: guarded-by(_lock)
         self._plans: Dict[int, int] = {}  # hvtpulint: guarded-by(_lock)
@@ -153,10 +171,12 @@ class _DrainCoordinator:
         self._drained = False  # hvtpulint: guarded-by(_lock)
         self._wake = threading.Event()
         self._stopped = threading.Event()
-        self._thread = threading.Thread(
-            target=self._watch_loop, name="hvtpu-preempt-watch",
-            daemon=True)
-        self._thread.start()
+        self._thread: Optional[threading.Thread] = None
+        if start_watcher:
+            self._thread = threading.Thread(
+                target=self._watch_loop, name="hvtpu-preempt-watch",
+                daemon=True)
+            self._thread.start()
 
     # -- notice intake (signal-handler safe) ---------------------------
     def notice(self, source: str) -> None:
@@ -166,11 +186,22 @@ class _DrainCoordinator:
         if self._departing:
             return
         self._reason = source
-        self._notice_t = time.monotonic()
+        self._notice_t = clock.monotonic()
         self._departing = True
-        global PENDING
-        PENDING = True
+        self._mark_pending()
         self._wake.set()
+
+    @property
+    def pending(self) -> bool:
+        """Any drain pending anywhere in the world, as seen by this
+        coordinator (instance state; never the module global)."""
+        return self._pending_local
+
+    def _mark_pending(self) -> None:
+        self._pending_local = True
+        if self._shared_pending:
+            global PENDING
+            PENDING = True
 
     # -- watcher -------------------------------------------------------
     def _watch_loop(self) -> None:
@@ -201,16 +232,28 @@ class _DrainCoordinator:
                     "drain_begin", rank=self.rank, source=self._reason,
                     grace_s=self.grace_s)
             self._arm_grace_timer()
-            if self._kv is not None:
-                self._kv.key_value_set(
-                    f"{_NS}/{self.gen}/notice/{self.rank}",
-                    json.dumps({"reason": self._reason,
-                                "grace_s": self.grace_s}))
+            self._post_notice_key()
         # 3. observe peers' notices and drain plans
         if self._kv is None or self.size <= 1:
             return
+        self._observe_peers()
+
+    def _post_notice_key(self) -> None:
+        """Publish this rank's notice marker exactly once (idempotent
+        across the watcher and commit threads)."""
+        with self._lock:
+            if self._notice_key_posted:
+                return
+            self._notice_key_posted = True
+        if self._kv is not None:
+            self._kv.key_value_set(
+                f"{_NS}/{self.gen}/notice/{self.rank}",
+                json.dumps({"reason": self._reason,
+                            "grace_s": self.grace_s}))
+
+    def _observe_peers(self) -> None:
         entries = self._dir_entries()
-        now = time.monotonic()
+        now = clock.monotonic()
         newly_seen = []
         any_peer = False
         with self._lock:
@@ -233,8 +276,7 @@ class _DrainCoordinator:
                 "rank %d draining (preemption notice); emergency "
                 "commit at the next agreed step boundary", r)
         if any_peer:
-            global PENDING
-            PENDING = True
+            self._mark_pending()
 
     def _dir_entries(self):
         """[(kind, rank, value)] under this generation's namespace —
@@ -271,10 +313,8 @@ class _DrainCoordinator:
 
     # -- grace bound ---------------------------------------------------
     def _arm_grace_timer(self) -> None:
-        t = threading.Timer(self.grace_s, self._grace_expired)
-        t.daemon = True
-        t.start()
-        self._grace_timer = t
+        self._grace_timer = clock.call_later(
+            self.grace_s, self._grace_expired)
 
     def _grace_expired(self) -> None:
         with self._lock:
@@ -294,6 +334,15 @@ class _DrainCoordinator:
         if tracing.ACTIVE:
             tracing.instant("drain_exit", rank=self.rank,
                             committed=False)
+        self._planned_exit()
+
+    def _planned_exit(self) -> None:
+        """Leave the process with the planned-departure code.  The sim
+        substitutes ``exit_fn`` (raising a virtual-exit control-flow
+        exception) and skips the real-process teardown."""
+        if self._exit_fn is not None:
+            self._exit_fn(DRAIN_EXIT_CODE)
+            return
         self._quiesce_data_loaders()
         try:
             from . import state as core_state
@@ -330,6 +379,16 @@ class _DrainCoordinator:
                 "%d", self.rank, post)
             if self._kv is not None:
                 try:
+                    # Key-order invariant (found by the fabric
+                    # simulator): a notice arriving within one watcher
+                    # poll of a commit boundary would otherwise publish
+                    # the PLAN before the NOTICE, and a peer scanning
+                    # between the two reaches its drain commit with no
+                    # notice recorded — DrainInterrupt then misattributes
+                    # the departure (rank=-1).  Posting the notice here
+                    # first guarantees every observer of a plan has also
+                    # seen its notice.
+                    self._post_notice_key()
                     self._kv.key_value_set(
                         f"{_NS}/{self.gen}/plan/{self.rank}", str(post))
                 except Exception:
@@ -384,7 +443,7 @@ class _DrainCoordinator:
             # peers measure from their first observation of any notice
             with self._lock:
                 t0 = min(self._peer_notices.values(), default=0.0)
-        elapsed = (time.monotonic() - t0) if t0 else 0.0
+        elapsed = (clock.monotonic() - t0) if t0 else 0.0
         _M_DRAIN_COMMIT_S.observe(elapsed)
         if tracing.ACTIVE:
             tracing.instant(
@@ -401,18 +460,10 @@ class _DrainCoordinator:
             if tracing.ACTIVE:
                 tracing.instant("drain_exit", rank=self.rank,
                                 committed=True)
-            self._quiesce_data_loaders()
-            try:
-                from . import state as core_state
-
-                # posts the stall goodbye tombstone and flushes traces
-                # before the coordination client goes away
-                core_state.shutdown()
-            except Exception:
-                pass
-            sys.stdout.flush()
-            sys.stderr.flush()
-            os._exit(DRAIN_EXIT_CODE)
+            # production path posts the stall goodbye tombstone and
+            # flushes traces before the coordination client goes away
+            self._planned_exit()
+            return
         from .exceptions import DrainInterrupt
 
         raise DrainInterrupt(
@@ -426,7 +477,7 @@ class _DrainCoordinator:
         generous — the safe direction for holding a stall abort).
         Entries disappear when the window expires, so normal stall
         semantics resume if a drain wedges."""
-        now = time.monotonic()
+        now = clock.monotonic()
         out: Dict[int, float] = {}
         if self._departing:
             rem = self.grace_s - (now - self._notice_t)
@@ -448,7 +499,7 @@ class _DrainCoordinator:
                 plans[self.rank] = self._plan
             drained = self._drained
         return {
-            "pending": PENDING,
+            "pending": self._pending_local,
             "departing": self._departing,
             "reason": self._reason or None,
             "drained": drained,
@@ -462,12 +513,37 @@ class _DrainCoordinator:
     def stop(self) -> None:
         self._stopped.set()
         self._wake.set()
-        self._thread.join(timeout=2.0)
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
         if self._grace_timer is not None:
             self._grace_timer.cancel()
 
 
 # -- module surface (what the rest of the framework calls) -------------
+
+def use(coord: Optional["_DrainCoordinator"]) -> None:
+    """Install ``coord`` as the CALLING THREAD's drain coordinator
+    (None to uninstall).  The fabric simulator gives each virtual-rank
+    thread its own coordinator this way; every module-level entry point
+    below then routes to it instead of the process-wide one."""
+    _tls.coord = coord
+
+
+def _current() -> Optional["_DrainCoordinator"]:
+    c = getattr(_tls, "coord", None)
+    return c if c is not None else _coord
+
+
+def pending() -> bool:
+    """Is any drain pending, as seen by the calling thread?  The hot
+    path: one thread-local read plus one attribute read.  Prefer this
+    over reading :data:`PENDING` directly — the module global cannot be
+    virtualised per rank."""
+    c = getattr(_tls, "coord", None)
+    if c is not None:
+        return c.pending
+    return PENDING
+
 
 def install(cfg, rank: int, size: int, client=None) -> None:
     """Arm the drain coordinator (called by ``core.state.init`` for
@@ -540,7 +616,7 @@ def installed() -> bool:
 def notice(source: str = "api") -> None:
     """Deliver a preemption notice to this rank programmatically (the
     ``preempt`` fault action and tests use this)."""
-    coord = _coord
+    coord = _current()
     if coord is None:
         logger.warning(
             "preemption notice (%s) ignored: the drain coordinator is "
@@ -551,8 +627,8 @@ def notice(source: str = "api") -> None:
 
 def drain_boundary(commit_count: int) -> bool:
     """True when this commit boundary is the agreed drain commit.
-    Callers guard on :data:`PENDING` first (hot path)."""
-    coord = _coord
+    Callers guard on :func:`pending` first (hot path)."""
+    coord = _current()
     if coord is None:
         return False
     return coord.drain_boundary(commit_count)
@@ -561,7 +637,7 @@ def drain_boundary(commit_count: int) -> bool:
 def finish_drain(commit_count: int) -> None:
     """Complete the drain after the commit persisted: the departing
     rank exits :data:`DRAIN_EXIT_CODE`; peers raise DrainInterrupt."""
-    coord = _coord
+    coord = _current()
     if coord is not None:
         coord.finish_drain(commit_count)
 
@@ -569,14 +645,14 @@ def finish_drain(commit_count: int) -> None:
 def draining_ranks() -> Dict[int, float]:
     """rank -> remaining grace seconds for ranks currently draining
     (stall inspectors report these instead of blaming them)."""
-    coord = _coord
+    coord = _current()
     if coord is None:
         return {}
     return coord.draining_ranks()
 
 
 def debug_state() -> dict:
-    coord = _coord
+    coord = _current()
     if coord is None:
-        return {"pending": PENDING, "installed": False}
+        return {"pending": pending(), "installed": False}
     return coord.debug_state()

@@ -36,10 +36,10 @@ from __future__ import annotations
 import dataclasses
 import os
 import random
-import time
 from typing import Any, Callable, Optional, Tuple
 
 from ..obs import metrics as obs_metrics
+from . import clock
 from . import faults
 
 _M_KV_RETRIES = obs_metrics.counter(
@@ -100,7 +100,7 @@ def call(policy: RetryPolicy, fn: Callable, *args,
     spent.  ``on_retry(attempt, exc_or_None)`` fires before each sleep.
     """
     rng = rng or random.Random()
-    start = time.monotonic()
+    start = clock.monotonic()
     attempt = 0
     while True:
         attempt += 1
@@ -110,21 +110,21 @@ def call(policy: RetryPolicy, fn: Callable, *args,
             budget_left = (
                 attempt < policy.max_attempts
                 and (policy.deadline_s is None
-                     or time.monotonic() - start < policy.deadline_s))
+                     or clock.monotonic() - start < policy.deadline_s))
             if not policy.retryable(e) or not budget_left:
                 raise
             if on_retry is not None:
                 on_retry(attempt, e)
-            time.sleep(policy.backoff_s(attempt, rng))
+            clock.sleep(policy.backoff_s(attempt, rng))
             continue
         if (policy.retry_result is not None
                 and policy.retry_result(result)
                 and attempt < policy.max_attempts
                 and (policy.deadline_s is None
-                     or time.monotonic() - start < policy.deadline_s)):
+                     or clock.monotonic() - start < policy.deadline_s)):
             if on_retry is not None:
                 on_retry(attempt, None)
-            time.sleep(policy.backoff_s(attempt, rng))
+            clock.sleep(policy.backoff_s(attempt, rng))
             continue
         return result
 

@@ -32,7 +32,6 @@ import logging
 import os
 import queue
 import threading
-import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -44,6 +43,7 @@ from ..comm import eager as eager_comm
 from ..comm.compression import NoneCompressor
 from ..comm.packing import pack_flat, unpack_flat
 from ..comm.reduce_ops import ReduceOp
+from ..core import clock
 from ..core import faults
 from ..core import preempt
 from ..core import retry as core_retry
@@ -179,6 +179,11 @@ class TransportClosed(Exception):
 class LocalTransport:
     """Single-process world: coordinator == the only member."""
 
+    #: Capability flag EagerController.start() consults instead of an
+    #: isinstance check, so injected transports (the fabric simulator's
+    #: per-rank KV facade) can opt into the streamed plane.
+    supports_streaming = False
+
     def exchange(self, ctrl, cycle: int, request_blob: bytes) -> bytes:
         ctrl.ingest(request_blob)
         return ctrl.compute_responses()
@@ -191,6 +196,8 @@ class KVTransport:
     """Coordination blobs over the JAX coordination-service KV store
     (replaces MPI_Gatherv/MPI_Bcast of mpi_controller.cc; the store
     itself replaces the Gloo HTTP rendezvous of http_server.py)."""
+
+    supports_streaming = True
 
     def __init__(self, rank: int, size: int, client=None,
                  timeout_s: float = 600.0, namespace: str = "hvt_eager",
@@ -244,7 +251,7 @@ class KVTransport:
         )
 
     def _get(self, key: str, deadline_s: Optional[float] = None) -> bytes:
-        deadline = time.monotonic() + (
+        deadline = clock.monotonic() + (
             self.timeout_ms / 1000.0 if deadline_s is None else deadline_s)
         poll_ms = max(1, int(min(self.poll_s,
                                  deadline_s if deadline_s else self.poll_s)
@@ -276,7 +283,7 @@ class KVTransport:
                              or core_retry.kv_retryable(e))
                 if not retryable:
                     raise
-                if time.monotonic() > deadline:
+                if clock.monotonic() > deadline:
                     raise TimeoutError(
                         f"coordination key {key!r} not posted within "
                         f"{self.timeout_ms / 1000.0:.0f}s"
@@ -300,7 +307,7 @@ class KVTransport:
             return
         want = {f"{prefix}r{r}": r for r in range(self.size)}
         got: Dict[str, bytes] = {}
-        deadline = time.monotonic() + self.timeout_ms / 1000.0
+        deadline = clock.monotonic() + self.timeout_ms / 1000.0
         sleep = 0.0
         while True:
             if self._closed.is_set():
@@ -320,7 +327,7 @@ class KVTransport:
                               else base64.b64decode(v))
             if len(got) == len(want):
                 break
-            if time.monotonic() > deadline:
+            if clock.monotonic() > deadline:
                 missing = sorted(
                     r for k, r in want.items() if k not in got)
                 raise TimeoutError(
@@ -331,7 +338,7 @@ class KVTransport:
             # off toward poll_s — a rank in a long compute step must
             # not be hammered with O(P x blob) directory re-fetches
             sleep = min(self.poll_s, sleep * 2 if sleep else 2e-4)
-            time.sleep(sleep)
+            clock.sleep(sleep)
         # deterministic ingest order (coordinator decisions must not
         # depend on arrival order)
         for r in range(self.size):
@@ -633,7 +640,7 @@ class EagerController:
         if self._thread is None:
             self._stream = (
                 self.size > 1
-                and isinstance(self._transport, KVTransport)
+                and getattr(self._transport, "supports_streaming", False)
                 and os.environ.get("HVTPU_EAGER_STREAM", "1") != "0"
             )
             self._exec_queue = queue.Queue(maxsize=4)
@@ -675,14 +682,14 @@ class EagerController:
         quiesce succeeds on re-verified ground.  Returns True when the
         controller went idle within ``timeout`` (immediately true when
         already idle)."""
-        deadline = time.monotonic() + timeout
+        deadline = clock.monotonic() + timeout
         while True:
             with self._lock:
                 busy = bool(self._payloads) or self._undrained != 0
                 unconfirmed = bool(self._predicted)
             if not busy and not unconfirmed:
                 return True
-            if time.monotonic() >= deadline:
+            if clock.monotonic() >= deadline:
                 rolled_back = 0
                 with self._lock:
                     if (not self._payloads and self._undrained == 0
@@ -702,7 +709,7 @@ class EagerController:
                     return True
                 return False
             self._wake.set()
-            time.sleep(0.01)
+            clock.sleep(0.01)
 
     def request_shutdown(self):
         """Announce this rank's shutdown in subsequent cycles WITHOUT
@@ -737,8 +744,8 @@ class EagerController:
             t_ms = getattr(self._transport, "timeout_ms", None)
             if t_ms:
                 linger = min(linger, t_ms / 1000.0)
-            deadline = time.monotonic() + linger
-            while time.monotonic() < deadline:
+            deadline = clock.monotonic() + linger
+            while clock.monotonic() < deadline:
                 if self._shutdown_seen.wait(timeout=0.1):
                     break
                 # the cycle thread dying (stall abort, transport
@@ -842,7 +849,7 @@ class EagerController:
             rop=op, prescale=prescale_factor, postscale=postscale_factor,
             compressor=compressor, splits=splits, kind=kind,
             process_set=process_set, psid=psid, root_rank=root_rank,
-            t_enqueue=time.monotonic(),
+            t_enqueue=clock.monotonic(),
         )
         with self._lock:
             seq = next(self._seq)
@@ -861,7 +868,7 @@ class EagerController:
             self._by_name[name] = seq
             self._undrained += 1
             self._pending_buf.append(name)
-            self._last_enqueue_t = time.monotonic()
+            self._last_enqueue_t = clock.monotonic()
             if self._timeline is not None:
                 # Parity: timeline.cc NEGOTIATE_<OP> span from enqueue
                 # until the agreed response arrives (execution phases
@@ -960,7 +967,7 @@ class EagerController:
         # (bounded at 4 ms by default) for this rank's next exchange.
         idle_cycles = 0
         while not self._stop.is_set():
-            t0 = time.monotonic()
+            t0 = clock.monotonic()
             try:
                 active = self.run_cycle_once()
             except TransportClosed:
@@ -982,7 +989,7 @@ class EagerController:
                 return
             idle_cycles = 0 if active else min(idle_cycles + 1, 3)
             if active:
-                elapsed = time.monotonic() - t0
+                elapsed = clock.monotonic() - t0
                 sleep = self.cycle_time_s - elapsed
             else:
                 # Empty cycles are not free: each is a full KV
@@ -1104,7 +1111,7 @@ class EagerController:
         limits = [s for s in (self.stall_warn_s, self.stall_abort_s)
                   if s and s > 0 and s != float("inf")]
         stall_every = min([2.0] + [max(0.05, s / 2) for s in limits])
-        next_stall = time.monotonic() + stall_every
+        next_stall = clock.monotonic() + stall_every
         idle = 0
         while not self._stop.is_set():
             active = False
@@ -1113,8 +1120,8 @@ class EagerController:
                     active = self._drain_once()
                 if self.rank == 0:
                     active = self._service_once() or active
-                if time.monotonic() >= next_stall:
-                    next_stall = time.monotonic() + stall_every
+                if clock.monotonic() >= next_stall:
+                    next_stall = clock.monotonic() + stall_every
                     self._inspect_stalls()
             except TransportClosed:
                 break
@@ -1140,7 +1147,7 @@ class EagerController:
         executed before the blob even leaves this host, and the blob
         itself goes out carrying the PREDICTED confirmation flag
         instead of waiting on a response round trip."""
-        t0 = time.monotonic()
+        t0 = clock.monotonic()
         self._gate_burst()
         # Atomic-burst drain cap: with an established steady burst,
         # drain exactly one burst per wire unit — enqueues of the NEXT
@@ -1182,7 +1189,7 @@ class EagerController:
         if take < drained:
             self._wake.set()  # capped remainder drains next pass
         _M_CYCLES.inc()
-        _M_CYCLE_S.observe(time.monotonic() - t0)
+        _M_CYCLE_S.observe(clock.monotonic() - t0)
         return True
 
     def _try_predict(self, parsed: wire.RequestList,
@@ -1230,7 +1237,7 @@ class EagerController:
         if not (self._stream and self._predict_on
                 and parsed.cache_bypass):
             return False
-        if preempt.PENDING:
+        if preempt.pending():
             # A coordinated drain is in flight: no NEW speculation —
             # everything from here to the emergency commit runs fully
             # negotiated (quiesce handles predictions already made).
@@ -1357,124 +1364,136 @@ class EagerController:
         sync_stall.bypass_thread()
         while not self._stop.is_set():
             try:
-                if self.rank == 0:
-                    if not self._local_resp:
-                        self._local_resp_ev.wait(0.25)
-                        self._local_resp_ev.clear()
-                        continue
-                    blob = self._local_resp.popleft()
-                else:
-                    blob = self._transport.fetch_response(self._next_resp)
-                    if blob is None:
-                        continue
+                if not self._fetch_once():
+                    continue
             except TransportClosed:
                 break
             except BaseException as e:  # noqa: BLE001
                 self._fail_all(e, "eager controller fetch loop failed")
                 return
-            try:
-                finished = self._ctrl.apply_responses(blob)
-                rl = wire.parse_response_list(blob)
-                if rl.cache_resync_needed:
-                    # re-announce in-flight ops next drain (see the
-                    # controller's resync-flush handling)
-                    self._post_needed = True
-                    self._wake.set()
-                with self._lock:
-                    # Post-hoc confirmations first: the coordinator
-                    # emits burst components in every rank's drain
-                    # order, so each hash must retire the OLDEST
-                    # outstanding prediction.  A hash matching nothing
-                    # in the FIFO belongs to a component this rank is
-                    # not a member of (or is stale after a reset) —
-                    # ignored; a hash matching a LATER record means
-                    # the head burst was released differently:
-                    # mispredict.
-                    for h in rl.confirm_hashes:
-                        if (self._predicted
-                                and h == self._predicted[0]["hash"]):
-                            rec = self._predicted.popleft()
-                            if tracing.ACTIVE:
-                                # confirmation instant: the predicted
-                                # burst's PREDICT spans were real —
-                                # hvtputrace overlap attributes them
-                                # as coordination, not compute
-                                tracing.instant(
-                                    "predict_confirm", how="hash",
-                                    names=list(rec["names"]))
-                        elif any(h == rec["hash"]
-                                 for rec in self._predicted):
-                            self._on_mispredict(
-                                "confirmation skipped the oldest "
-                                "outstanding prediction (hash "
-                                f"{h:#018x} matched a later burst)")
-                    # verify-and-skip responses already executed from
-                    # a predicted schedule (FIFO: the response stream
-                    # and the prediction order are both drain-
-                    # ordered); every other response marks its tensors
-                    # as scheduled
-                    keep = []
-                    for rs in rl.responses:
-                        rec = (self._predicted[0] if self._predicted
-                               else None)
-                        if (rec is not None and rec["responses"]
-                                and rs == rec["responses"][0]):
-                            # a partially-predicted burst (some member
-                            # observed instead, so no suppression)
-                            # streams real responses: byte-verify
-                            # against the prediction, skip re-execution
-                            rec["responses"].pop(0)
-                            if not rec["responses"]:
-                                self._predicted.popleft()
-                                if tracing.ACTIVE:
-                                    # stream byte-verify drained the
-                                    # whole predicted burst
-                                    tracing.instant(
-                                        "predict_confirm",
-                                        how="byte-verify",
-                                        names=list(rec["names"]))
-                            continue
-                        if rec is not None and set(
-                                rs.tensor_names) & set(rec["names"]):
-                            # shares tensors with the oldest predicted
-                            # burst but differs from its schedule: the
-                            # coordinator released something else
-                            self._on_mispredict(
-                                "released schedule diverged from the "
-                                f"predicted one for {rs.tensor_names}")
-                        for n in rs.tensor_names:
-                            self._unsched.discard(n)
-                        if self._observe:
-                            # first-occurrence verification: the real
-                            # stream must emit EXACTLY the predicted
-                            # schedule before a bit-set may predict
-                            ob = self._observe[0]
-                            if rs in ob[1]:
-                                ob[2] += 1
-                                if ob[2] == len(ob[1]):
-                                    self._verified_bits.add(ob[0])
-                                    self._observe.popleft()
-                            else:
-                                ob_names = {n for pr in ob[1]
-                                            for n in pr.tensor_names}
-                                if ob_names.intersection(rs.tensor_names):
-                                    # shares tensors but differs: the
-                                    # world disagrees — never verify
-                                    self._observe.popleft()
-                        keep.append(rs)
-                    rl.responses = keep
-                self._dispatch_execution(rl, finished)
-            except BaseException as e:  # noqa: BLE001
-                self._fail_all(e, "eager controller fetch loop failed")
-                return
-            self._next_resp += 1
-            if self.rank != 0 and self._next_resp % 64 == 0:
-                try:
-                    self._transport.post_ack(self._next_resp - 1)
-                except Exception:
-                    pass
             if self._shutdown_seen.is_set():
                 return
+
+    def _fetch_once(self, wait_s: float = 0.25) -> bool:
+        """One streamed-plane fetch step: take the next response blob
+        (rank 0 from its in-process feed, other ranks from the KV
+        response stream) and apply it.  Returns True when a blob was
+        applied, False when none arrived within ``wait_s``.  Factored
+        out of :meth:`_fetch_loop` so the fabric simulator can pump the
+        response plane one application at a time with no fetcher
+        thread (``wait_s=0``); exceptions propagate to the caller."""
+        if self.rank == 0:
+            if not self._local_resp:
+                self._local_resp_ev.wait(wait_s)
+                self._local_resp_ev.clear()
+                if not self._local_resp:
+                    return False
+            blob = self._local_resp.popleft()
+        else:
+            blob = self._transport.fetch_response(self._next_resp)
+            if blob is None:
+                return False
+        self._apply_response_blob(blob)
+        return True
+
+    def _apply_response_blob(self, blob: bytes) -> None:
+        finished = self._ctrl.apply_responses(blob)
+        rl = wire.parse_response_list(blob)
+        if rl.cache_resync_needed:
+            # re-announce in-flight ops next drain (see the
+            # controller's resync-flush handling)
+            self._post_needed = True
+            self._wake.set()
+        with self._lock:
+            # Post-hoc confirmations first: the coordinator
+            # emits burst components in every rank's drain
+            # order, so each hash must retire the OLDEST
+            # outstanding prediction.  A hash matching nothing
+            # in the FIFO belongs to a component this rank is
+            # not a member of (or is stale after a reset) —
+            # ignored; a hash matching a LATER record means
+            # the head burst was released differently:
+            # mispredict.
+            for h in rl.confirm_hashes:
+                if (self._predicted
+                        and h == self._predicted[0]["hash"]):
+                    rec = self._predicted.popleft()
+                    if tracing.ACTIVE:
+                        # confirmation instant: the predicted
+                        # burst's PREDICT spans were real —
+                        # hvtputrace overlap attributes them
+                        # as coordination, not compute
+                        tracing.instant(
+                            "predict_confirm", how="hash",
+                            names=list(rec["names"]))
+                elif any(h == rec["hash"]
+                         for rec in self._predicted):
+                    self._on_mispredict(
+                        "confirmation skipped the oldest "
+                        "outstanding prediction (hash "
+                        f"{h:#018x} matched a later burst)")
+            # verify-and-skip responses already executed from
+            # a predicted schedule (FIFO: the response stream
+            # and the prediction order are both drain-
+            # ordered); every other response marks its tensors
+            # as scheduled
+            keep = []
+            for rs in rl.responses:
+                rec = (self._predicted[0] if self._predicted
+                       else None)
+                if (rec is not None and rec["responses"]
+                        and rs == rec["responses"][0]):
+                    # a partially-predicted burst (some member
+                    # observed instead, so no suppression)
+                    # streams real responses: byte-verify
+                    # against the prediction, skip re-execution
+                    rec["responses"].pop(0)
+                    if not rec["responses"]:
+                        self._predicted.popleft()
+                        if tracing.ACTIVE:
+                            # stream byte-verify drained the
+                            # whole predicted burst
+                            tracing.instant(
+                                "predict_confirm",
+                                how="byte-verify",
+                                names=list(rec["names"]))
+                    continue
+                if rec is not None and set(
+                        rs.tensor_names) & set(rec["names"]):
+                    # shares tensors with the oldest predicted
+                    # burst but differs from its schedule: the
+                    # coordinator released something else
+                    self._on_mispredict(
+                        "released schedule diverged from the "
+                        f"predicted one for {rs.tensor_names}")
+                for n in rs.tensor_names:
+                    self._unsched.discard(n)
+                if self._observe:
+                    # first-occurrence verification: the real
+                    # stream must emit EXACTLY the predicted
+                    # schedule before a bit-set may predict
+                    ob = self._observe[0]
+                    if rs in ob[1]:
+                        ob[2] += 1
+                        if ob[2] == len(ob[1]):
+                            self._verified_bits.add(ob[0])
+                            self._observe.popleft()
+                    else:
+                        ob_names = {n for pr in ob[1]
+                                    for n in pr.tensor_names}
+                        if ob_names.intersection(rs.tensor_names):
+                            # shares tensors but differs: the
+                            # world disagrees — never verify
+                            self._observe.popleft()
+                keep.append(rs)
+            rl.responses = keep
+        self._dispatch_execution(rl, finished)
+        self._next_resp += 1
+        if self.rank != 0 and self._next_resp % 64 == 0:
+            try:
+                self._transport.post_ack(self._next_resp - 1)
+            except Exception:
+                pass
 
     # ---- shared negotiation plumbing ----
     def hint_burst(self, n: int):
@@ -1537,7 +1556,7 @@ class EagerController:
         # frontend-hinted burst gets the longest hold: the hint is
         # declared intent, and the hooks feeding it can be paced by a
         # slow backward under load.
-        deadline = time.monotonic() + (
+        deadline = clock.monotonic() + (
             max(span, 0.25) if hint and expected
             else max(span, 0.05) if expected
             else span)
@@ -1545,7 +1564,7 @@ class EagerController:
             with self._lock:
                 undrained = self._undrained
                 last_t = self._last_enqueue_t
-            now = time.monotonic()
+            now = clock.monotonic()
             # A pending drain (core/preempt.py) must not wait out the
             # burst gate: drain whatever is queued NOW so in-flight
             # collectives finish before the drain commit's grace
@@ -1553,13 +1572,13 @@ class EagerController:
             if expected > 0:
                 if (undrained == 0 or undrained >= expected
                         or now >= deadline or self._stop.is_set()
-                        or preempt.PENDING):
+                        or preempt.pending()):
                     break
             elif (undrained == 0 or now - last_t >= quiesce
                     or now >= deadline or self._stop.is_set()
-                    or preempt.PENDING):
+                    or preempt.pending()):
                 break
-            time.sleep(min(quiesce / 2, max(deadline - now, 1e-4)))
+            clock.sleep(min(quiesce / 2, max(deadline - now, 1e-4)))
 
     def _note_drained(self, drained: int, req: bytes
                       ) -> wire.RequestList:
@@ -1658,7 +1677,7 @@ class EagerController:
         use the streamed loops below instead.  Returns True when the
         cycle carried work (requests drained or responses executed) —
         the loop's idle-backoff signal."""
-        t_cycle0 = time.monotonic()
+        t_cycle0 = clock.monotonic()
         self._gate_burst()
         cycle = self._cycle
         self._cycle += 1
@@ -1683,7 +1702,7 @@ class EagerController:
         if cycle % 256 == 0:
             self._inspect_stalls()
         _M_CYCLES.inc()
-        _M_CYCLE_S.observe(time.monotonic() - t_cycle0)
+        _M_CYCLE_S.observe(clock.monotonic() - t_cycle0)
         return active
 
     def _inspect_stalls(self):
@@ -1724,7 +1743,7 @@ class EagerController:
         """Age-based watchdog for non-coordinator ranks: they cannot see
         which ranks are missing (only rank 0's message table can), but
         they can tell their own op has waited too long."""
-        now = time.monotonic()
+        now = clock.monotonic()
         with self._lock:
             pending = [(p.name, now - p.t_enqueue)
                        for p in self._payloads.values()]
@@ -1830,7 +1849,7 @@ class EagerController:
             prescale=1.0, postscale=1.0, compressor=NoneCompressor,
             splits=splits, kind=kind, process_set=rs.process_set_id,
             psid=rs.process_set_id, root_rank=rs.root_rank,
-            t_enqueue=time.monotonic(),
+            t_enqueue=clock.monotonic(),
         )
 
     def _take_payloads(self, rs: wire.Response,
@@ -1908,7 +1927,7 @@ class EagerController:
                 self._fail_error_response(rs)
                 continue
             payloads = self._take_payloads(rs)
-            now = time.monotonic()
+            now = clock.monotonic()
             for p in payloads:
                 if p.seq != -1:  # not a synthetic zero payload
                     _M_NEGOTIATION_S.observe(now - p.t_enqueue)

@@ -20,9 +20,9 @@ from __future__ import annotations
 
 import os
 import subprocess
-import time
 from typing import Dict, List, Optional
 
+from ..core import clock
 from ..runner import hosts as hosts_mod
 
 
@@ -91,7 +91,7 @@ class HostManager:
         """Record a strike: sideline ``hostname`` for ``base *
         2**(strikes-1)`` seconds (capped) before it is probed again.
         Returns the cooldown applied."""
-        now = time.monotonic() if now is None else now
+        now = clock.monotonic() if now is None else now
         entry = self._blacklist.setdefault(hostname, _BlacklistEntry())
         entry.strikes += 1
         cooldown = min(
@@ -113,7 +113,7 @@ class HostManager:
 
     def blacklisted_now(self, now: Optional[float] = None) -> List[str]:
         """Hosts currently inside a cooldown window."""
-        now = time.monotonic() if now is None else now
+        now = clock.monotonic() if now is None else now
         return sorted(h for h, e in self._blacklist.items()
                       if e.until > now)
 
@@ -125,7 +125,7 @@ class HostManager:
                            ) -> Optional[float]:
         """Seconds until the soonest cooldown expires, or None when no
         host is currently sidelined."""
-        now = time.monotonic() if now is None else now
+        now = clock.monotonic() if now is None else now
         pending = [e.until - now for e in self._blacklist.values()
                    if e.until > now]
         return min(pending) if pending else None

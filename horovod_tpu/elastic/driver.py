@@ -22,7 +22,6 @@ import os
 import signal
 import sys
 import tempfile
-import time
 from typing import Dict, List, Optional
 
 from ..obs import metrics as obs_metrics
@@ -36,6 +35,7 @@ from ..runner.launch import (
     ssh_options_from_args,
     uniform_local_size,
 )
+from ..core import clock
 from ..core.preempt import DRAIN_EXIT_CODE, configured_signal
 from .discovery import HostDiscoveryScript, HostManager
 from .worker import RESET_EXIT_CODE
@@ -165,8 +165,8 @@ class ElasticDriver:
             return False
 
     def _wait_for_min_hosts(self) -> bool:
-        deadline = time.monotonic() + self.elastic_timeout
-        while time.monotonic() < deadline:
+        deadline = clock.monotonic() + self.elastic_timeout
+        while clock.monotonic() < deadline:
             self._refresh_hosts()
             _M_BLACKLISTED.set(len(self.hosts.blacklisted_now()))
             if self.hosts.available_slots() >= self.min_np:
@@ -176,7 +176,7 @@ class ElasticDriver:
                 # soonest re-admission when it fits the deadline,
                 # otherwise fail fast instead of burning the timeout
                 readmit = self.hosts.next_readmission_s()
-                remaining = deadline - time.monotonic()
+                remaining = deadline - clock.monotonic()
                 if readmit is None:
                     pass  # raced with an expiry: re-poll immediately
                 elif readmit >= remaining:
@@ -189,9 +189,9 @@ class ElasticDriver:
                     self._log(
                         "all discovered hosts blacklisted; probing "
                         f"again in {readmit:.0f}s")
-                    time.sleep(min(readmit + 0.05, remaining))
+                    clock.sleep(min(readmit + 0.05, remaining))
                 continue
-            time.sleep(self.interval)
+            clock.sleep(self.interval)
         return False
 
     def _spawn(self, slots: List[hosts_mod.SlotInfo], port: int
@@ -265,7 +265,7 @@ class ElasticDriver:
         _M_BUDGET_LEFT.set(self.max_restarts
                            if self.max_restarts >= 0 else -1)
         while True:
-            t_rdv = time.monotonic()
+            t_rdv = clock.monotonic()
             if not self._wait_for_min_hosts():
                 print(
                     f"hvtpu.elastic: fewer than min_np={self.min_np} "
@@ -287,7 +287,7 @@ class ElasticDriver:
             )
             self.final_world_size = np_now
             workers = self._spawn(slots, port)
-            _M_RENDEZVOUS_S.observe(time.monotonic() - t_rdv)
+            _M_RENDEZVOUS_S.observe(clock.monotonic() - t_rdv)
             _M_WORKERS.set(np_now)
             outcome = self._supervise(workers, slots)
             _M_WORKERS.set(0)
@@ -320,7 +320,7 @@ class ElasticDriver:
     def _restart_budget_ok(self) -> bool:
         """Charge one relaunch against the budget; False (with a
         diagnostic) when it is exhausted."""
-        now = time.monotonic()
+        now = clock.monotonic()
         self._restart_times.append(now)
         if self.restart_window > 0:
             self._restart_times = [
@@ -369,14 +369,14 @@ class ElasticDriver:
         notified = False
         drain_deadline = None
         while True:
-            time.sleep(self.interval)
+            clock.sleep(self.interval)
             # 0. driver-level preemption: forward the drain FIRST and
             # give workers the full drain grace to reach the commit;
             # only then escalate through terminate()'s SIGTERM/SIGKILL
             # — the kill grace can never undercut the drain grace.
             if self._drain_requested and not self._drain_forwarded:
                 self._drain_forwarded = True
-                drain_deadline = time.monotonic() + self.drain_grace
+                drain_deadline = clock.monotonic() + self.drain_grace
                 self._forward_drain(workers)
             # 1. check worker exits
             running, done_ok, reset_req, crashed, drained = \
@@ -403,7 +403,7 @@ class ElasticDriver:
                 # whole-job preemption: wait out the drain, then stop
                 if not running:
                     return "term"
-                if time.monotonic() >= drain_deadline:
+                if clock.monotonic() >= drain_deadline:
                     for w in workers:
                         w.terminate()
                     for w in workers:
@@ -467,11 +467,11 @@ class ElasticDriver:
         _M_BLACKLISTED.set(len(self.hosts.blacklisted_now()))
         # grace period for the rest to exit at a commit boundary
         self._notify_hosts_updated(workers)
-        deadline = time.monotonic() + 30.0
-        while time.monotonic() < deadline:
+        deadline = clock.monotonic() + 30.0
+        while clock.monotonic() < deadline:
             if all(w.poll() is not None for w in workers):
                 break
-            time.sleep(0.2)
+            clock.sleep(0.2)
         for w in workers:
             w.terminate()
         for w in workers:

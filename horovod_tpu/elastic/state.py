@@ -108,7 +108,7 @@ class State:
         # — forcing even a COLLECTIVE durable save is safe here.
         from ..core import preempt as _preempt
 
-        drain_now = _preempt.PENDING \
+        drain_now = _preempt.pending() \
             and _preempt.drain_boundary(self._commit_count)
         durable = self._commit_count % self._durable_every == 0
         if drain_now:

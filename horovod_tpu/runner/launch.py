@@ -591,13 +591,24 @@ def _run(args: argparse.Namespace) -> int:
         return 0
     if args.check_build:
         return _check_build()
-    if args.fault_spec:
+    # Workers inherit a fault spec from either the flag or a
+    # pre-existing HVTPU_FAULT_SPEC in the launcher's environment
+    # (launch_workers forwards both).  Validate every source here,
+    # before any spawn: a malformed clause would otherwise kill each
+    # worker at fault-registry init, which at scale reads as a
+    # mysterious whole-job crash instead of one launcher-side error
+    # naming the bad clause.
+    for origin, spec in (("--fault-spec", args.fault_spec),
+                         ("HVTPU_FAULT_SPEC",
+                          os.environ.get("HVTPU_FAULT_SPEC"))):
+        if not spec:
+            continue
         from ..core.faults import FaultSpecError, parse_spec
 
         try:
-            parse_spec(args.fault_spec)  # fail fast, before any spawn
+            parse_spec(spec)  # fail fast, before any spawn
         except FaultSpecError as e:
-            print(f"hvtpurun: --fault-spec: {e}", file=sys.stderr)
+            print(f"hvtpurun: {origin}: {e}", file=sys.stderr)
             return 2
     if args.host_discovery_script:
         from ..elastic.driver import run_elastic

@@ -1,0 +1,40 @@
+"""Fabric simulator: the real control plane at virtual scale.
+
+One process hosts 256–4096 virtual ranks on a deterministic
+discrete-event kernel (virtual time, no real sleeps); each rank runs
+REAL framework code — eager negotiation over KVTransport, the drain
+coordination protocol, rendezvous audits, heartbeat stall inspection,
+HostManager blacklisting — against an in-memory coordination KV with
+per-link latency/bandwidth/jitter models, under chaos injected through
+``core/faults.py``.  Same seed ⇒ byte-identical event log.
+
+Entry points: ``python -m tools.hvtpusim`` (CLI) and
+:func:`~horovod_tpu.sim.scenarios.run_scenario` (tests).  Architecture
+and the determinism/replay contract: docs/simulation.md.
+"""
+
+from .context import RankContext
+from .fabric import LinkModel, SimFabric
+from .kernel import (DeadlockError, SimKernel, SimTimeBudgetExceeded,
+                     VirtualClock, VirtualExit, WaitToken)
+from .scenarios import SCENARIOS, run_scenario
+from .workers import (SimElasticState, WorldView, elect_and_assign,
+                      patch_data_plane)
+
+__all__ = [
+    "DeadlockError",
+    "LinkModel",
+    "RankContext",
+    "SCENARIOS",
+    "SimElasticState",
+    "SimFabric",
+    "SimKernel",
+    "SimTimeBudgetExceeded",
+    "VirtualClock",
+    "VirtualExit",
+    "WaitToken",
+    "WorldView",
+    "elect_and_assign",
+    "patch_data_plane",
+    "run_scenario",
+]

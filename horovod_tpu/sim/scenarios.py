@@ -1,0 +1,844 @@
+"""Named chaos scenarios: the real control plane at virtual scale.
+
+Each scenario builds a :class:`~.kernel.SimKernel` + \
+:class:`~.fabric.SimFabric`, spawns one task per virtual rank running
+REAL framework code (``State.commit`` / ``_DrainCoordinator`` /
+``core.audit.verify`` / ``AmortizedStallInspector`` /
+``EagerController`` over ``KVTransport``), injects chaos through
+``core/faults.py`` clauses bound to virtual ranks, runs to quiescence,
+asserts the protocol's invariants, and returns
+``{"scenario", "ranks", "seed", "stats", "events"}`` where ``stats``
+carries per-phase virtual-time numbers and ``events`` is the
+deterministic replay log (same seed ⇒ byte-identical).
+
+Catalog (also ``python -m tools.hvtpusim list``):
+
+========================  =============================================
+steady-drain              one rank preempted mid-run (fault action
+                          ``preempt``); full notice → plan → agreed
+                          drain commit → exit-79/DrainInterrupt cycle,
+                          with a per-commit audit allgather as the
+                          lockstep barrier.  Asserts exactly-once
+                          drain-commit accounting on every rank.
+thundering-rendezvous     every rank calls the audit digest-allgather
+                          simultaneously — the post-restart rendezvous
+                          verification storm.  Asserts zero divergence
+                          (or pinpointed divergence for a planted one).
+rolling-preemption        repeated waves: preempt → drain → survivor
+                          re-election (dense rank renumbering over the
+                          KV) → next generation, shrinking the world
+                          each wave.
+kill-blacklist            a rank dies hard (fault action ``kill``);
+                          a virtual driver records the failure in the
+                          REAL HostManager: strike, cooldown exclusion,
+                          then cooldown-expiry readmission on the
+                          virtual clock.
+kv-brownout               a window of injected ``kv.get``/``kv.put``
+                          UNAVAILABLE faults plus dropped heartbeats
+                          under live audits and heartbeat evaluation.
+                          Asserts the retry plane absorbs the brownout:
+                          no false stall failure, all audits complete.
+straggler-tail            lockstep negotiation (manual controllers over
+                          KVTransport) with one rank's link 20× slower;
+                          the cycle-time distribution shows the tail.
+stream-matrix             the streamed (barrier-free) plane with
+                          schedule prediction warmed up, then the
+                          split-burst × mispredict-recovery ×
+                          membership-change (staggered shutdown)
+                          interleavings.  Asserts every future
+                          resolves and post-recovery cycles are clean.
+========================  =============================================
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Dict, Iterator, List, Optional
+
+from .context import RankContext
+from .fabric import SimFabric
+from .kernel import SimKernel, VirtualExit
+from .workers import (SimElasticState, WorldView, elect_and_assign,
+                      patch_data_plane)
+
+__all__ = ["SCENARIOS", "run_scenario"]
+
+_DEF_BUDGET_S = 36000.0  # virtual-time ceiling: livelock tripwire
+
+
+@contextlib.contextmanager
+def _env(**overrides: Optional[str]) -> Iterator[None]:
+    """Scoped os.environ overrides (None deletes)."""
+    saved = {k: os.environ.get(k) for k in overrides}
+    for k, v in overrides.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _fresh(ranks: int, seed: int) -> tuple:
+    from ..core import audit as core_audit
+
+    core_audit.reset_sequences()
+    kernel = SimKernel(seed=seed)
+    fabric = SimFabric(kernel)
+    return kernel, fabric
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
+    return sorted_vals[idx]
+
+
+def _result(name: str, ranks: int, seed: int, kernel: SimKernel,
+            stats: Dict) -> Dict:
+    return {"scenario": name, "ranks": ranks, "seed": seed,
+            "stats": stats, "events": kernel.events}
+
+
+# ---------------------------------------------------------------------------
+# thundering-rendezvous
+# ---------------------------------------------------------------------------
+
+def thundering_rendezvous(ranks: int, seed: int = 0, *,
+                          diverge_rank: Optional[int] = None) -> Dict:
+    """Every rank runs the REAL audit digest-allgather at once — the
+    restart-rendezvous verification storm.  ``diverge_rank`` plants one
+    divergent payload and asserts the audit names exactly that rank."""
+    from ..core import audit as core_audit
+
+    kernel, fabric = _fresh(ranks, seed)
+    done_t: Dict[int, float] = {}
+    reports: Dict[int, dict] = {}
+
+    def make(rank: int):
+        def body():
+            world = WorldView(rank, ranks, 0)
+            client = fabric.client(rank, caps="str")
+            value = 41.0 if rank == diverge_rank else 7.0
+            tree = {"epoch": 3, "w": [value, float(ranks)]}
+            reports[rank] = core_audit.verify(
+                tree, label="rendezvous", action="warn",
+                timeout_s=600.0, client=client, world=world)
+            done_t[rank] = kernel.now
+            kernel.log("rendezvous_done", rank=rank)
+        return body
+
+    for r in range(ranks):
+        kernel.spawn(f"rank{r}", make(r))
+    kernel.run(max_virtual_s=_DEF_BUDGET_S)
+
+    assert len(done_t) == ranks, "some ranks never finished rendezvous"
+    for rank, report in reports.items():
+        if diverge_rank is None:
+            assert not report["divergent"], (
+                f"false divergence on rank {rank}: {report}")
+        else:
+            assert report["ranks"] == [diverge_rank], (
+                f"rank {rank} blamed {report['ranks']}, "
+                f"expected [{diverge_rank}]")
+    times = sorted(done_t.values())
+    stats = {"phases": {"rendezvous": {
+        "virtual_s": times[-1],
+        "p50_s": _pct(times, 0.50),
+        "p99_s": _pct(times, 0.99),
+    }}, "kv_ops": dict(fabric.ops)}
+    return _result("thundering-rendezvous", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
+# steady-drain
+# ---------------------------------------------------------------------------
+
+def steady_drain(ranks: int, seed: int = 0, *, steps: int = 6,
+                 depart_rank: Optional[int] = None,
+                 grace_s: float = 60.0, compute_s: float = 0.05,
+                 durable_every: int = 3) -> Dict:
+    """One rank preempted (fault action ``preempt`` at the
+    ``worker.step`` site): the full drain protocol over the simulated
+    KV, with the per-commit audit allgather acting as the lockstep
+    barrier real training gets from its collectives."""
+    from ..core.exceptions import DrainInterrupt
+    from ..core.preempt import DRAIN_EXIT_CODE
+    from ..core import retry as core_retry
+
+    if depart_rank is None:
+        depart_rank = max(1, ranks // 3)
+    spec = f"worker.step:preempt@rank={depart_rank},times=1"
+    kernel, fabric = _fresh(ranks, seed)
+    records: Dict[int, dict] = {}
+    notice_t: List[float] = []
+    drain_t: List[float] = []
+
+    def make(rank: int):
+        def body():
+            client = fabric.client(rank, caps="dir")
+            kv = core_retry.resilient_kv(client, rank=rank)
+            ctx = RankContext(
+                kernel, rank, ranks, fault_spec=spec, generation=0,
+                drain_client=kv, drain_grace_s=grace_s, with_drain=True)
+            state = SimElasticState(
+                client=client, world=WorldView(rank, ranks, 0), step=0)
+            state.set_commit_policy(durable_every)
+            rec = records[rank] = {"outcome": "finished"}
+            with ctx.activate():
+                try:
+                    for _ in range(steps):
+                        ctx.check_exit()
+                        ctx.coordinator._poll_once()
+                        kernel.sleep(compute_s)
+                        state.step += 1
+                        state.commit()
+                except DrainInterrupt as e:
+                    rec["outcome"] = "drain_interrupt"
+                    rec["peer"] = e.rank
+                    drain_t.append(kernel.now)
+                    kernel.log("drain_interrupt", rank=rank,
+                               commit=state._commit_count)
+                except VirtualExit:
+                    rec["outcome"] = "virtual_exit"
+                    drain_t.append(kernel.now)
+                    kernel.log("drain_exit", rank=rank,
+                               commit=state._commit_count)
+                    raise
+                finally:
+                    rec["commits"] = state._commit_count
+                    rec["durable"] = state.durable_commits
+                    if rank == depart_rank and ctx.coordinator._notice_t:
+                        notice_t.append(ctx.coordinator._notice_t)
+        return body
+
+    with _env(HVTPU_AUDIT_EVERY="1", HVTPU_AUDIT_ACTION="abort",
+              HVTPU_ELASTIC_STATE_DIR=None):
+        tasks = {r: kernel.spawn(f"rank{r}", make(r))
+                 for r in range(ranks)}
+        kernel.run(max_virtual_s=_DEF_BUDGET_S)
+
+    departed = tasks[depart_rank]
+    assert departed.exit_code == DRAIN_EXIT_CODE, (
+        f"departing rank exited {departed.exit_code}, "
+        f"expected {DRAIN_EXIT_CODE}")
+    survivor_commits = {records[r]["commits"]
+                        for r in range(ranks) if r != depart_rank}
+    assert len(survivor_commits) == 1, (
+        f"survivors drained at different commits: {survivor_commits}")
+    drain_commit = survivor_commits.pop()
+    assert records[depart_rank]["commits"] == drain_commit, (
+        "departing rank's drain commit disagrees with the survivors'")
+    # exactly-once durable accounting: every rank wrote the periodic
+    # durable commits PLUS the promoted drain commit, exactly once
+    expected_durable = sum(
+        1 for c in range(1, drain_commit + 1)
+        if c % durable_every == 0 or c == drain_commit)
+    for r in range(ranks):
+        assert records[r]["durable"] == expected_durable, (
+            f"rank {r} wrote {records[r]['durable']} durable commits, "
+            f"expected {expected_durable}")
+        assert records[r]["outcome"] == (
+            "virtual_exit" if r == depart_rank else "drain_interrupt")
+    latency = (max(drain_t) - notice_t[0]) if notice_t and drain_t else 0.0
+    stats = {"phases": {
+        "steady": {"virtual_s": round(notice_t[0], 6) if notice_t else 0.0},
+        "drain": {
+            "drain_commit": drain_commit,
+            "notice_to_commit_s": round(latency, 6),
+            "grace_s": grace_s,
+            "virtual_s": round(max(drain_t) if drain_t else 0.0, 6),
+        }}, "kv_ops": dict(fabric.ops)}
+    return _result("steady-drain", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
+# rolling-preemption
+# ---------------------------------------------------------------------------
+
+def rolling_preemption(ranks: int, seed: int = 0, *, waves: int = 2,
+                       steps_per_gen: int = 4, grace_s: float = 60.0,
+                       compute_s: float = 0.02) -> Dict:
+    """``waves`` preemption waves: each generation one rank is
+    preempted at its first commit, the world drains, survivors
+    re-elect dense ranks over the KV, and the next generation resumes
+    from the drain commit — the restart-based elastic resize at
+    protocol level."""
+    from ..core.exceptions import DrainInterrupt
+    from ..core.preempt import DRAIN_EXIT_CODE
+    from ..core import retry as core_retry
+
+    kernel, fabric = _fresh(ranks, seed)
+    # deterministic victim schedule over PHYSICAL ids (never phys 0 —
+    # keeping one stable observer makes the log easier to read)
+    pool = list(range(1, ranks))
+    rng = kernel.rng("victims")
+    victims = [pool.pop(rng.randrange(len(pool))) for _ in range(waves)]
+    records: Dict[int, dict] = {}
+    wave_stats: List[dict] = []
+    gen_members: Dict[int, set] = {0: set(range(ranks))}
+
+    def make(phys: int):
+        def body():
+            rec = records[phys] = {"gens": 0, "final_rank": phys,
+                                   "resumed_step": 0}
+            rank, size = phys, ranks
+            step_base = 0
+            for gen in range(waves + 1):
+                victim_here = gen < waves and phys == victims[gen]
+                spec = (f"worker.step:preempt@rank={rank},times=1"
+                        if victim_here else "")
+                client = fabric.client(phys, caps="dir")
+                kv = core_retry.resilient_kv(client, rank=rank)
+                ctx = RankContext(
+                    kernel, rank, size, fault_spec=spec, generation=gen,
+                    drain_client=kv, drain_grace_s=grace_s,
+                    with_drain=True)
+                state = SimElasticState(
+                    client=client, world=WorldView(rank, size, gen),
+                    step=step_base)
+                state.set_commit_policy(2)
+                drained_peer = None
+                with ctx.activate():
+                    try:
+                        for _ in range(steps_per_gen):
+                            ctx.check_exit()
+                            ctx.coordinator._poll_once()
+                            kernel.sleep(compute_s)
+                            state.step += 1
+                            state.commit()
+                    except DrainInterrupt as e:
+                        drained_peer = e.rank
+                    except VirtualExit:
+                        kernel.log("departed", gen=gen, phys=phys,
+                                   rank=rank,
+                                   commit=state._commit_count)
+                        raise
+                rec["gens"] = gen + 1
+                step_base = state._saved["step"]
+                rec["resumed_step"] = step_base
+                if drained_peer is None:
+                    # final generation ran to completion
+                    rec["final_rank"] = rank
+                    continue
+                kernel.log("drain_observed", gen=gen, phys=phys,
+                           rank=rank, peer=drained_peer,
+                           commit=state._commit_count)
+                survivors = [r for r in range(size) if r != drained_peer]
+                assignment = elect_and_assign(
+                    kv, rank, survivors, generation=gen + 1)
+                rank = assignment[rank]
+                size = len(survivors)
+                rec["final_rank"] = rank
+        return body
+
+    with _env(HVTPU_AUDIT_EVERY="1", HVTPU_AUDIT_ACTION="abort",
+              HVTPU_ELASTIC_STATE_DIR=None):
+        tasks = {p: kernel.spawn(f"phys{p}", make(p))
+                 for p in range(ranks)}
+        kernel.run(max_virtual_s=_DEF_BUDGET_S)
+
+    for w, victim in enumerate(victims):
+        assert tasks[victim].exit_code == DRAIN_EXIT_CODE, (
+            f"wave-{w} victim phys{victim} exited "
+            f"{tasks[victim].exit_code}, expected {DRAIN_EXIT_CODE}")
+    survivors_phys = [p for p in range(ranks) if p not in victims]
+    final_size = ranks - waves
+    final_ranks = sorted(records[p]["final_rank"] for p in survivors_phys)
+    assert final_ranks == list(range(final_size)), (
+        f"survivor renumbering not dense: {final_ranks}")
+    for p in survivors_phys:
+        assert records[p]["gens"] == waves + 1, (
+            f"phys{p} completed {records[p]['gens']} generations, "
+            f"expected {waves + 1}")
+    resumed = {records[p]["resumed_step"] for p in survivors_phys}
+    assert len(resumed) == 1, (
+        f"survivors resumed from different steps: {resumed}")
+    stats = {"phases": {
+        "waves": {"count": waves, "victims_phys": victims},
+        "final": {"world_size": final_size,
+                  "virtual_s": round(kernel.now, 6),
+                  "resumed_step": resumed.pop()},
+    }, "kv_ops": dict(fabric.ops)}
+    return _result("rolling-preemption", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
+# kill-blacklist
+# ---------------------------------------------------------------------------
+
+class _StaticDiscovery:
+    """Discovery stub for the virtual driver: a fixed host->slots map
+    (the HostManager under test is real; only the shell-out is fake)."""
+
+    def __init__(self, hosts: Dict[str, int]):
+        self.hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self.hosts)
+
+
+def kill_blacklist(ranks: int, seed: int = 0, *, steps: int = 6,
+                   kill_rank: Optional[int] = None,
+                   slots_per_host: int = 8,
+                   cooldown_s: float = 30.0,
+                   compute_s: float = 0.02) -> Dict:
+    """A rank dies hard (``kill`` fault): a virtual driver task feeds
+    the failure to the REAL HostManager and asserts strike → cooldown
+    exclusion → cooldown-expiry readmission, all on the virtual
+    clock."""
+    from ..elastic.discovery import HostManager
+
+    if kill_rank is None:
+        kill_rank = max(1, ranks // 2)
+    spec = f"worker.step:kill@rank={kill_rank},times=1"
+    kernel, fabric = _fresh(ranks, seed)
+    hosts = {f"host{h}": slots_per_host
+             for h in range((ranks + slots_per_host - 1)
+                            // slots_per_host)}
+    kill_host = f"host{kill_rank // slots_per_host}"
+    records: Dict[int, dict] = {}
+    driver_log: List[dict] = []
+
+    def make(rank: int):
+        def body():
+            client = fabric.client(rank, caps="dir")
+            ctx = RankContext(kernel, rank, ranks, fault_spec=spec,
+                              generation=0)
+            state = SimElasticState(
+                client=client, world=WorldView(rank, ranks, 0), step=0)
+            rec = records[rank] = {}
+            with ctx.activate():
+                try:
+                    for _ in range(steps):
+                        ctx.check_exit()
+                        kernel.sleep(compute_s)
+                        state.step += 1
+                        state.commit()
+                finally:
+                    rec["commits"] = state._commit_count
+                    rec["durable"] = state.durable_commits
+        return body
+
+    def driver():
+        hm = HostManager(_StaticDiscovery(hosts),
+                         cooldown_base_s=cooldown_s,
+                         cooldown_max_s=8 * cooldown_s)
+        hm.refresh()
+        full_slots = hm.available_slots()
+        # wait for the kill
+        while not (tasks[kill_rank].done
+                   and tasks[kill_rank].exit_code == 1):
+            kernel.sleep(0.5)
+        cooldown = hm.blacklist_host(kill_host)
+        hm.refresh()
+        driver_log.append({
+            "t": kernel.now, "event": "blacklisted",
+            "host": kill_host, "cooldown_s": cooldown,
+            "strikes": hm.strikes(kill_host),
+            "slots": hm.available_slots(),
+        })
+        assert kill_host in hm.blacklisted_now()
+        assert hm.available_slots() == full_slots - hosts[kill_host]
+        if len(hosts) > 1:
+            assert not hm.exhausted(min_np=1)
+        # cooldown expiry on the virtual clock: the host is probed and
+        # readmitted
+        wait = hm.next_readmission_s()
+        assert wait is not None and wait <= cooldown
+        kernel.sleep(wait + 0.001)
+        changed = hm.refresh()
+        driver_log.append({
+            "t": kernel.now, "event": "readmitted", "host": kill_host,
+            "changed": changed, "slots": hm.available_slots(),
+        })
+        assert changed and hm.available_slots() == full_slots
+        assert hm.blacklisted_now() == []
+        assert hm.strikes(kill_host) == 1  # strike persists past cooldown
+        hm.record_success(kill_host)
+        assert hm.strikes(kill_host) == 0
+        kernel.log("driver_done", blacklist_events=len(driver_log))
+
+    with _env(HVTPU_AUDIT_EVERY="0", HVTPU_ELASTIC_STATE_DIR=None):
+        tasks = {r: kernel.spawn(f"rank{r}", make(r))
+                 for r in range(ranks)}
+        kernel.spawn("driver", driver)
+        kernel.run(max_virtual_s=_DEF_BUDGET_S)
+
+    assert tasks[kill_rank].exit_code == 1, (
+        f"killed rank exited {tasks[kill_rank].exit_code}, expected 1")
+    for r in range(ranks):
+        if r == kill_rank:
+            continue
+        assert records[r]["commits"] == steps, (
+            f"survivor rank {r} committed {records[r]['commits']}, "
+            f"expected {steps}")
+        assert records[r]["durable"] == steps  # default policy: every commit
+    stats = {"phases": {
+        "kill": {"rank": kill_rank, "host": kill_host},
+        "blacklist": driver_log[0] if driver_log else {},
+        "readmission": driver_log[1] if len(driver_log) > 1 else {},
+    }, "kv_ops": dict(fabric.ops)}
+    return _result("kill-blacklist", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
+# kv-brownout
+# ---------------------------------------------------------------------------
+
+def kv_brownout(ranks: int, seed: int = 0, *, steps: int = 5,
+                error_prob: float = 0.25, error_budget: int = 60,
+                heartbeat_s: float = 0.5,
+                compute_s: float = 0.1) -> Dict:
+    """A coordination-service brownout: every rank's ``kv.get`` /
+    ``kv.put`` ops fail with UNAVAILABLE at ``error_prob`` for a
+    bounded budget, and heartbeats are dropped too — while audits and
+    the heartbeat stall inspector keep running.  Asserts the retry
+    plane absorbs it: every audit completes, no rank latches a stall
+    failure, and retries actually happened."""
+    from ..comm.stall import AmortizedStallInspector
+    from ..core import audit as core_audit
+    from ..core import retry as core_retry
+    from ..obs import metrics as obs_metrics
+
+    spec = (f"kv.put:error@prob={error_prob},times={error_budget};"
+            f"kv.get:error@prob={error_prob},times={error_budget};"
+            f"heartbeat:drop@prob=0.3,times={error_budget}")
+    kernel, fabric = _fresh(ranks, seed)
+    # The invariant under test is "the retry plane absorbs the
+    # brownout", not "4 attempts always suffice": at prob=0.25 a
+    # single op exhausts the default 4-attempt budget with p~0.4%,
+    # which at 256 ranks x ~1e5 KV ops is a near-certainty.  Give the
+    # policy enough headroom that exhaustion probability is
+    # negligible (0.25^16 ~ 2e-10 per op) so the assertion holds at
+    # every world size.
+    retry_env = _env(HVTPU_KV_RETRY_ATTEMPTS="16",
+                     HVTPU_KV_RETRY_DEADLINE_S="600")
+    inspectors: Dict[int, AmortizedStallInspector] = {}
+    audits_done: Dict[int, int] = {}
+    retries_before = obs_metrics.counter("hvtpu_kv_retries_total").value()
+
+    def make(rank: int):
+        def body():
+            client = fabric.client(rank, caps="dir")
+            kv = core_retry.resilient_kv(client, rank=rank)
+            ctx = RankContext(kernel, rank, ranks, fault_spec=spec,
+                              generation=0)
+            insp = AmortizedStallInspector(
+                kv, rank, warn_s=60.0, abort_s=600.0,
+                heartbeat_s=heartbeat_s, generation=0,
+                start_heartbeat=False)
+            inspectors[rank] = insp
+            world = WorldView(rank, ranks, 0)
+            audits_done[rank] = 0
+            with ctx.activate():
+                for step in range(steps):
+                    desc = insp.pre_op(0, range(ranks), f"step{step}")
+                    # virtual compute, heartbeats pumped on cadence
+                    beats = max(1, int(compute_s / heartbeat_s))
+                    for _ in range(beats):
+                        kernel.sleep(compute_s / beats)
+                        insp._beat_once()
+                    insp._clear_inflight(0)
+                    report = core_audit.verify(
+                        {"step": step, "w": [1.0, 2.0]},
+                        label="brownout", action="abort",
+                        timeout_s=1200.0, client=client, world=world)
+                    assert not report["divergent"]
+                    audits_done[rank] += 1
+                insp.stop()
+                kernel.log("brownout.rank_done", rank=rank,
+                           audits=audits_done[rank],
+                           t=round(kernel.now, 9))
+        return body
+
+    with retry_env:
+        for r in range(ranks):
+            kernel.spawn(f"rank{r}", make(r))
+        kernel.run(max_virtual_s=_DEF_BUDGET_S)
+
+    for rank, insp in inspectors.items():
+        assert insp.failure is None, (
+            f"rank {rank} latched a false stall failure during the "
+            f"brownout: {insp.failure}")
+        assert audits_done[rank] == steps
+    retries = (obs_metrics.counter("hvtpu_kv_retries_total").value()
+               - retries_before)
+    assert retries > 0, "brownout injected no retried KV op"
+    stats = {"phases": {"brownout": {
+        "virtual_s": round(kernel.now, 6),
+        "kv_retries": retries,
+        "audits": steps * ranks,
+    }}, "kv_ops": dict(fabric.ops)}
+    return _result("kv-brownout", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
+# straggler-tail / lockstep negotiation bench
+# ---------------------------------------------------------------------------
+
+def _lockstep_world(kernel: SimKernel, fabric: SimFabric, ranks: int,
+                    cycles: int, cycle_times: Dict[int, List[float]],
+                    fault_spec: str = ""):
+    """Task bodies for a manual lockstep EagerController world over
+    the simulated KVTransport; every rank enqueues one allreduce per
+    cycle and drives run_cycle_once (a real all-rank barrier)."""
+    from ..eager.controller import EagerController, KVTransport
+
+    def make(rank: int):
+        def body():
+            ctx = RankContext(kernel, rank, ranks, fault_spec=fault_spec,
+                              generation=0)
+            transport = KVTransport(
+                rank, ranks, client=fabric.client(rank, caps="bytes"),
+                timeout_s=600.0, poll_s=1.0)
+            ctrl = EagerController(rank, ranks, transport=transport,
+                                   cycle_time_ms=1.0, manual=True)
+            times = cycle_times.setdefault(rank, [])
+            with ctx.activate():
+                for cycle in range(cycles):
+                    t0 = kernel.now
+                    fut = ctrl.enqueue(
+                        "allreduce", [1.0, float(rank)],
+                        name=f"grad.{cycle}")
+                    ctrl.run_cycle_once()
+                    assert fut.done(), (
+                        f"rank {rank} cycle {cycle}: future unresolved "
+                        "after the lockstep cycle")
+                    fut.result(timeout=0)
+                    times.append(kernel.now - t0)
+                ctrl.request_shutdown()
+                while not ctrl._shutdown_seen.is_set():
+                    ctrl.run_cycle_once()
+                ctrl.stop()
+        return body
+
+    return make
+
+
+def straggler_tail(ranks: int, seed: int = 0, *, cycles: int = 8,
+                   straggler: Optional[int] = None,
+                   slowdown: float = 20.0) -> Dict:
+    """Lockstep negotiation with one rank's KV link ``slowdown``×
+    slower: the per-cycle barrier makes every rank pay the straggler's
+    latency — the distribution's tail IS the diagnosis."""
+    kernel, fabric = _fresh(ranks, seed)
+    if straggler is None:
+        straggler = max(1, ranks - 1)
+    base = fabric.link(straggler)
+    fabric.set_link(straggler,
+                    latency_s=base.latency_s * slowdown,
+                    bandwidth_bps=base.bandwidth_bps / slowdown)
+    cycle_times: Dict[int, List[float]] = {}
+    make = _lockstep_world(kernel, fabric, ranks, cycles, cycle_times)
+    with patch_data_plane(), _env(HVTPU_EAGER_STREAM=None):
+        for r in range(ranks):
+            kernel.spawn(f"rank{r}", make(r))
+        kernel.run(max_virtual_s=_DEF_BUDGET_S)
+
+    all_times = sorted(t for ts in cycle_times.values() for t in ts)
+    stats = {"phases": {"negotiate": {
+        "cycles": cycles,
+        "straggler_rank": straggler,
+        "slowdown": slowdown,
+        "cycle_p50_s": round(_pct(all_times, 0.50), 9),
+        "cycle_p99_s": round(_pct(all_times, 0.99), 9),
+        "cycle_max_s": round(all_times[-1], 9) if all_times else 0.0,
+        "virtual_s": round(kernel.now, 6),
+    }}, "kv_ops": dict(fabric.ops)}
+    return _result("straggler-tail", ranks, seed, kernel, stats)
+
+
+def bench_negotiation(ranks: int, seed: int = 0, *, cycles: int = 6,
+                      warmup: int = 2) -> Dict:
+    """Healthy-network lockstep negotiation: the measured
+    negotiation-cycle time vs world size (BENCH_SCALING rows)."""
+    kernel, fabric = _fresh(ranks, seed)
+    cycle_times: Dict[int, List[float]] = {}
+    make = _lockstep_world(kernel, fabric, ranks, warmup + cycles,
+                           cycle_times)
+    with patch_data_plane(), _env(HVTPU_EAGER_STREAM=None):
+        for r in range(ranks):
+            kernel.spawn(f"rank{r}", make(r))
+        kernel.run(max_virtual_s=_DEF_BUDGET_S)
+    steady = sorted(t for ts in cycle_times.values()
+                    for t in ts[warmup:])
+    stats = {"phases": {"negotiate": {
+        "cycles": cycles,
+        "cycle_p50_s": round(_pct(steady, 0.50), 9),
+        "cycle_mean_s": round(sum(steady) / max(1, len(steady)), 9),
+        "cycle_max_s": round(steady[-1], 9) if steady else 0.0,
+        "virtual_s": round(kernel.now, 6),
+    }}, "kv_ops": dict(fabric.ops)}
+    return _result("bench-negotiation", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
+# stream-matrix: streamed plane under split-burst / mispredict /
+# membership-change interleavings
+# ---------------------------------------------------------------------------
+
+def stream_matrix(ranks: int, seed: int = 0, *, burst: int = 2,
+                  warmup_steps: int = 4, post_steps: int = 2) -> Dict:
+    """The streamed (barrier-free) control plane with schedule
+    prediction warmed up, then the interleavings that historically
+    break prediction-class protocols:
+
+    - **split-burst**: one rank drains its burst in two halves (a
+      virtual-time gap wider than the gate deadline) — atomic burst
+      units must HOLD the release, never diverge it;
+    - **mispredict-recovery**: a rank is forced through
+      ``_on_mispredict`` mid-stream — the resync re-anchor must
+      converge and subsequent cycles run clean;
+    - **membership-change**: every rank announces shutdown at a
+      different virtual time — agreement must still be reached with
+      request blobs carrying flags mid-flight.
+
+    Asserts every enqueued future resolves, the predictor actually
+    engaged during warmup, and all ranks observe shutdown agreement.
+    """
+    from ..eager.controller import EagerController, KVTransport
+    from ..obs import metrics as obs_metrics
+
+    kernel, fabric = _fresh(ranks, seed)
+    split_rank = max(1, ranks // 3)
+    mispredict_rank = max(1, (2 * ranks) // 3)
+    predicted_before = obs_metrics.counter(
+        "hvtpu_controller_predicted_cycles_total").value()
+    steps_total = warmup_steps + 2 + post_steps
+    resolved: Dict[int, int] = {}
+    shutdown_seen: Dict[int, bool] = {}
+
+    def pump(ctrl, rank: int) -> bool:
+        """One round of the real drainer/servicer/fetcher work, inline
+        on this rank's task (mirrors _drain_loop/_fetch_loop without
+        their threads)."""
+        active = False
+        if ctrl._undrained or ctrl._post_needed:
+            active = ctrl._drain_once() or active
+        if rank == 0:
+            active = ctrl._service_once() or active
+            while ctrl._local_resp:
+                ctrl._fetch_once(wait_s=0)
+                active = True
+        else:
+            active = ctrl._fetch_once(wait_s=0) or active
+        return active
+
+    def make(rank: int):
+        def body():
+            transport = KVTransport(
+                rank, ranks, client=fabric.client(rank, caps="bytes"),
+                timeout_s=600.0, poll_s=0.02)
+            ctrl = EagerController(rank, ranks, transport=transport,
+                                   cycle_time_ms=1.0, manual=True)
+            ctrl._stream = True  # streamed plane, scenario-pumped
+            resolved[rank] = 0
+            # training-loop shape: the SAME named collectives re-issued
+            # every step (what lets the bit-set verify and the
+            # predictor engage)
+            names = [f"g{i}" for i in range(burst)]
+            for step in range(steps_total):
+                if step == warmup_steps and rank == split_rank:
+                    # split-burst: half now, half after a gap wider
+                    # than the steady-state gate deadline
+                    half = burst // 2 or 1
+                    futs = [ctrl.enqueue("allreduce", [1.0], name=n)
+                            for n in names[:half]]
+                    deadline = kernel.now + 0.3
+                    while kernel.now < deadline:
+                        if not pump(ctrl, rank):
+                            kernel.sleep(0.01)
+                    futs += [ctrl.enqueue("allreduce", [1.0], name=n)
+                             for n in names[half:]]
+                else:
+                    futs = [ctrl.enqueue("allreduce", [1.0], name=n)
+                            for n in names]
+                if (step == warmup_steps + 1
+                        and rank == mispredict_rank):
+                    with ctrl._lock:
+                        ctrl._on_mispredict("sim-forced divergence")
+                while not all(f.done() for f in futs):
+                    if not pump(ctrl, rank):
+                        kernel.sleep(0.005)
+                for f in futs:
+                    f.result(timeout=0)
+                resolved[rank] += len(futs)
+                kernel.log("step_done", rank=rank, step=step)
+            # membership-change: staggered shutdown announcements
+            kernel.sleep(0.001 * rank)
+            ctrl.request_shutdown()
+            while not ctrl._shutdown_seen.is_set():
+                if not pump(ctrl, rank):
+                    kernel.sleep(0.005)
+            shutdown_seen[rank] = True
+            # drain any post-agreement confirmations so quiesce needn't
+            # spin; leftovers roll back inside quiesce (its contract)
+            tail = kernel.now + 1.0
+            while ctrl._predicted and kernel.now < tail:
+                if not pump(ctrl, rank):
+                    kernel.sleep(0.005)
+            quiesced = ctrl.quiesce(timeout=10.0)
+            assert quiesced, f"rank {rank} did not quiesce post-shutdown"
+            ctrl.stop()
+        return body
+
+    with patch_data_plane(), _env(HVTPU_EAGER_PREDICT="auto",
+                                  HVTPU_EAGER_BURST_CAP="1"):
+        for r in range(ranks):
+            kernel.spawn(f"rank{r}", make(r))
+        kernel.run(max_virtual_s=_DEF_BUDGET_S)
+
+    for r in range(ranks):
+        assert resolved[r] == steps_total * burst, (
+            f"rank {r} resolved {resolved[r]} futures, expected "
+            f"{steps_total * burst}")
+        assert shutdown_seen.get(r), f"rank {r} missed shutdown agreement"
+    predicted = (obs_metrics.counter(
+        "hvtpu_controller_predicted_cycles_total").value()
+                 - predicted_before)
+    assert predicted > 0, (
+        "the schedule predictor never engaged — the warmup phase is "
+        "not exercising the fast path")
+    stats = {"phases": {
+        "warmup": {"steps": warmup_steps,
+                   "predicted_bursts": predicted},
+        "perturb": {"split_rank": split_rank,
+                    "mispredict_rank": mispredict_rank},
+        "shutdown": {"virtual_s": round(kernel.now, 6)},
+    }, "kv_ops": dict(fabric.ops)}
+    return _result("stream-matrix", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+SCENARIOS = {
+    "thundering-rendezvous": thundering_rendezvous,
+    "steady-drain": steady_drain,
+    "rolling-preemption": rolling_preemption,
+    "kill-blacklist": kill_blacklist,
+    "kv-brownout": kv_brownout,
+    "straggler-tail": straggler_tail,
+    "stream-matrix": stream_matrix,
+}
+
+
+def run_scenario(name: str, ranks: int, seed: int = 0, **kwargs) -> Dict:
+    """Run one named scenario; raises KeyError with the catalog on an
+    unknown name."""
+    fn = SCENARIOS.get(name)
+    if fn is None:
+        raise KeyError(
+            f"unknown scenario {name!r}; available: "
+            f"{', '.join(sorted(SCENARIOS))}")
+    return fn(ranks, seed, **kwargs)

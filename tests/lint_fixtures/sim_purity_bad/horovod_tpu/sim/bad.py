@@ -1,0 +1,19 @@
+"""Every way simulator code can leak host time or ambient RNG."""
+
+import random
+import time
+from random import randint
+from time import sleep as zzz
+
+
+def naughty():
+    t0 = time.time()          # host wall clock
+    t1 = time.monotonic()     # host monotonic clock
+    time.sleep(0.1)           # real sleep
+    time.sleep(0.2)           # second hit: occurrence-indexed key
+    zzz(0.3)                  # from-import alias of time.sleep
+    x = random.random()       # process-global RNG
+    random.seed(42)           # reseeding the global RNG
+    y = randint(0, 9)         # from-import of a module-level fn
+    ok = random.Random(7).random()  # allowed: seeded instance
+    return t0 + t1 + x + y + ok

@@ -1,0 +1,12 @@
+"""Clean simulator module: virtual clock + seeded RNG streams only."""
+
+import random
+
+from horovod_tpu.core import clock
+
+
+def wait_and_draw(kernel, seed):
+    kernel.sleep(0.5)           # virtual sleep: fine
+    now = clock.monotonic()     # seam read: fine
+    rng = random.Random(seed)   # seeded generator instance: allowed
+    return now + rng.random()

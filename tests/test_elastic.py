@@ -375,3 +375,99 @@ class TestRestartBudget:
         d._restart_times = [t - 2000.0 for t in d._restart_times]
         assert d._restart_budget_ok() is True
         assert d._restart_budget_ok() is False
+
+
+class TestClockSeam:
+    """Every blacklist/budget timing decision must route through the
+    core/clock seam (not time.monotonic directly) so the fabric
+    simulator can run the driver's control plane on virtual time.
+    These tests install a fake clock on the test thread and advance it
+    discretely — no real sleeps, no ``now=`` test-only overrides."""
+
+    class _FakeClock:
+        def __init__(self, t=0.0):
+            self.t = t
+
+        def monotonic(self):
+            return self.t
+
+        def wall(self):
+            return self.t
+
+        def sleep(self, seconds):
+            self.t += seconds
+
+        def call_later(self, delay_s, fn):  # pragma: no cover
+            raise AssertionError("no timers expected in these paths")
+
+    @pytest.fixture
+    def fake_clock(self):
+        from horovod_tpu.core import clock as core_clock
+
+        fc = self._FakeClock()
+        core_clock.install(fc)
+        try:
+            yield fc
+        finally:
+            core_clock.install(None)
+
+    def _mgr(self, tmp_path, base=10.0):
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho a:2\necho b:2\n")
+        script.chmod(0o755)
+        return HostManager(HostDiscoveryScript(str(script)),
+                           cooldown_base_s=base)
+
+    def test_cooldown_and_strike_decay_on_injected_clock(
+            self, tmp_path, fake_clock):
+        mgr = self._mgr(tmp_path, base=10.0)
+        mgr.refresh()
+        fake_clock.t = 100.0
+        assert mgr.blacklist_host("a") == 10.0  # reads seam clock
+        assert mgr.blacklisted_now() == ["a"]
+        assert mgr.next_readmission_s() == pytest.approx(10.0)
+        fake_clock.t = 105.0
+        assert mgr.blacklisted_now() == ["a"]  # mid-cooldown
+        assert mgr.next_readmission_s() == pytest.approx(5.0)
+        assert mgr.refresh() is True  # cooling host drops out of the set
+        fake_clock.t = 110.5
+        assert mgr.blacklisted_now() == []  # cooldown expired
+        assert mgr.refresh() is True  # host readmitted
+        # strike survives readmission; decay is success-driven
+        assert mgr.strikes("a") == 1
+        mgr.record_success("a")
+        assert mgr.strikes("a") == 0
+        # a second strike after decay starts over at the base cooldown
+        assert mgr.blacklist_host("a") == 10.0
+
+    def test_exhausted_reads_injected_clock(self, tmp_path, fake_clock):
+        mgr = self._mgr(tmp_path, base=10.0)
+        mgr.refresh()
+        fake_clock.t = 50.0
+        mgr.blacklist_host("a")
+        mgr.blacklist_host("b")
+        assert mgr.exhausted(min_np=1) is True
+        fake_clock.t = 60.5  # both cooldowns expired on the seam clock
+        assert mgr.exhausted(min_np=1) is False
+
+    def test_restart_budget_window_on_injected_clock(
+            self, tmp_path, fake_clock, capsys):
+        from horovod_tpu.elastic.driver import ElasticDriver
+
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho localhost:2\n")
+        script.chmod(0o755)
+        d = ElasticDriver(
+            command=["true"],
+            discovery=HostDiscoveryScript(str(script)),
+            min_np=2, state_dir=str(tmp_path),
+            max_restarts=1, restart_window=60.0)
+        fake_clock.t = 0.0
+        assert d._restart_budget_ok() is True
+        # the seam clock ages the first relaunch out of the window —
+        # the budget refills with no mutation of driver internals
+        fake_clock.t = 120.0
+        assert d._restart_budget_ok() is True
+        fake_clock.t = 121.0  # two relaunches inside one window: trip
+        assert d._restart_budget_ok() is False
+        assert "restart budget exhausted" in capsys.readouterr().err

@@ -17,7 +17,8 @@ import pytest
 
 from tools.hvtpulint import (Project, load_suppressions, run_passes)
 from tools.hvtpulint import (knob_registry, metrics_catalog,
-                             rank_divergence, thread_safety, wire_twin)
+                             rank_divergence, sim_purity, thread_safety,
+                             wire_twin)
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 FIXTURES = REPO_ROOT / "tests" / "lint_fixtures"
@@ -253,7 +254,37 @@ class TestCli:
         assert proc.returncode == 0
         listed = set(proc.stdout.split())
         assert {"wire-twin", "rank-divergence", "thread-safety",
-                "knob-registry", "metrics-catalog"} <= listed
+                "knob-registry", "metrics-catalog", "sim-purity"} <= listed
+
+
+# --------------------------------------------------------------------------
+# sim-purity
+# --------------------------------------------------------------------------
+
+class TestSimPurity:
+    def test_clean_sim_tree_has_no_findings(self):
+        assert run_pass(sim_purity, "sim_purity_clean") == []
+
+    def test_bad_tree_flags_every_leak(self):
+        findings = run_pass(sim_purity, "sim_purity_bad")
+        assert keys(findings) == {
+            "time.time:bad.py:1",
+            "time.monotonic:bad.py:1",
+            "time.sleep:bad.py:1",
+            "time.sleep:bad.py:2",      # occurrence-indexed keys
+            "time.sleep:bad.py:3",      # from-import alias
+            "random.random:bad.py:1",
+            "random.seed:bad.py:1",
+            "random.randint:bad.py:1",  # from-import of a module fn
+        }
+        # random.Random(7) instantiation in the same fixture is allowed
+        assert not any("random.Random" in k for k in keys(findings))
+
+    def test_real_sim_package_is_pure(self):
+        # the shipped simulator itself honours its own contract
+        findings = sim_purity.run(Project(REPO_ROOT))
+        assert findings == [], "\n".join(
+            f.format_text() for f in findings)
 
 
 def test_repo_is_clean():

@@ -486,3 +486,59 @@ class TestSignedFunctionChannel:
         assert ei.value.rank == 0
         assert "signature verification" in str(ei.value)
         assert "rank 1: ok" in str(ei.value)
+
+
+class TestFaultSpecLaunchValidation:
+    """A malformed fault spec — from --fault-spec OR an inherited
+    HVTPU_FAULT_SPEC — must fail at the launcher naming the bad
+    clause, before any worker spawns (a bad clause would otherwise
+    kill every worker at fault-registry init, which at scale reads as
+    a mysterious whole-job crash)."""
+
+    # one malformed spec per grammar shape parse_spec rejects
+    BAD_SPECS = [
+        ("kv.get", "expected 'site:action"),           # no action
+        ("bogus.site:error", "unknown site"),          # bad site
+        ("kv.get:explode", "unknown action"),          # bad action
+        ("kv.get:delay(abc)", "unknown action"),       # bad delay arg
+        ("kv.get:error@prob=2.0", "bad selector"),     # prob out of range
+        ("kv.get:error@times=x", "bad selector"),      # non-int times
+        ("kv.get:error@rank=a", "bad selector"),       # non-int rank
+        ("kv.get:error@wat=1", "unknown selector"),    # unknown selector
+    ]
+
+    @pytest.mark.parametrize("spec,msg", BAD_SPECS)
+    def test_env_var_rejected_at_launch(self, spec, msg, monkeypatch,
+                                        capsys):
+        monkeypatch.setenv("HVTPU_FAULT_SPEC", spec)
+        rc = launch_mod.main(["-np", "1", "true"])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "HVTPU_FAULT_SPEC" in err
+        assert msg in err
+        assert spec in err  # the diagnostic names the bad clause
+
+    @pytest.mark.parametrize("spec,msg", BAD_SPECS[:2])
+    def test_flag_rejected_at_launch(self, spec, msg, monkeypatch,
+                                     capsys):
+        monkeypatch.delenv("HVTPU_FAULT_SPEC", raising=False)
+        rc = launch_mod.main(
+            ["-np", "1", "--fault-spec", spec, "true"])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "--fault-spec" in err
+        assert msg in err
+
+    def test_valid_env_spec_reaches_workers(self, monkeypatch):
+        captured = {}
+
+        def fake_launch(command, slots, coordinator_addr, port,
+                        **kwargs):
+            captured["ok"] = True
+            return 0
+
+        monkeypatch.setenv("HVTPU_FAULT_SPEC",
+                           "kv.get:error@prob=0.1;worker.step:kill@rank=3")
+        monkeypatch.setattr(launch_mod, "launch_workers", fake_launch)
+        assert launch_mod.main(["-np", "1", "true"]) == 0
+        assert captured["ok"]

@@ -1,6 +1,6 @@
 """hvtpulint — zero-dependency static analysis for the hvtpu tree.
 
-Five passes guard invariants that are otherwise only enforced at
+Six passes guard invariants that are otherwise only enforced at
 runtime (see docs/static-analysis.md):
 
   wire-twin        C++ wire format (native/src) vs the Python twin
@@ -8,6 +8,7 @@ runtime (see docs/static-analysis.md):
   thread-safety    guarded-by lock discipline in eager/controller.py
   knob-registry    HVTPU_* env knobs vs the generated docs/knobs.md
   metrics-catalog  registered metrics vs docs/observability.md vs bench
+  sim-purity       no host time / ambient RNG in horovod_tpu/sim
 
 Everything here is stdlib-only (ast + re); the C++ side is scanned
 lexically, never compiled.
@@ -215,13 +216,14 @@ def _registry() -> Dict[str, Callable[[Project], List[Finding]]]:
     # Imported lazily so `import tools.hvtpulint` stays cheap and the
     # passes can import this module for Finding/Project.
     from . import (knob_registry, metrics_catalog, rank_divergence,
-                   thread_safety, wire_twin)
+                   sim_purity, thread_safety, wire_twin)
     return {
         "wire-twin": wire_twin.run,
         "rank-divergence": rank_divergence.run,
         "thread-safety": thread_safety.run,
         "knob-registry": knob_registry.run,
         "metrics-catalog": metrics_catalog.run,
+        "sim-purity": sim_purity.run,
     }
 
 

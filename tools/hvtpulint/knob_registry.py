@@ -116,15 +116,19 @@ class _Extractor(ast.NodeVisitor):
                     knob.reads.append((self.rel, node.lineno))
                 if f.attr in {"get", "getenv"} and len(node.args) > 1:
                     knob.defaults.append(ast.unparse(node.args[1]))
-        # config.py helpers: _env("CYCLE_TIME", 1.0) -> HVTPU_CYCLE_TIME
+        # config.py helpers: _env("CYCLE_TIME", 1.0) -> HVTPU_CYCLE_TIME;
+        # local helpers passing the full name (_env_float("HVTPU_X", d))
+        # count as reads of that name verbatim
         if (isinstance(f, ast.Name) and _ENV_HELPER_RE.match(f.id)
                 and node.args and isinstance(node.args[0], ast.Constant)
-                and isinstance(node.args[0].value, str)
-                and not node.args[0].value.startswith("HVTPU_")):
-            knob = self._knob("HVTPU_" + node.args[0].value)
-            knob.reads.append((self.rel, node.lineno))
-            if len(node.args) > 1:
-                knob.defaults.append(ast.unparse(node.args[1]))
+                and isinstance(node.args[0].value, str)):
+            arg = node.args[0].value
+            name = arg if arg.startswith("HVTPU_") else "HVTPU_" + arg
+            if len(name) > len("HVTPU_"):
+                knob = self._knob(name)
+                knob.reads.append((self.rel, node.lineno))
+                if len(node.args) > 1:
+                    knob.defaults.append(ast.unparse(node.args[1]))
         self.generic_visit(node)
 
     def visit_Subscript(self, node: ast.Subscript):

@@ -1,0 +1,107 @@
+"""sim-purity pass: no host time or ambient randomness in the fabric
+simulator.
+
+The determinism/replay contract of ``horovod_tpu/sim`` (same seed ⇒
+byte-identical event log, docs/simulation.md) survives only if nothing
+in the package reads the host clock or the interpreter-global RNG.
+This pass bans, anywhere under ``horovod_tpu/sim/``:
+
+  * ``time.time`` / ``time.monotonic`` / ``time.sleep`` (and their
+    ``_ns``/``perf_counter`` variants) — host-clock reads and real
+    sleeps; simulator code must use the kernel's virtual clock
+    (``SimKernel.now`` / ``.sleep`` / ``core/clock``).
+  * module-level :mod:`random` functions (``random.random``,
+    ``random.randint``, ``random.seed``, …) — the process-global RNG
+    is shared mutable state seeded who-knows-where.  Instantiating
+    ``random.Random(seed)`` is explicitly allowed: that is exactly how
+    :meth:`SimKernel.rng` builds its named, seeded streams.
+
+Both attribute calls (``time.sleep(...)``) and names bound via
+``from time import sleep`` are caught.  Detection is AST-based; names
+in strings/comments don't count.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from . import Finding, Project
+
+PASS = "sim-purity"
+
+SIM_DIR = "horovod_tpu/sim"
+
+#: time-module attributes that read the host clock or really sleep.
+BANNED_TIME = {
+    "time", "time_ns", "monotonic", "monotonic_ns", "sleep",
+    "perf_counter", "perf_counter_ns",
+}
+
+#: random-module attributes that are allowed (seeded generator
+#: classes); every other ``random.<lowercase>`` call is the ambient
+#: process-global RNG and is banned.
+ALLOWED_RANDOM = {"Random", "SystemRandom"}
+
+
+def _banned_random(attr: str) -> bool:
+    return attr not in ALLOWED_RANDOM and not attr.startswith("_")
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self):
+        # local name -> canonical "module.attr" for bindings made by
+        # `from time import sleep [as s]` / `from random import randint`
+        self.from_bindings: Dict[str, str] = {}
+        self.hits: List[tuple] = []  # (line, canonical)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time":
+            for alias in node.names:
+                if alias.name in BANNED_TIME:
+                    self.from_bindings[
+                        alias.asname or alias.name] = f"time.{alias.name}"
+        elif node.module == "random":
+            for alias in node.names:
+                if _banned_random(alias.name):
+                    self.from_bindings[
+                        alias.asname or alias.name] = f"random.{alias.name}"
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            mod, attr = fn.value.id, fn.attr
+            if mod == "time" and attr in BANNED_TIME:
+                self.hits.append((node.lineno, f"time.{attr}"))
+            elif mod == "random" and _banned_random(attr):
+                self.hits.append((node.lineno, f"random.{attr}"))
+        elif isinstance(fn, ast.Name) and fn.id in self.from_bindings:
+            self.hits.append((node.lineno, self.from_bindings[fn.id]))
+        self.generic_visit(node)
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    files = project.py_files(SIM_DIR)
+    for path in files:
+        tree = project.parse(path)
+        if tree is None:
+            continue
+        visitor = _Visitor()
+        visitor.visit(tree)
+        rel = project.rel(path)
+        counts: Dict[str, int] = {}
+        for line, canonical in visitor.hits:
+            # occurrence-indexed key: stable across unrelated edits,
+            # distinct when one file has several hits of one symbol
+            n = counts[canonical] = counts.get(canonical, 0) + 1
+            findings.append(Finding(
+                PASS, rel, line,
+                f"{canonical}:{path.name}:{n}",
+                f"simulator code calls {canonical}() — host time / "
+                "ambient randomness breaks the byte-identical replay "
+                "contract; use the virtual clock (SimKernel / "
+                "core.clock) or a seeded SimKernel.rng stream",
+            ))
+    return findings

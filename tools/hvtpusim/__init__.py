@@ -1,0 +1,10 @@
+"""hvtpusim: run the hvtpu control plane at virtual scale.
+
+``python -m tools.hvtpusim run <scenario> --ranks N --seed S`` executes
+one named chaos scenario (see ``list``) on the deterministic fabric
+simulator and prints per-phase virtual-time stats plus the event-log
+digest; ``bench`` produces the measured control-plane scaling rows
+(negotiation cycle / rendezvous / drain commit vs world size) recorded
+in BENCH_SCALING.json.  docs/simulation.md documents the architecture
+and the determinism/replay contract.
+"""
