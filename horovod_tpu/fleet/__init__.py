@@ -1,0 +1,32 @@
+"""hvtpu.fleet — multi-job resource arbiter over one elastic pool.
+
+Gang scheduling (full min-world allocations only), priority preemption
+through the graceful-drain channel (planned resizes, zero lost steps,
+no restart-budget strikes), and traffic-driven autoscaling hooks.
+See docs/fleet.md.
+"""
+
+from .arbiter import FleetArbiter
+from .autoscale import Autoscaler, FileSignal
+from .job import (DONE, DRAINING, FAILED, FleetSpecError, Job, JobSpec,
+                  PENDING, RESIZING, RUNNING, STATES, prefixed_client)
+from .runner import AllocationDiscovery, ElasticJobRunner
+
+__all__ = [
+    "FleetArbiter",
+    "Autoscaler",
+    "FileSignal",
+    "FleetSpecError",
+    "Job",
+    "JobSpec",
+    "prefixed_client",
+    "AllocationDiscovery",
+    "ElasticJobRunner",
+    "STATES",
+    "PENDING",
+    "RUNNING",
+    "DRAINING",
+    "RESIZING",
+    "DONE",
+    "FAILED",
+]

@@ -1,0 +1,565 @@
+"""The multi-job resource arbiter: one pool, N jobs, gang scheduling,
+priority preemption via graceful drain, autoscaling hooks.
+
+The arbiter promotes the one-job ElasticDriver into a fleet: it owns a
+:class:`~horovod_tpu.elastic.discovery.HostManager` over the POOL's
+discovery (the same cooldown-blacklist machinery the single-job driver
+uses) and divides the discovered slots among jobs.  Everything is
+driven by :meth:`tick` — a pure, lock-held scheduling pass over
+arbiter state — so the production loop (:meth:`run`, real clock
+thread), the CLI server, tier-1 fake-clock tests, and the fabric
+simulator (a kernel task calling ``tick()`` on virtual time) all run
+the SAME logic.
+
+Scheduling policy (deterministic by construction):
+
+- **Gang scheduling.**  A job launches only when its full ``min_np``
+  allocation is free — never a partial gang.  Pending jobs are visited
+  in (priority desc, submit order) order; a small job behind a starved
+  big one may backfill (no slot is held idle waiting), because the big
+  one acquires its gang through preemption, not accumulation.
+- **Start-time expansion.**  When every pending job has been placed,
+  freshly-started jobs widen toward ``max_np`` with the leftover slots
+  (free — the job has not launched yet).  Already-RUNNING jobs never
+  auto-expand; growth is the autoscaler's (or an operator's) call,
+  because a grow costs the job a commit-boundary reset.
+- **Priority preemption.**  A pending job that cannot fit may reclaim
+  slots from strictly-lower-priority RUNNING jobs, shrinking each
+  victim toward its ``min_np`` — never evicting below it.  Victim
+  order is lowest priority first, and within a tier the YOUNGEST job
+  (highest submit_seq) yields first; ``submit_seq`` is unique, so
+  selection is a total order (the tie-break determinism tests pin
+  this).  The shrink rides the planned-drain channel: per-rank
+  ``core/preempt.py`` notice files → coordinated emergency commit →
+  ``DRAIN_EXIT_CODE`` exits → a resize with zero lost steps and no
+  restart-budget or blacklist strike.  If the drain grace expires, the
+  arbiter escalates (SIGTERM) and the victim pays a charged restart.
+- **Fail fast.**  A pending job whose ``min_np`` exceeds the pool's
+  total discovered capacity can never run; it FAILs immediately with a
+  diagnostic naming both numbers.
+
+Thread safety: ``_lock`` guards all arbiter state; ``tick``/``submit``
+/``cancel``/``debug_state`` take it.  Job handles have their own
+internal locks and never call back into the arbiter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..core import clock
+from ..elastic.discovery import HostManager
+from ..obs import metrics as obs_metrics
+from . import job as job_mod
+from .autoscale import Autoscaler
+from .job import (DONE, DRAINING, FAILED, FleetSpecError, Job, JobSpec,
+                  PENDING, RESIZING, RUNNING, STATES)
+
+__all__ = ["FleetArbiter"]
+
+_M_JOBS = obs_metrics.gauge(
+    "hvtpu_fleet_jobs",
+    "Fleet jobs per lifecycle state (label: state).")
+_M_SLOTS_TOTAL = obs_metrics.gauge(
+    "hvtpu_fleet_pool_slots_total",
+    "Schedulable slots in the fleet pool (discovered minus "
+    "blacklist-cooldown hosts).")
+_M_SLOTS_USED = obs_metrics.gauge(
+    "hvtpu_fleet_pool_slots_used",
+    "Pool slots currently allocated to live jobs.")
+_M_PREEMPTIONS = obs_metrics.counter(
+    "hvtpu_fleet_preemptions_total",
+    "Planned shrinks the arbiter issued on lower-priority jobs "
+    "(priority preemption + autoscale shrinks), via the graceful-"
+    "drain channel.")
+_M_QUEUE_WAIT = obs_metrics.histogram(
+    "hvtpu_fleet_queue_wait_seconds",
+    "Submit-to-launch wait per job: how long the gang waited for its "
+    "full min-world allocation.")
+_M_RESIZE_S = obs_metrics.histogram(
+    "hvtpu_fleet_resize_seconds",
+    "Arbiter-initiated resize latency: shrink request to the victim "
+    "running again at its new size.")
+_M_AUTOSCALE = obs_metrics.counter(
+    "hvtpu_fleet_autoscale_events_total",
+    "Autoscale decisions applied (label: direction = grow | shrink).")
+
+
+class FleetArbiter:
+    """One shared pool serving N prioritised elastic jobs."""
+
+    def __init__(self, discovery, *,
+                 fleet_dir: Optional[str] = None,
+                 tick_s: Optional[float] = None,
+                 drain_grace_s: Optional[float] = None,
+                 runner_factory: Optional[Callable[[Job], object]] = None,
+                 event_fn: Optional[Callable[..., None]] = None,
+                 blacklist_cooldown: Optional[float] = None,
+                 verbose: bool = False,
+                 register_debug: bool = True):
+        self.hosts = HostManager(discovery,
+                                 cooldown_base_s=blacklist_cooldown)
+        if fleet_dir is None:
+            fleet_dir = os.environ.get("HVTPU_FLEET_DIR")
+        self.fleet_dir = fleet_dir
+        if tick_s is None:
+            tick_s = float(
+                os.environ.get("HVTPU_FLEET_TICK_SECONDS", "1") or 1)
+        self.tick_s = tick_s
+        if drain_grace_s is None:
+            drain_grace_s = float(
+                os.environ.get("HVTPU_FLEET_DRAIN_GRACE_SECONDS", "30")
+                or 30)
+        self.drain_grace_s = drain_grace_s
+        self._event_fn = event_fn
+        self.verbose = verbose
+        if runner_factory is None:
+            base = (os.path.join(fleet_dir, "jobs") if fleet_dir
+                    else tempfile.mkdtemp(prefix="hvtpu_fleet_"))
+
+            def runner_factory(j, _base=base):
+                from .runner import ElasticJobRunner
+
+                return ElasticJobRunner(j, _base, verbose=self.verbose)
+
+        self._runner_factory = runner_factory
+        self._lock = threading.RLock()
+        self.jobs: Dict[str, Job] = {}  # hvtpulint: guarded-by(_lock)
+        self._autoscalers: Dict[str, Autoscaler] = {}  # hvtpulint: guarded-by(_lock)
+        self._submit_seq = 0  # hvtpulint: guarded-by(_lock)
+        self._pool_seen = False  # hvtpulint: guarded-by(_lock)
+        self._stop = threading.Event()
+        self._registered_debug = register_debug
+        if register_debug:
+            obs_metrics.register_debug_provider("fleet", self.debug_state)
+
+    # -- events ---------------------------------------------------------
+    def _event(self, kind: str, **fields) -> None:
+        if self._event_fn is not None:
+            self._event_fn(f"fleet.{kind}", **fields)
+        if self.verbose:
+            detail = " ".join(f"{k}={v}" for k, v in fields.items())
+            print(f"hvtpu.fleet: {kind} {detail}", flush=True)
+
+    # -- submit / cancel -------------------------------------------------
+    def submit(self, spec: JobSpec) -> Job:
+        """Queue a validated spec; duplicate live names are rejected
+        (the name keys the state dir and KV prefix)."""
+        with self._lock:
+            return self._submit_locked(spec)
+
+    def _submit_locked(self, spec: JobSpec) -> Job:  # hvtpulint: requires(_lock)
+        existing = self.jobs.get(spec.name)
+        if existing is not None and not existing.terminal:
+            raise FleetSpecError(
+                "name", f"job {spec.name!r} already exists "
+                f"(state {existing.state})")
+        self._submit_seq += 1
+        job = Job(spec, self._submit_seq)
+        self.jobs[spec.name] = job
+        if spec.autoscale is not None:
+            asc = Autoscaler.from_spec(spec.autoscale)
+            if asc is not None:
+                self._autoscalers[spec.name] = asc
+            else:
+                self._event("autoscale_unconfigured", job=spec.name)
+        self._event("submit", job=spec.name, priority=spec.priority,
+                    min_np=spec.min_np, max_np=spec.max_np)
+        return job
+
+    def attach_autoscaler(self, name: str, autoscaler: Autoscaler
+                          ) -> None:
+        with self._lock:
+            if name not in self.jobs:
+                raise KeyError(f"unknown job {name!r}")
+            self._autoscalers[name] = autoscaler
+
+    def cancel(self, name: str) -> bool:
+        with self._lock:
+            return self._cancel_locked(name)
+
+    def _cancel_locked(self, name: str) -> bool:  # hvtpulint: requires(_lock)
+        job = self.jobs.get(name)
+        if job is None or job.terminal:
+            return False
+        job.cancelled = True
+        if job.state == PENDING:
+            job.to(FAILED, reason="cancelled")
+        elif job.handle is not None:
+            job.handle.stop()  # whole-job graceful drain
+        self._event("cancel", job=name, state=job.state)
+        return True
+
+    # -- the scheduling pass ---------------------------------------------
+    def tick(self) -> None:
+        """One full arbiter pass: spool intake → pool refresh → reap →
+        fail-fast → gang schedule (+preempt) → autoscale → publish."""
+        with self._lock:
+            self._intake_spool()
+            self._refresh_pool()
+            self._reap()
+            self._fail_oversized()
+            self._schedule()
+            self._autoscale_tick()
+            self._publish()
+
+    def _refresh_pool(self) -> None:  # hvtpulint: requires(_lock)
+        try:
+            self.hosts.refresh()
+        except Exception as e:  # noqa: BLE001 — transient discovery failure
+            self._event("discovery_error", error=str(e)[:200])
+            return
+        if self.hosts.last_found:
+            self._pool_seen = True
+
+    def _live_jobs(self) -> List[Job]:  # hvtpulint: requires(_lock)
+        return [j for j in self.jobs.values() if not j.terminal]
+
+    def _free_map(self) -> Dict[str, int]:  # hvtpulint: requires(_lock)
+        """host → unallocated schedulable slots (negative clamped: a
+        pool that shrank below its allocations frees nothing)."""
+        free = dict(self.hosts.current)
+        for j in self._live_jobs():
+            for h, n in j.allocation.items():
+                if h in free:
+                    free[h] -= n
+        return {h: n for h, n in free.items() if n > 0}
+
+    @staticmethod
+    def _take(free: Dict[str, int], n: int) -> Dict[str, int]:
+        """Deterministically carve ``n`` slots out of ``free`` (hosts
+        in sorted name order)."""
+        out: Dict[str, int] = {}
+        for h in sorted(free):
+            if n <= 0:
+                break
+            got = min(free[h], n)
+            if got > 0:
+                out[h] = got
+                free[h] -= got
+                n -= got
+        return out
+
+    def _reap(self) -> None:  # hvtpulint: requires(_lock)
+        """Adopt every handle's view: exits, phase changes, live
+        allocations, charged restarts, drain-grace escalation."""
+        now = clock.monotonic()
+        for j in self._live_jobs():
+            h = j.handle
+            if h is None:
+                continue
+            j.charged_restarts = h.charged_restarts
+            code = h.poll()
+            if code is not None:
+                j.exit_code = code
+                j.allocation = {}
+                if j.cancelled:
+                    j.to(FAILED, reason="cancelled")
+                elif code == 0:
+                    j.to(DONE)
+                else:
+                    j.to(FAILED, reason=f"exit {code}")
+                self._event("job_end", job=j.name, state=j.state,
+                            code=code,
+                            charged_restarts=j.charged_restarts)
+                continue
+            phase = h.phase()
+            if j.state == DRAINING:
+                if phase == "resizing":
+                    j.to(RESIZING)
+                elif phase == "running" and h.target_np() is None:
+                    # drain landed and the relaunch won the race with
+                    # this tick
+                    self._finish_resize(j, now)
+                elif (j.shrink_deadline is not None
+                      and now >= j.shrink_deadline
+                      and not j.shrink_escalated):
+                    j.shrink_escalated = True
+                    n = h.escalate()
+                    self._event("drain_grace_expired", job=j.name,
+                                signalled=n)
+            elif j.state == RESIZING and phase == "running":
+                self._finish_resize(j, now)
+            elif j.state == RUNNING and phase == "resizing":
+                # an external event (spot reclaim drain, crash) is
+                # resizing the job without the arbiter asking
+                j.to(RESIZING)
+            j.allocation = h.allocation()
+
+    def _finish_resize(self, j: Job, now: float) -> None:
+        j.to(RUNNING)
+        if j.shrink_started_t is not None:
+            _M_RESIZE_S.observe(now - j.shrink_started_t)
+            self._event("resized", job=j.name,
+                        np=j.handle.current_np(),
+                        resize_s=round(now - j.shrink_started_t, 6))
+        j.shrink_started_t = None
+        j.shrink_deadline = None
+        j.shrink_escalated = False
+
+    def _fail_oversized(self) -> None:  # hvtpulint: requires(_lock)
+        """A gang that can NEVER fit (min_np > the pool's total
+        discovered capacity) fails fast with both numbers named."""
+        if not self._pool_seen:
+            return
+        capacity = sum(self.hosts.last_found.values())
+        for j in self._live_jobs():
+            if j.state == PENDING and j.spec.min_np > capacity:
+                j.to(FAILED, reason=(
+                    f"min_np={j.spec.min_np} can never fit: the pool "
+                    f"has {capacity} total slots"))
+                self._event("job_unschedulable_fatal", job=j.name,
+                            min_np=j.spec.min_np, capacity=capacity)
+
+    def _schedule(self) -> None:  # hvtpulint: requires(_lock)
+        pending = sorted(
+            (j for j in self.jobs.values() if j.state == PENDING),
+            key=lambda j: (-j.spec.priority, j.submit_seq))
+        started: List[Job] = []
+        all_placed = True
+        for j in pending:
+            free = self._free_map()
+            total = sum(free.values())
+            if total >= j.spec.min_np:
+                alloc = self._take(free, j.spec.min_np)
+                self._start_job(j, alloc)
+                started.append(j)
+            else:
+                all_placed = False
+                self._maybe_preempt(j, total)
+        # start-time expansion: only when nothing is left waiting
+        if all_placed:
+            for j in sorted(started,
+                            key=lambda j: (-j.spec.priority,
+                                           j.submit_seq)):
+                self._expand_at_start(j)
+        # launch AFTER expansion so each gang starts once, full-width
+        for j in started:
+            j.handle.start(j.allocation)
+            self._event("job_start", job=j.name,
+                        np=sum(j.allocation.values()),
+                        queue_wait_s=round(j.queue_wait_s or 0.0, 6))
+
+    def _start_job(self, j: Job, alloc: Dict[str, int]) -> None:
+        j.allocation = alloc
+        j.handle = self._runner_factory(j)
+        j.to(RUNNING)
+        if j.queue_wait_s is not None:
+            _M_QUEUE_WAIT.observe(j.queue_wait_s)
+
+    def _expand_at_start(self, j: Job) -> None:  # hvtpulint: requires(_lock)
+        free = self._free_map()
+        total = sum(free.values())
+        cur = sum(j.allocation.values())
+        cap = j.spec.max_np if j.spec.max_np is not None else cur + total
+        extra = min(cap - cur, total)
+        if extra <= 0:
+            return
+        more = self._take(free, extra)
+        for h, n in more.items():
+            j.allocation[h] = j.allocation.get(h, 0) + n
+
+    def _maybe_preempt(self, j: Job, free_total: int) -> None:  # hvtpulint: requires(_lock)
+        """Reclaim ``min_np - free`` slots from strictly-lower-priority
+        RUNNING jobs, shrinking each toward its min.  Victim order:
+        priority asc, then YOUNGEST first (submit_seq desc) — a unique
+        total order."""
+        need = j.spec.min_np - free_total
+        victims = sorted(
+            (v for v in self.jobs.values()
+             if v.state == RUNNING and v.handle is not None
+             and v.spec.priority < j.spec.priority),
+            key=lambda v: (v.spec.priority, -v.submit_seq))
+        plan = []
+        for v in victims:
+            if need <= 0:
+                break
+            cur = sum(v.allocation.values())
+            reclaim = min(cur - v.spec.min_np, need)
+            if reclaim > 0:
+                plan.append((v, cur - reclaim))
+                need -= reclaim
+        if need > 0:
+            if not j.unschedulable_reported:
+                j.unschedulable_reported = True
+                self._event("job_waiting", job=j.name,
+                            min_np=j.spec.min_np, free=free_total,
+                            missing=need)
+            return
+        j.unschedulable_reported = False
+        for v, new_np in plan:
+            self._start_shrink(v, new_np,
+                               reason=f"preempted for {j.name}")
+
+    def _start_shrink(self, v: Job, new_np: int, reason: str) -> None:
+        if not v.handle.request_shrink(new_np):
+            return  # between incarnations; retried next tick
+        now = clock.monotonic()
+        v.preemptions += 1
+        v.shrink_started_t = now
+        v.shrink_deadline = now + self.drain_grace_s
+        v.shrink_escalated = False
+        v.to(DRAINING, reason=reason)
+        _M_PREEMPTIONS.inc()
+        self._event("preempt", victim=v.name, to_np=new_np,
+                    reason=reason)
+
+    def _autoscale_tick(self) -> None:  # hvtpulint: requires(_lock)
+        now = clock.monotonic()
+        for name in sorted(self._autoscalers):
+            asc = self._autoscalers[name]
+            j = self.jobs.get(name)
+            if j is None or j.state != RUNNING or j.handle is None:
+                continue
+            decision = asc.evaluate(now)
+            if decision is None:
+                continue
+            direction, step = decision
+            cur = sum(j.allocation.values())
+            if direction == "grow":
+                free = self._free_map()
+                cap = (j.spec.max_np if j.spec.max_np is not None
+                       else cur + sum(free.values()))
+                extra = min(step, cap - cur, sum(free.values()))
+                if extra <= 0:
+                    continue
+                more = self._take(free, extra)
+                alloc = dict(j.allocation)
+                for h, n in more.items():
+                    alloc[h] = alloc.get(h, 0) + n
+                j.allocation = alloc
+                j.handle.update_allocation(alloc)
+                _M_AUTOSCALE.inc(direction="grow")
+                self._event("autoscale", job=name, direction="grow",
+                            np=sum(alloc.values()),
+                            signal=asc.last_signal)
+            else:
+                new_np = max(j.spec.min_np, cur - step)
+                if new_np >= cur:
+                    continue
+                _M_AUTOSCALE.inc(direction="shrink")
+                self._event("autoscale", job=name, direction="shrink",
+                            np=new_np, signal=asc.last_signal)
+                self._start_shrink(j, new_np, reason="autoscale")
+
+    # -- spool protocol (CLI ↔ arbiter) ----------------------------------
+    def _intake_spool(self) -> None:  # hvtpulint: requires(_lock)
+        d = self.fleet_dir
+        if not d:
+            return
+        sub = os.path.join(d, "submit")
+        if os.path.isdir(sub):
+            for fn in sorted(os.listdir(sub)):
+                if not fn.endswith(".json"):
+                    continue
+                path = os.path.join(sub, fn)
+                try:
+                    self._submit_locked(JobSpec.load(path))
+                except FleetSpecError as e:
+                    self._reject(fn, str(e))
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        can = os.path.join(d, "cancel")
+        if os.path.isdir(can):
+            for fn in sorted(os.listdir(can)):
+                self._cancel_locked(fn)
+                try:
+                    os.unlink(os.path.join(can, fn))
+                except OSError:
+                    pass
+
+    def _reject(self, fn: str, message: str) -> None:
+        self._event("submit_rejected", spool=fn, error=message[:300])
+        d = os.path.join(self.fleet_dir, "rejected")
+        try:
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, fn + ".error"), "w") as f:
+                f.write(message + "\n")
+        except OSError:
+            pass
+
+    def _publish(self) -> None:  # hvtpulint: requires(_lock)
+        counts = {s: 0 for s in STATES}
+        for j in self.jobs.values():
+            counts[j.state] += 1
+        for s, c in counts.items():
+            _M_JOBS.set(c, state=s)
+        total = sum(self.hosts.current.values())
+        used = sum(n for j in self._live_jobs()
+                   for n in j.allocation.values())
+        _M_SLOTS_TOTAL.set(total)
+        _M_SLOTS_USED.set(min(used, total) if total else used)
+        if self.fleet_dir:
+            self._write_state_json()
+
+    def _write_state_json(self) -> None:  # hvtpulint: requires(_lock)
+        state = self.debug_state_locked()
+        path = os.path.join(self.fleet_dir, "state.json")
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(state, f, sort_keys=True, indent=1)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    # -- read side -------------------------------------------------------
+    def debug_state(self) -> dict:
+        with self._lock:
+            return self.debug_state_locked()
+
+    def debug_state_locked(self) -> dict:  # hvtpulint: requires(_lock)
+        free = self._free_map()
+        out = {
+            "t_wall": round(clock.wall(), 3),
+            "pool": {
+                "hosts": dict(self.hosts.current),
+                "blacklisted": self.hosts.blacklisted_now(),
+                "slots_total": sum(self.hosts.current.values()),
+                "slots_free": sum(free.values()),
+            },
+            "jobs": [j.info()
+                     for j in sorted(self.jobs.values(),
+                                     key=lambda j: j.submit_seq)],
+            "autoscalers": {n: a.debug_state()
+                            for n, a in sorted(
+                                self._autoscalers.items())},
+        }
+        return out
+
+    def all_terminal(self) -> bool:
+        with self._lock:
+            return bool(self.jobs) and all(
+                j.terminal for j in self.jobs.values())
+
+    # -- loop ------------------------------------------------------------
+    def run(self, until_idle: bool = False) -> None:
+        """Tick on ``tick_s`` cadence (through the clock seam) until
+        :meth:`stop` — or, with ``until_idle``, until every submitted
+        job is terminal."""
+        while not self._stop.is_set():
+            self.tick()
+            if until_idle and self.all_terminal():
+                return
+            clock.sleep(self.tick_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def close(self) -> None:
+        self.stop()
+        if self._registered_debug:
+            try:
+                obs_metrics.unregister_debug_provider("fleet")
+            except Exception:  # noqa: BLE001 — already unregistered
+                pass
+
+
+# keep the job module import visible for re-exports (fleet/__init__)
+_ = job_mod

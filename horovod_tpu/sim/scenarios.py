@@ -47,6 +47,13 @@ stream-matrix             the streamed (barrier-free) plane with
                           membership-change (staggered shutdown)
                           interleavings.  Asserts every future
                           resolves and post-recovery cycles are clean.
+multi-job-arbiter         the REAL FleetArbiter sharing one pool
+                          between a low- and a high-priority job:
+                          injected preemption, then a priority
+                          preemption via the graceful-drain channel
+                          (exit-79 victims, zero charged restarts),
+                          gang start of the high job, and per-job
+                          exactly-once sample accounting.
 ========================  =============================================
 """
 
@@ -819,6 +826,428 @@ def stream_matrix(ranks: int, seed: int = 0, *, burst: int = 2,
 
 
 # ---------------------------------------------------------------------------
+# multi-job-arbiter: the fleet arbiter sharing one pool between jobs
+# ---------------------------------------------------------------------------
+
+class _SimJobRunner:
+    """Handle-protocol job runner over virtual rank tasks: the sim
+    counterpart of ``fleet/runner.py``'s ElasticJobRunner.  Each
+    generation spawns ``np`` rank tasks running the REAL elastic-commit
+    + drain-coordination code over a job-prefixed KV namespace; a
+    supervisor task classifies each incarnation (done / drain /
+    restart) exactly like the production driver and relaunches.
+
+    All mutation happens on kernel task threads serialised by the run
+    token, so no locks are needed (the sim invariant)."""
+
+    def __init__(self, job, kernel, fabric, *, steps: int,
+                 compute_s: float, durable_every: int, grace_s: float,
+                 perm, account: Dict[int, int],
+                 fault_spec: str = "", launch_hook=None):
+        self.job = job
+        self.name = job.spec.name
+        self.kernel = kernel
+        self.fabric = fabric
+        self.steps = steps
+        self.compute_s = compute_s
+        self.durable_every = durable_every
+        self.grace_s = grace_s
+        self.perm = perm
+        self.account = account
+        self.fault_spec = fault_spec  # generation 0 only
+        self.launch_hook = launch_hook
+        # disjoint generation space per job: audit sequence counters
+        # are keyed (generation, rank, label) process-wide, and two
+        # jobs sharing rank ids at different commit cadences would
+        # otherwise desynchronise each other's audit rounds
+        self.ns_base = job.submit_seq * 1000
+        self.charged_restarts = 0
+        self.drains = 0
+        self.np_history: List[int] = []
+        self.exit79_per_gen: List[int] = []
+        self.shrink_req_t: Optional[float] = None
+        self.notice_to_commit_s: Optional[float] = None
+        self.resize_s: Optional[float] = None
+        self._alloc: Dict[str, int] = {}
+        self._pending_alloc: Optional[Dict[str, int]] = None
+        self._victims: set = set()
+        self._notices: set = set()
+        self._kills: set = set()
+        self._target_np: Optional[int] = None
+        self._phase = "pending"
+        self._np = 0
+        self._exit: Optional[int] = None
+        self._gen = 0
+        self._resume = {"step": 0, "cursor": 0}
+        self._drain_t = 0.0
+        self._measure_resize = False
+
+    # -- handle protocol (called from the arbiter's task) ---------------
+    def start(self, allocation: Dict[str, int]) -> None:
+        self._alloc = dict(allocation)
+        self._phase = "running"
+        self.kernel.spawn(f"{self.name}.driver", self._supervise)
+
+    def poll(self) -> Optional[int]:
+        return self._exit
+
+    def stop(self) -> None:
+        self._kills.update(range(self._np))
+
+    def request_shrink(self, new_np: int) -> bool:
+        if self._phase != "running" or self._np <= new_np:
+            return False
+        keep: Dict[str, int] = {}
+        remaining = new_np
+        for h in sorted(self._alloc):
+            take = min(self._alloc[h], remaining)
+            if take > 0:
+                keep[h] = take
+                remaining -= take
+        self._pending_alloc = keep
+        self._victims = set(range(new_np, self._np))
+        self._target_np = new_np
+        self._phase = "draining"
+        self.shrink_req_t = self.kernel.now
+        self._measure_resize = True
+        self._notices.update(self._victims)
+        self.kernel.log("fleet_sim.shrink_notice", job=self.name,
+                        to_np=new_np, victims=self._np - new_np)
+        return True
+
+    def escalate(self) -> int:
+        victims = set(self._victims)
+        self._kills |= victims
+        return len(victims)
+
+    def update_allocation(self, allocation: Dict[str, int]) -> None:
+        self._alloc = dict(allocation)
+
+    def phase(self) -> str:
+        return self._phase
+
+    def current_np(self) -> int:
+        return self._np
+
+    def target_np(self) -> Optional[int]:
+        return self._target_np
+
+    def allocation(self) -> Dict[str, int]:
+        return dict(self._alloc)
+
+    # -- supervisor (one kernel task per job) ---------------------------
+    def _supervise(self) -> None:
+        from ..core.preempt import DRAIN_EXIT_CODE
+
+        while True:
+            gen = self._gen
+            size = sum(self._alloc.values())
+            self._np = size
+            self._phase = "running"
+            self.np_history.append(size)
+            if self._measure_resize and len(self.np_history) > 1 \
+                    and self.shrink_req_t is not None \
+                    and self._pending_alloc is None:
+                self._measure_resize = False
+                self.resize_s = self.kernel.now - self.shrink_req_t
+            self.kernel.log("fleet_sim.launch", job=self.name, gen=gen,
+                            np=size)
+            if self.launch_hook is not None:
+                self.launch_hook(self, gen, size)
+            outcomes: Dict[int, str] = {}
+            tasks = [self.kernel.spawn(
+                f"{self.name}.g{gen}.r{r}",
+                self._make_rank(r, size, gen, outcomes))
+                for r in range(size)]
+            while not all(t.done for t in tasks):
+                self.kernel.sleep(0.05)
+            drained = [r for r, t in enumerate(tasks)
+                       if t.exit_code == DRAIN_EXIT_CODE]
+            crashed = [r for r, t in enumerate(tasks)
+                       if t.exit_code not in (None, DRAIN_EXIT_CODE)]
+            if all(outcomes.get(r) == "finished" for r in range(size)):
+                self._phase = "done"
+                self._exit = 0
+                self.kernel.log("fleet_sim.done", job=self.name,
+                                gen=gen, np=size,
+                                step=self._resume["step"])
+                return
+            if drained and not crashed:
+                self.drains += 1
+                self.exit79_per_gen.append(len(drained))
+                if self._victims and self.shrink_req_t is not None \
+                        and self.notice_to_commit_s is None:
+                    self.notice_to_commit_s = (self._drain_t
+                                               - self.shrink_req_t)
+            else:
+                self.charged_restarts += 1
+            # the incarnation_end moment: apply the pending grant
+            # BEFORE the relaunch (the anti-race contract the real
+            # driver gets from its synchronous listener)
+            if self._pending_alloc is not None:
+                self._alloc = self._pending_alloc
+                self._pending_alloc = None
+                self._victims = set()
+                self._notices = set()
+                self._kills = set()
+                self._target_np = None
+            self._phase = "resizing"
+            self._gen += 1
+            self.kernel.log("fleet_sim.incarnation_end", job=self.name,
+                            gen=gen, drained=len(drained),
+                            crashed=len(crashed))
+            self.kernel.sleep(0.1)  # modelled relaunch latency
+
+    def _make_rank(self, rank: int, size: int, gen: int,
+                   outcomes: Dict[int, str]):
+        job_gen = self.ns_base + gen
+
+        def body():
+            from ..core.exceptions import DrainInterrupt
+            from ..core.preempt import DRAIN_EXIT_CODE
+            from ..core import retry as core_retry
+            from ..data import sharder
+            from ..fleet.job import prefixed_client
+
+            client = prefixed_client(
+                self.fabric.client(self.ns_base + rank, caps="dir"),
+                self.name)
+            kv = core_retry.resilient_kv(client, rank=rank)
+            ctx = RankContext(
+                self.kernel, rank, size,
+                fault_spec=(self.fault_spec if gen == 0 else ""),
+                generation=job_gen, drain_client=kv,
+                drain_grace_s=self.grace_s, with_drain=True)
+            state = SimElasticState(
+                client=client, world=WorldView(rank, size, job_gen),
+                step=self._resume["step"],
+                cursor=self._resume["cursor"])
+            state.set_commit_policy(self.durable_every)
+            pending: List[int] = []
+            flushed = 0
+            outcomes[rank] = "running"
+
+            def flush_durable():
+                nonlocal flushed
+                if state.durable_commits > flushed:
+                    flushed = state.durable_commits
+                    for i in pending:
+                        self.account[i] = self.account.get(i, 0) + 1
+                    del pending[:]
+
+            with ctx.activate():
+                try:
+                    while state.step < self.steps:
+                        ctx.check_exit()
+                        if rank in self._kills:
+                            raise VirtualExit(1)
+                        if rank in self._notices:
+                            self._notices.discard(rank)
+                            ctx.coordinator.notice("fleet")
+                        ctx.coordinator._poll_once()
+                        self.kernel.sleep(self.compute_s)
+                        idx, new_cursor = sharder.shard_window(
+                            self.perm, state.cursor, rank, size, 1)
+                        pending.extend(int(i) for i in idx)
+                        state.step += 1
+                        state.cursor = int(new_cursor)
+                        try:
+                            state.commit()
+                        finally:
+                            # deliveries become accountable only when
+                            # a DURABLE commit captured their cursor —
+                            # uncommitted batches are re-fetched by the
+                            # next incarnation (exactly-once contract)
+                            flush_durable()
+                    # end-of-job durable save (what a real training
+                    # loop does before exiting clean), so the final
+                    # partial window is accounted too
+                    state.save()
+                    flush_durable()
+                    outcomes[rank] = "finished"
+                except DrainInterrupt:
+                    outcomes[rank] = "drain_peer"
+                except VirtualExit as e:
+                    outcomes[rank] = ("drain_exit"
+                                      if e.code == DRAIN_EXIT_CODE
+                                      else "killed")
+                    if e.code == DRAIN_EXIT_CODE:
+                        self._drain_t = self.kernel.now
+                    raise
+                finally:
+                    self._resume = {
+                        "step": int(state._saved.get("step", 0)),
+                        "cursor": int(state._saved.get("cursor", 0))}
+
+        return body
+
+
+def multi_job_arbiter(ranks: int, seed: int = 0, *, lo_steps: int = 8,
+                      hi_steps: int = 4, slots_per_host: int = 8,
+                      tick_s: float = 0.25, hi_arrival_s: float = 1.0,
+                      grace_s: float = 120.0, compute_s: float = 0.4,
+                      durable_every: int = 2) -> Dict:
+    """Two jobs, one pool, under the REAL FleetArbiter: a low-priority
+    job expands to the whole pool, survives an injected mid-run
+    preemption (planned drain, relaunch at full size), then a
+    high-priority job arrives and the arbiter reclaims half the pool
+    through the graceful-drain channel — the victims exit
+    DRAIN_EXIT_CODE at an agreed commit, the low job relaunches
+    smaller with ZERO charged restarts, and the high job gang-starts
+    only once its full min-world allocation is free.  Both jobs finish
+    with per-job exactly-once sample accounting."""
+    from ..core.preempt import DRAIN_EXIT_CODE
+    from ..data import sharder
+    from ..fleet import FleetArbiter, JobSpec
+
+    kernel, fabric = _fresh(ranks, seed)
+    n_hosts = (ranks + slots_per_host - 1) // slots_per_host
+    hosts = {f"host{h:04d}": slots_per_host for h in range(n_hosts)}
+    pool_slots = n_hosts * slots_per_host
+    hi_min = ranks // 2
+    lo_min = max(1, ranks // 4)
+    fault_rank = max(1, ranks // 3)
+    num_samples = (lo_steps + hi_steps) * pool_slots
+    perms = {
+        "lo": sharder.epoch_permutation(num_samples, seed * 131 + 1, 0),
+        "hi": sharder.epoch_permutation(num_samples, seed * 131 + 2, 0),
+    }
+    accounts: Dict[str, Dict[int, int]] = {"lo": {}, "hi": {}}
+    runners: Dict[str, _SimJobRunner] = {}
+    gang_snapshots: List[dict] = []
+
+    def launch_hook(runner, gen, size):
+        # gang-disjointness evidence: at every launch, per-host usage
+        # across ALL live jobs must fit the host
+        usage: Dict[str, int] = {}
+        for r in runners.values():
+            if r._exit is None:
+                for h, n in r._alloc.items():
+                    usage[h] = usage.get(h, 0) + n
+        gang_snapshots.append(
+            {"t": kernel.now, "job": runner.name, "gen": gen,
+             "np": size, "usage": usage})
+
+    def make_runner(job):
+        name = job.spec.name
+        cfg = {
+            "lo": dict(steps=lo_steps,
+                       fault_spec=(f"worker.step:preempt@"
+                                   f"rank={fault_rank},times=1")),
+            "hi": dict(steps=hi_steps, fault_spec=""),
+        }[name]
+        runner = _SimJobRunner(
+            job, kernel, fabric, compute_s=compute_s,
+            durable_every=durable_every, grace_s=grace_s,
+            perm=perms[name], account=accounts[name],
+            launch_hook=launch_hook, **cfg)
+        runners[name] = runner
+        return runner
+
+    arb = FleetArbiter(
+        _StaticDiscovery(hosts), fleet_dir=None, tick_s=tick_s,
+        drain_grace_s=grace_s, runner_factory=make_runner,
+        event_fn=kernel.log, register_debug=False)
+
+    def arbiter_task():
+        arb.submit(JobSpec("lo", ["sim"], priority=0,
+                           min_np=lo_min, max_np=pool_slots))
+        while not arb.all_terminal():
+            arb.tick()
+            kernel.sleep(tick_s)
+        arb.tick()  # final reap/publish
+        kernel.log("fleet_sim.arbiter_done",
+                   states={n: arb.jobs[n].state
+                           for n in sorted(arb.jobs)})
+
+    def hi_submitter():
+        kernel.sleep(hi_arrival_s)
+        # the arrival must preempt a healthy post-drain world, not
+        # merge into the injected gen-0 drain (whose commit lands
+        # after hi_arrival_s at large rank counts): wait for lo's
+        # second incarnation to be running
+        lo_runner = runners["lo"]
+        while not (lo_runner._gen >= 1
+                   and lo_runner.phase() == "running"):
+            kernel.sleep(tick_s)
+        arb.submit(JobSpec("hi", ["sim"], priority=10, min_np=hi_min))
+
+    with _env(HVTPU_AUDIT_EVERY="1", HVTPU_AUDIT_ACTION="abort",
+              HVTPU_ELASTIC_STATE_DIR=None, HVTPU_FLEET_DIR=None):
+        kernel.spawn("arbiter", arbiter_task)
+        kernel.spawn("hi-submitter", hi_submitter)
+        kernel.run(max_virtual_s=_DEF_BUDGET_S)
+
+    lo_r, hi_r = runners["lo"], runners["hi"]
+    lo_job, hi_job = arb.jobs["lo"], arb.jobs["hi"]
+    # both jobs finished clean under the arbiter
+    assert lo_job.state == "DONE" and lo_r._exit == 0, (
+        f"lo ended {lo_job.state} (exit {lo_r._exit}): {lo_job.reason}")
+    assert hi_job.state == "DONE" and hi_r._exit == 0, (
+        f"hi ended {hi_job.state} (exit {hi_r._exit}): {hi_job.reason}")
+    # incarnation history: full pool → full pool (after the injected
+    # preemption's planned drain) → shrunk for the high-priority gang
+    assert lo_r.np_history[0] == pool_slots, (
+        f"lo did not expand to the pool at start: {lo_r.np_history}")
+    assert lo_r.np_history[-1] == pool_slots - hi_min, (
+        f"lo final size {lo_r.np_history[-1]}, expected "
+        f"{pool_slots - hi_min}: {lo_r.np_history}")
+    assert hi_r.np_history == [hi_min], (
+        f"hi must gang-launch exactly once at min_np: {hi_r.np_history}")
+    # planned drains only: exit-79 departures, zero charged restarts
+    assert lo_r.drains == 2 and lo_r.exit79_per_gen == [1, hi_min], (
+        f"drains={lo_r.drains} exit79={lo_r.exit79_per_gen}")
+    assert lo_r.charged_restarts == 0 and hi_r.charged_restarts == 0, (
+        f"planned preemption charged the restart budget: "
+        f"lo={lo_r.charged_restarts} hi={hi_r.charged_restarts}")
+    assert lo_job.preemptions == 1 and lo_job.charged_restarts == 0
+    # gang scheduling: at every launch the per-host usage fits
+    for snap in gang_snapshots:
+        for h, used in snap["usage"].items():
+            assert used <= hosts[h], (
+                f"host {h} over-committed ({used}/{hosts[h]}) at "
+                f"{snap}")
+    # the high job waited for the drain, then got its FULL gang
+    assert hi_job.queue_wait_s is not None and hi_job.queue_wait_s > 0
+    assert gang_snapshots[-1]["job"] in ("hi", "lo")
+    # per-job exactly-once accounting against the committed cursor
+    for name in ("lo", "hi"):
+        acct = accounts[name]
+        cursor = runners[name]._resume["cursor"]
+        assert cursor > 0, f"{name} committed no data progress"
+        dupes = {i: c for i, c in acct.items() if c != 1}
+        assert not dupes, (
+            f"{name}: samples delivered more than once: "
+            f"{sorted(dupes)[:10]}")
+        expect = sorted(int(i) for i in perms[name][:cursor])
+        assert sorted(acct) == expect, (
+            f"{name}: delivered set != committed window "
+            f"({len(acct)} vs {cursor})")
+    assert lo_r.notice_to_commit_s is not None
+    assert 0 < lo_r.notice_to_commit_s < grace_s
+    assert lo_r.resize_s is not None and lo_r.resize_s > 0
+    stats = {"phases": {
+        "pool": {"hosts": n_hosts, "slots": pool_slots},
+        "inject": {"fault_rank": fault_rank,
+                   "lo_incarnations": lo_r.np_history},
+        "preempt": {
+            "victims": hi_min,
+            "queue_wait_s": round(hi_job.queue_wait_s, 6),
+            "notice_to_commit_s": round(lo_r.notice_to_commit_s, 6),
+            "resize_s": round(lo_r.resize_s, 6),
+        },
+        "done": {
+            "lo_final_np": lo_r.np_history[-1],
+            "hi_np": hi_min,
+            "lo_samples": len(accounts["lo"]),
+            "hi_samples": len(accounts["hi"]),
+            "virtual_s": round(kernel.now, 6),
+        }}, "kv_ops": dict(fabric.ops)}
+    _ = DRAIN_EXIT_CODE
+    return _result("multi-job-arbiter", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -830,6 +1259,7 @@ SCENARIOS = {
     "kv-brownout": kv_brownout,
     "straggler-tail": straggler_tail,
     "stream-matrix": stream_matrix,
+    "multi-job-arbiter": multi_job_arbiter,
 }
 
 

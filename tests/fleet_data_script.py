@@ -1,0 +1,59 @@
+"""Fleet variant of elastic_data_script: same exactly-once training
+loop, but DELIVER lines are appended to the per-job file named by
+``FLEET_DELIVER_LOG`` instead of stdout — two jobs sharing one arbiter
+(and one test process's stdout) must not interleave their accounting.
+Single short O_APPEND writes keep concurrent ranks line-atomic.
+"""
+
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu as hvt
+import horovod_tpu.elastic as elastic
+from horovod_tpu.data import ArraySource, ElasticDataLoader
+
+
+def main():
+    hvt.init()
+    epochs = int(os.environ.get("ELASTIC_EPOCHS", "2"))
+    sleep_s = float(os.environ.get("EPOCH_SLEEP", "0.3"))
+    n = int(os.environ.get("DATA_SAMPLES", "48"))
+    batch = int(os.environ.get("DATA_BATCH", "4"))
+    log_path = os.environ["FLEET_DELIVER_LOG"]
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    loader = ElasticDataLoader(
+        ArraySource({"x": x}), batch_size=batch, seed=7,
+        device_put=False)
+    state = elastic.ObjectState(data=loader.state, total=0.0)
+
+    def deliver(line):
+        with open(log_path, "a") as f:
+            f.write(line + "\n")
+
+    @elastic.run
+    def train(state):
+        import jax.numpy as jnp
+
+        gen = os.environ.get("HVTPU_ELASTIC_GENERATION", "0")
+        while loader.state.epoch < epochs:
+            epoch = loader.state.epoch
+            for b in loader:
+                idx = sorted(int(v) for v in np.asarray(b["x"]).ravel())
+                out = hvt.allreduce(jnp.ones(2), op=hvt.Sum)
+                state.total += float(out[0])
+                deliver(
+                    f"DELIVER rank={hvt.rank()} size={hvt.size()} "
+                    f"gen={gen} epoch={epoch} idx={idx}")
+                time.sleep(sleep_s)
+                state.commit()
+        if hvt.rank() == 0:
+            deliver(f"DONE size={hvt.size()} epoch={loader.state.epoch}")
+
+    train(state)
+    loader.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -306,3 +306,43 @@ class TestControlPlaneSimSchema:
         assert "control_plane_sim" in note, (
             "coordination_vs_P must reference the measured "
             "control_plane_sim rows that supersede it")
+
+
+class TestFleetArbiterSimSchema:
+    """BENCH_SCALING.json carries MEASURED multi-job arbiter rows from
+    the fabric simulator (tools/hvtpusim bench-fleet): gang queue wait,
+    preemption notice->commit, and victim resize latency vs pool size.
+    These back the docs/fleet.md latency claims, so the schema is
+    load-bearing like the control-plane rows above."""
+
+    REQUIRED_ROW_KEYS = {
+        "ranks", "queue_wait_s", "preempt_notice_to_commit_s",
+        "resize_s", "victims", "measured", "method",
+    }
+
+    @pytest.fixture
+    def doc(self):
+        with open(os.path.join(_ROOT, "BENCH_SCALING.json")) as f:
+            return json.load(f)
+
+    def test_measured_rows_present_and_complete(self, doc):
+        sim = doc["fleet_arbiter_sim"]
+        assert "drain" in sim["note"].lower()
+        rows = sim["rows"]
+        assert {r["ranks"] for r in rows} >= {64, 256, 1024}
+        for row in rows:
+            assert self.REQUIRED_ROW_KEYS <= set(row), row.get("ranks")
+            assert row["measured"] is True
+            assert "fabric-sim" in row["method"]
+
+    def test_timings_are_finite_positive_virtual_seconds(self, doc):
+        for row in doc["fleet_arbiter_sim"]["rows"]:
+            for key in ("queue_wait_s", "preempt_notice_to_commit_s",
+                        "resize_s"):
+                v = row[key]
+                assert isinstance(v, (int, float)) and 0 < v < 3600, (
+                    f"ranks={row['ranks']} {key}={v!r}")
+            # drain commit happens strictly inside the resize window
+            assert row["preempt_notice_to_commit_s"] < row["resize_s"]
+            # half the low-priority world is reclaimed for the arrival
+            assert row["victims"] == row["ranks"] // 2

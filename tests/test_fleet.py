@@ -1,0 +1,937 @@
+"""hvtpu.fleet: multi-job arbiter over one elastic pool.
+
+Unit tier (tier-1, no real sleeps): the JobSpec validation matrix and
+its ``hvtpufleet submit --spec`` exit-2 contract, the lifecycle state
+machine, job-scoped KV prefixing, gang-scheduling edge cases (partial-
+allocation refusal, never-fits fail-fast, victim tie-break
+determinism, drain-grace expiry → charged restart), and every timer —
+queue wait, autoscale debounce/cooldown, preemption grace — driven by
+an injected fake clock through the ``core/clock`` seam.
+
+Acceptance tier (slow, multiprocess): two real elastic jobs sharing a
+localhost pool; a high-priority arrival preempts the low-priority job
+through the graceful-drain channel (exit 79, ``--max-restarts 0``
+proving zero budget strikes) and BOTH deliver every sample of every
+epoch exactly once.
+"""
+
+import json
+import os
+import re
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from horovod_tpu.fleet import (DONE, DRAINING, FAILED, FleetArbiter,
+                               FleetSpecError, Job, JobSpec, PENDING,
+                               RESIZING, RUNNING, Autoscaler,
+                               prefixed_client)
+from horovod_tpu.fleet.autoscale import FileSignal
+
+
+# ---------------------------------------------------------------------------
+# shared fakes
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def monotonic(self):
+        return self.t
+
+    def wall(self):
+        return self.t
+
+    def sleep(self, seconds):
+        self.t += seconds
+
+    def call_later(self, delay_s, fn):  # pragma: no cover
+        raise AssertionError("no timers expected in these paths")
+
+
+@pytest.fixture
+def fake_clock():
+    from horovod_tpu.core import clock as core_clock
+
+    fc = _FakeClock(t=1000.0)
+    core_clock.install(fc)
+    try:
+        yield fc
+    finally:
+        core_clock.install(None)
+
+
+class _FakeDiscovery:
+    """Mutable pool: duck-types HostDiscoveryScript."""
+
+    def __init__(self, hosts=None):
+        self.hosts = dict(hosts or {})
+
+    def find_available_hosts_and_slots(self):
+        return dict(self.hosts)
+
+
+class _FakeRunner:
+    """Handle-protocol fake: the test scripts the drain/relaunch
+    transitions the real ElasticJobRunner derives from its driver."""
+
+    def __init__(self, job):
+        self.name = job.name
+        self.charged_restarts = 0
+        self.drains = 0
+        self._phase = "pending"
+        self._np = 0
+        self._alloc = {}
+        self._target = None
+        self._exit = None
+        self.shrink_requests = []
+        self.escalations = 0
+        self.stopped = False
+        self.refuse_shrink = False
+        self.started = False
+
+    # -- handle protocol -------------------------------------------------
+    def start(self, allocation):
+        self.started = True
+        self._alloc = dict(allocation)
+        self._np = sum(allocation.values())
+        self._phase = "running"
+
+    def poll(self):
+        return self._exit
+
+    def phase(self):
+        return self._phase
+
+    def current_np(self):
+        return self._np
+
+    def target_np(self):
+        return self._target
+
+    def allocation(self):
+        return dict(self._alloc)
+
+    def request_shrink(self, new_np):
+        if self.refuse_shrink or self._phase != "running":
+            return False
+        self.shrink_requests.append(new_np)
+        self._target = new_np
+        self._phase = "draining"
+        return True
+
+    def escalate(self):
+        victims = self._np - (self._target or self._np)
+        self.escalations += 1
+        self.charged_restarts += 1  # bare SIGTERM = crash = charged
+        self._apply_target()
+        self._phase = "running"
+        return victims
+
+    def update_allocation(self, allocation):
+        self._alloc = dict(allocation)
+        self._np = sum(allocation.values())
+
+    def stop(self):
+        self.stopped = True
+
+    # -- test scripting --------------------------------------------------
+    def drain_lands(self):
+        """Victims exited DRAIN_EXIT_CODE; the incarnation ended as a
+        planned drain and the relaunch is in flight."""
+        self.drains += 1
+        self._apply_target()
+        self._phase = "resizing"
+
+    def relaunch(self):
+        self._phase = "running"
+        self._np = sum(self._alloc.values())
+
+    def exit(self, code):
+        self._exit = code
+        self._alloc = {}
+
+    def _apply_target(self):
+        shed = self._np - self._target
+        for h in sorted(self._alloc, reverse=True):
+            if shed <= 0:
+                break
+            got = min(self._alloc[h], shed)
+            self._alloc[h] -= got
+            shed -= got
+        self._alloc = {h: n for h, n in self._alloc.items() if n > 0}
+        self._np = self._target
+        self._target = None
+
+
+def _spec(name, min_np=1, max_np=None, priority=0, **kw):
+    return JobSpec(name, ["job-cmd"], min_np=min_np, max_np=max_np,
+                   priority=priority, **kw)
+
+
+@pytest.fixture
+def arbiter(fake_clock):
+    pool = _FakeDiscovery({"h1": 4, "h2": 4})
+    events = []
+
+    def event_fn(kind, **fields):
+        events.append((kind.replace("fleet.", "", 1), fields))
+
+    arb = FleetArbiter(pool, fleet_dir=None, tick_s=0.5,
+                       drain_grace_s=30.0,
+                       runner_factory=_FakeRunner, event_fn=event_fn,
+                       register_debug=False)
+    arb.pool = pool
+    arb.events = events
+    return arb
+
+
+def _kinds(arb):
+    return [k for k, _ in arb.events]
+
+
+# ---------------------------------------------------------------------------
+# JobSpec validation matrix (the submit exit-2 contract's engine)
+# ---------------------------------------------------------------------------
+
+
+_BAD_SPECS = [
+    ("name", {"name": "bad name!"}),
+    ("name", {"name": ""}),
+    ("name", {"name": "-leading-dash"}),
+    ("command", {"command": []}),
+    ("command", {"command": "not-a-list"}),
+    ("command", {"command": ["ok", ""]}),
+    ("priority", {"priority": -1}),
+    ("priority", {"priority": "hi"}),
+    ("priority", {"priority": True}),
+    ("min_np", {"min_np": 0}),
+    ("min_np", {"min_np": 2.5}),
+    ("max_np", {"min_np": 4, "max_np": 2}),
+    ("max_np", {"max_np": 0}),
+    ("env", {"env": {"A": 1}}),
+    ("env", {"env": "PATH=x"}),
+    ("max_restarts", {"max_restarts": -2}),
+    ("restart_window", {"restart_window": -1}),
+    ("drain_grace", {"drain_grace": 0.1}),
+    ("autoscale.high", {"autoscale": {"low": 1}}),
+    ("autoscale.low", {"autoscale": {"high": 1, "low": 2}}),
+    ("autoscale.step", {"autoscale": {"high": 2, "low": 1, "step": 0}}),
+    ("autoscale.debounce_s",
+     {"autoscale": {"high": 2, "low": 1, "debounce_s": -1}}),
+    ("autoscale.signal_file",
+     {"autoscale": {"high": 2, "low": 1, "signal_file": ""}}),
+    ("autoscale.bogus", {"autoscale": {"high": 2, "low": 1, "bogus": 1}}),
+    ("frobnicate", {"frobnicate": 1}),
+]
+
+
+class TestJobSpecValidation:
+    @pytest.mark.parametrize(
+        "field,overlay", _BAD_SPECS,
+        ids=[f"{f}-{i}" for i, (f, _) in enumerate(_BAD_SPECS)])
+    def test_malformed_field_is_named(self, field, overlay):
+        d = {"name": "ok", "command": ["run"]}
+        d.update(overlay)
+        with pytest.raises(FleetSpecError) as ei:
+            JobSpec.from_dict(d)
+        assert ei.value.field == field
+        assert f"field '{field}'" in str(ei.value)
+
+    @pytest.mark.parametrize("missing", ["name", "command"])
+    def test_required_fields(self, missing):
+        d = {"name": "ok", "command": ["run"]}
+        del d[missing]
+        with pytest.raises(FleetSpecError) as ei:
+            JobSpec.from_dict(d)
+        assert ei.value.field == missing
+
+    def test_non_object_spec(self):
+        with pytest.raises(FleetSpecError) as ei:
+            JobSpec.from_dict([1, 2])
+        assert ei.value.field == "spec"
+
+    def test_load_invalid_json_and_missing_file(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text("{not json")
+        with pytest.raises(FleetSpecError, match="invalid JSON") as ei:
+            JobSpec.load(str(p))
+        assert ei.value.field == "spec"
+        with pytest.raises(FleetSpecError, match="unreadable"):
+            JobSpec.load(str(tmp_path / "absent.json"))
+
+    def test_round_trip(self):
+        spec = JobSpec("train-a", ["python", "t.py"], priority=3,
+                       min_np=2, max_np=8, env={"K": "v"},
+                       max_restarts=2, restart_window=60.0,
+                       drain_grace=5.0,
+                       autoscale={"high": 10, "low": 2, "step": 2})
+        again = JobSpec.from_dict(spec.to_dict())
+        assert again.to_dict() == spec.to_dict()
+
+    def test_effective_max(self):
+        # max_np=None means "no cap": the pool bounds it
+        assert _spec("a", min_np=2).effective_max(8) == 8
+        assert _spec("a", min_np=2).effective_max() == 2
+        assert _spec("a", min_np=2, max_np=16).effective_max(8) == 8
+        assert _spec("a", min_np=2, max_np=4).effective_max(8) == 4
+
+
+class TestLifecycle:
+    def test_illegal_transition_raises(self, fake_clock):
+        j = Job(_spec("a"), 1)
+        with pytest.raises(RuntimeError, match="illegal transition"):
+            j.to(RESIZING)
+        j.to(RUNNING)
+        j.to(DRAINING)
+        j.to(RESIZING)
+        j.to(RUNNING)
+        j.to(DONE)
+        assert j.terminal
+        with pytest.raises(RuntimeError, match="illegal transition"):
+            j.to(RUNNING)
+
+    def test_queue_wait_stamped_once(self, fake_clock):
+        j = Job(_spec("a"), 1)
+        fake_clock.t += 12.5
+        j.to(RUNNING)
+        assert j.queue_wait_s == pytest.approx(12.5)
+
+
+# ---------------------------------------------------------------------------
+# job-scoped KV prefixing
+# ---------------------------------------------------------------------------
+
+
+class _StrKV:
+    def __init__(self):
+        self.d = {}
+
+    def key_value_set(self, k, v):
+        self.d[k] = v
+
+    def blocking_key_value_get(self, k, timeout_ms):
+        return self.d[k]
+
+    def key_value_try_get(self, k):
+        return self.d.get(k)
+
+    def key_value_delete(self, k):
+        self.d.pop(k, None)
+
+
+class _DirKV(_StrKV):
+    def key_value_dir_get(self, prefix):
+        return sorted((k, v) for k, v in self.d.items()
+                      if k.startswith(prefix))
+
+
+class TestPrefixedClient:
+    def test_jobs_are_namespaced_apart(self):
+        inner = _DirKV()
+        a = prefixed_client(inner, "job-a")
+        b = prefixed_client(inner, "job-b")
+        a.key_value_set("hvtdrain/0/notice/3", "x")
+        assert "fleet/job-a/hvtdrain/0/notice/3" in inner.d
+        assert b.key_value_try_get("hvtdrain/0/notice/3") is None
+        assert a.key_value_try_get("hvtdrain/0/notice/3") == "x"
+
+    def test_dir_get_reroots_results(self):
+        inner = _DirKV()
+        a = prefixed_client(inner, "job-a")
+        a.key_value_set("hvtdrain/0/notice/1", "n1")
+        a.key_value_set("hvtdrain/0/notice/2", "n2")
+        prefixed_client(inner, "job-b").key_value_set(
+            "hvtdrain/0/notice/9", "other")
+        got = a.key_value_dir_get("hvtdrain/0/notice")
+        assert got == [("hvtdrain/0/notice/1", "n1"),
+                       ("hvtdrain/0/notice/2", "n2")]
+
+    def test_capability_tiers_are_mirrored(self):
+        assert not hasattr(prefixed_client(_StrKV(), "j"),
+                           "key_value_dir_get")
+        assert hasattr(prefixed_client(_DirKV(), "j"),
+                       "key_value_dir_get")
+
+    def test_delete_is_scoped(self):
+        inner = _DirKV()
+        a = prefixed_client(inner, "job-a")
+        a.key_value_set("k", "v")
+        a.key_value_delete("k")
+        assert inner.d == {}
+
+
+# ---------------------------------------------------------------------------
+# gang scheduling + preemption edge cases (fake clock, fake runners)
+# ---------------------------------------------------------------------------
+
+
+class TestGangScheduling:
+    def test_full_gang_or_nothing(self, arbiter):
+        j1 = arbiter.submit(_spec("holder", min_np=5, max_np=5))
+        j2 = arbiter.submit(_spec("waiter", min_np=5, max_np=5))
+        arbiter.tick()
+        assert j1.state == RUNNING and sum(j1.allocation.values()) == 5
+        # 3 slots free < min_np=5: no partial allocation, no handle
+        assert j2.state == PENDING
+        assert j2.allocation == {} and j2.handle is None
+        # the starvation is reported once, not every tick
+        arbiter.tick()
+        arbiter.tick()
+        assert _kinds(arbiter).count("job_waiting") == 1
+
+    def test_backfill_behind_starved_job(self, arbiter):
+        arbiter.submit(_spec("holder", min_np=4, max_np=4))
+        big = arbiter.submit(_spec("big", min_np=6, max_np=6))
+        small = arbiter.submit(_spec("small", min_np=2, max_np=2))
+        arbiter.tick()
+        # big (4 free < 6) must not hold the pool idle: small backfills
+        assert big.state == PENDING
+        assert small.state == RUNNING
+
+    def test_never_fits_fails_fast_with_diagnostic(self, arbiter):
+        j = arbiter.submit(_spec("galaxy", min_np=100))
+        arbiter.tick()
+        assert j.state == FAILED
+        assert "min_np=100" in j.reason
+        assert "8 total slots" in j.reason
+        assert "job_unschedulable_fatal" in _kinds(arbiter)
+
+    def test_start_time_expansion_toward_max(self, arbiter):
+        j = arbiter.submit(_spec("wide", min_np=2, max_np=6))
+        arbiter.tick()
+        assert sum(j.allocation.values()) == 6
+        assert j.handle.started
+
+    def test_no_expansion_while_another_waits(self, arbiter):
+        wide = arbiter.submit(_spec("wide", min_np=2))  # uncapped
+        arbiter.submit(_spec("waiter", min_np=20))  # > pool, pending...
+        # ...but fail-fast kills it first; make it fit capacity
+        arbiter.cancel("waiter")
+        arbiter.submit(_spec("waiter2", min_np=7, max_np=7))
+        arbiter.tick()
+        # waiter2 keeps the pool contended: wide must stay at min_np
+        assert sum(wide.allocation.values()) == 2
+
+    def test_duplicate_live_name_rejected(self, arbiter):
+        arbiter.submit(_spec("dup"))
+        with pytest.raises(FleetSpecError) as ei:
+            arbiter.submit(_spec("dup"))
+        assert ei.value.field == "name"
+
+    def test_resubmit_after_terminal_ok(self, arbiter):
+        j = arbiter.submit(_spec("again", min_np=2, max_np=2))
+        arbiter.tick()
+        j.handle.exit(0)
+        arbiter.tick()
+        assert j.state == DONE
+        j2 = arbiter.submit(_spec("again", min_np=2, max_np=2))
+        arbiter.tick()
+        assert j2.state == RUNNING
+
+    def test_queue_wait_measured_on_injected_clock(self, arbiter,
+                                                   fake_clock):
+        arbiter.pool.hosts = {}
+        j = arbiter.submit(_spec("late", min_np=4, max_np=4))
+        arbiter.tick()
+        assert j.state == PENDING
+        fake_clock.t += 7.5
+        arbiter.pool.hosts = {"h1": 4}
+        arbiter.tick()
+        assert j.state == RUNNING
+        assert j.queue_wait_s == pytest.approx(7.5)
+        start = [f for k, f in arbiter.events if k == "job_start"][0]
+        assert start["queue_wait_s"] == pytest.approx(7.5)
+
+    def test_cancel_pending_and_running(self, arbiter):
+        p = arbiter.submit(_spec("p", min_np=20))
+        assert arbiter.cancel("p") is True
+        assert p.state == FAILED and p.reason == "cancelled"
+        r = arbiter.submit(_spec("r", min_np=2, max_np=2))
+        arbiter.tick()
+        assert arbiter.cancel("r") is True
+        assert r.handle.stopped
+        r.handle.exit(1)
+        arbiter.tick()
+        assert r.state == FAILED and r.reason == "cancelled"
+        assert arbiter.cancel("r") is False  # already terminal
+        assert arbiter.cancel("ghost") is False
+
+    def test_run_until_idle_on_fake_clock(self, fake_clock):
+        class _InstantRunner(_FakeRunner):
+            def poll(self):
+                return 0 if self.started else None
+
+        arb = FleetArbiter(_FakeDiscovery({"h1": 4}), fleet_dir=None,
+                           tick_s=0.5, runner_factory=_InstantRunner,
+                           register_debug=False)
+        j = arb.submit(_spec("quick", min_np=2, max_np=2))
+        t0 = fake_clock.t
+        arb.run(until_idle=True)
+        assert j.state == DONE
+        # the loop slept on the SEAM (virtual time advanced, the test
+        # thread never blocked on a real sleep)
+        assert fake_clock.t > t0
+
+
+class TestPreemption:
+    def _running_pair(self, arbiter):
+        """old holds 5 slots, young holds 3, pool (8) exhausted."""
+        old = arbiter.submit(_spec("old-lo", min_np=2, max_np=5))
+        young = arbiter.submit(_spec("young-lo", min_np=2, max_np=5))
+        arbiter.tick()
+        assert sum(old.allocation.values()) == 5
+        assert sum(young.allocation.values()) == 3
+        return old, young
+
+    def test_youngest_victim_yields_first(self, arbiter):
+        old, young = self._running_pair(arbiter)
+        hi = arbiter.submit(_spec("hi", min_np=1, max_np=1, priority=5))
+        arbiter.tick()
+        # need 1: the YOUNGEST low-tier job sheds it — the older one
+        # is untouched (tie-break: priority asc, submit_seq desc)
+        assert young.state == DRAINING
+        assert young.handle.shrink_requests == [2]
+        assert old.state == RUNNING and old.handle.shrink_requests == []
+        assert hi.state == PENDING  # gang waits for the drain
+
+    def test_preempt_spreads_across_victims_toward_min(self, arbiter):
+        old, young = self._running_pair(arbiter)
+        arbiter.submit(_spec("hi", min_np=4, max_np=4, priority=5))
+        arbiter.tick()
+        # need 4 = young's 1 (floor min_np=2) + old's 3
+        assert young.handle.shrink_requests == [2]
+        assert old.handle.shrink_requests == [2]
+
+    def test_never_below_min_reports_waiting(self, arbiter):
+        old, young = self._running_pair(arbiter)
+        hi = arbiter.submit(_spec("hi", min_np=5, max_np=5, priority=5))
+        arbiter.tick()
+        # reclaimable = 1+3 < 5: nobody is shrunk below min_np and the
+        # arrival reports once
+        assert old.state == RUNNING and young.state == RUNNING
+        assert old.handle.shrink_requests == []
+        assert hi.state == PENDING
+        waits = [f for k, f in arbiter.events if k == "job_waiting"]
+        assert len(waits) == 1 and waits[0]["missing"] == 1
+
+    def test_drain_resize_lifecycle_and_latency(self, arbiter,
+                                                fake_clock):
+        old, young = self._running_pair(arbiter)
+        hi = arbiter.submit(_spec("hi", min_np=4, max_np=4, priority=5))
+        arbiter.tick()
+        assert young.state == DRAINING and old.state == DRAINING
+        fake_clock.t += 1.0
+        young.handle.drain_lands()
+        old.handle.drain_lands()
+        arbiter.tick()
+        assert young.state == RESIZING
+        assert young.handle.drains == 1
+        fake_clock.t += 0.5
+        young.handle.relaunch()
+        old.handle.relaunch()
+        arbiter.tick()
+        assert young.state == RUNNING and old.state == RUNNING
+        assert young.preemptions == 1
+        assert young.charged_restarts == 0  # planned: no strike
+        assert old.charged_restarts == 0
+        assert young.shrink_deadline is None  # fields cleared
+        resized = [f for k, f in arbiter.events if k == "resized"]
+        assert resized and resized[0]["resize_s"] == pytest.approx(1.5)
+        # the freed gang admits the arrival
+        assert hi.state == RUNNING
+        assert sum(hi.allocation.values()) == 4
+
+    def test_grace_expiry_escalates_and_charges(self, arbiter,
+                                                fake_clock):
+        _old, young = self._running_pair(arbiter)
+        arbiter.submit(_spec("hi", min_np=1, max_np=1, priority=5))
+        arbiter.tick()
+        assert young.state == DRAINING
+        # the victim ignores its notices; the grace window lapses
+        fake_clock.t += 30.0
+        arbiter.tick()
+        assert young.handle.escalations == 1
+        assert "drain_grace_expired" in _kinds(arbiter)
+        arbiter.tick()
+        # the SIGTERM relaunch is a CHARGED restart, by design
+        assert young.charged_restarts == 1
+        assert young.state == RUNNING
+        # escalation fires exactly once per shrink
+        fake_clock.t += 60.0
+        arbiter.tick()
+        assert young.handle.escalations == 1
+
+    def test_shrink_retries_between_incarnations(self, arbiter):
+        # one shrinkable victim: the holder is already at its min
+        holder = arbiter.submit(_spec("holder", min_np=2, max_np=2))
+        vict = arbiter.submit(_spec("vict", min_np=2, max_np=6))
+        arbiter.tick()
+        assert sum(vict.allocation.values()) == 6
+        arbiter.submit(_spec("hi", min_np=4, max_np=4, priority=5))
+        vict.handle.refuse_shrink = True
+        arbiter.tick()
+        assert vict.state == RUNNING  # between incarnations: deferred
+        vict.handle.refuse_shrink = False
+        arbiter.tick()
+        assert vict.state == DRAINING
+        assert vict.handle.shrink_requests == [2]
+        assert holder.handle.shrink_requests == []
+
+    def test_equal_priority_never_preempts(self, arbiter):
+        old, young = self._running_pair(arbiter)
+        peer = arbiter.submit(_spec("peer", min_np=3, max_np=3))
+        arbiter.tick()
+        arbiter.tick()
+        assert peer.state == PENDING
+        assert old.handle.shrink_requests == []
+        assert young.handle.shrink_requests == []
+
+
+# ---------------------------------------------------------------------------
+# autoscaling: debounce / cooldown on the injected clock
+# ---------------------------------------------------------------------------
+
+
+class TestAutoscaler:
+    def test_debounce_requires_sustained_signal(self):
+        sig = {"v": 0.0}
+        asc = Autoscaler(lambda: sig["v"], high=10, low=2, step=2,
+                         debounce_s=5.0, cooldown_s=0.0)
+        sig["v"] = 50.0
+        assert asc.evaluate(now=100.0) is None  # first sighting arms
+        assert asc.evaluate(now=103.0) is None  # < debounce_s
+        sig["v"] = 5.0
+        assert asc.evaluate(now=104.0) is None  # dip resets the timer
+        sig["v"] = 50.0
+        assert asc.evaluate(now=105.0) is None
+        assert asc.evaluate(now=110.0) == ("grow", 2)
+
+    def test_cooldown_blocks_thrash(self):
+        asc = Autoscaler(lambda: 50.0, high=10, low=2, step=1,
+                         debounce_s=0.0, cooldown_s=20.0)
+        assert asc.evaluate(now=100.0) == ("grow", 1)
+        assert asc.evaluate(now=105.0) is None
+        assert asc.evaluate(now=119.9) is None
+        assert asc.evaluate(now=121.0) == ("grow", 1)
+
+    def test_shrink_on_low_watermark(self):
+        sig = {"v": 1.0}
+        asc = Autoscaler(lambda: sig["v"], high=10, low=2, step=3,
+                         debounce_s=4.0, cooldown_s=0.0)
+        assert asc.evaluate(now=10.0) is None
+        assert asc.evaluate(now=14.0) == ("shrink", 3)
+
+    def test_no_signal_resets_never_acts(self):
+        seq = iter([50.0, None, 50.0, 50.0])
+        asc = Autoscaler(lambda: next(seq), high=10, low=2,
+                         debounce_s=3.0, cooldown_s=0.0)
+        assert asc.evaluate(now=0.0) is None
+        assert asc.evaluate(now=10.0) is None  # None resets the arm
+        assert asc.evaluate(now=11.0) is None  # re-arms here
+        assert asc.evaluate(now=14.0) == ("grow", 1)
+
+    def test_inverted_watermarks_rejected(self):
+        with pytest.raises(ValueError, match="inverted"):
+            Autoscaler(lambda: None, high=1, low=2)
+
+    def test_file_signal(self, tmp_path):
+        p = tmp_path / "depth"
+        sig = FileSignal(str(p))
+        assert sig() is None  # absent file = no signal
+        p.write_text("42.5\n")
+        assert sig() == 42.5
+        p.write_text("not a number")
+        assert sig() is None
+
+    def test_from_spec_env_fallback(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("HVTPU_FLEET_AUTOSCALE_SIGNAL_FILE",
+                           raising=False)
+        assert Autoscaler.from_spec({"high": 2, "low": 1}) is None
+        monkeypatch.setenv("HVTPU_FLEET_AUTOSCALE_SIGNAL_FILE",
+                           str(tmp_path / "s"))
+        asc = Autoscaler.from_spec({"high": 2, "low": 1})
+        assert isinstance(asc.signal_fn, FileSignal)
+
+    def test_arbiter_grow_and_shrink(self, arbiter, fake_clock):
+        j = arbiter.submit(_spec("serve", min_np=2, max_np=6))
+        filler = arbiter.submit(_spec("filler", min_np=6, max_np=6))
+        arbiter.tick()
+        assert sum(j.allocation.values()) == 2  # filler contends
+        sig = {"v": 5.0}
+        arbiter.attach_autoscaler(
+            "serve", Autoscaler(lambda: sig["v"], high=10, low=2,
+                                step=2, debounce_s=1.0, cooldown_s=0.0))
+        filler.handle.exit(0)
+        arbiter.tick()
+        assert filler.state == DONE
+        # hot signal: grow by step after the debounce window
+        sig["v"] = 40.0
+        arbiter.tick()
+        fake_clock.t += 1.0
+        arbiter.tick()
+        assert sum(j.allocation.values()) == 4
+        assert j.handle.current_np() == 4
+        # cold signal: shrink rides the SAME planned-drain channel
+        sig["v"] = 1.0
+        fake_clock.t += 5.0
+        arbiter.tick()
+        fake_clock.t += 1.0
+        arbiter.tick()
+        assert j.state == DRAINING
+        assert j.handle.shrink_requests == [2]
+        grow = [f for k, f in arbiter.events
+                if k == "autoscale" and f["direction"] == "grow"]
+        shrink = [f for k, f in arbiter.events
+                  if k == "autoscale" and f["direction"] == "shrink"]
+        assert len(grow) == 1 and len(shrink) == 1
+
+    def test_grow_clamped_by_pool_and_max(self, arbiter, fake_clock):
+        j = arbiter.submit(_spec("serve", min_np=2, max_np=3))
+        arbiter.tick()
+        assert sum(j.allocation.values()) == 3  # start-time expansion
+        arbiter.attach_autoscaler(
+            "serve", Autoscaler(lambda: 99.0, high=10, low=2, step=4,
+                                debounce_s=0.0, cooldown_s=0.0))
+        arbiter.tick()
+        assert sum(j.allocation.values()) == 3  # max_np caps the grow
+
+
+# ---------------------------------------------------------------------------
+# spool protocol + CLI
+# ---------------------------------------------------------------------------
+
+
+def _write_spec(tmp_path, name="spooled", **overlay):
+    d = {"name": name, "command": ["run"], "min_np": 2, "max_np": 2}
+    d.update(overlay)
+    p = tmp_path / f"{name}.json"
+    p.write_text(json.dumps(d))
+    return str(p)
+
+
+@pytest.fixture
+def fleet_dir(tmp_path):
+    d = tmp_path / "fleet"
+    (d / "submit").mkdir(parents=True)
+    (d / "cancel").mkdir()
+    return d
+
+
+class TestSpoolProtocol:
+    def _arbiter(self, fleet_dir):
+        return FleetArbiter(_FakeDiscovery({"h1": 4}),
+                            fleet_dir=str(fleet_dir), tick_s=0.5,
+                            runner_factory=_FakeRunner,
+                            register_debug=False)
+
+    def test_spooled_spec_starts_and_state_published(self, fleet_dir,
+                                                     fake_clock):
+        arb = self._arbiter(fleet_dir)
+        spec = _write_spec(fleet_dir / "submit")
+        arb.tick()
+        assert not os.path.exists(spec)  # consumed
+        assert arb.jobs["spooled"].state == RUNNING
+        state = json.loads((fleet_dir / "state.json").read_text())
+        assert state["pool"]["slots_total"] == 4
+        assert state["jobs"][0]["name"] == "spooled"
+        assert state["jobs"][0]["state"] == "RUNNING"
+
+    def test_malformed_spool_rejected_with_error_file(self, fleet_dir,
+                                                      fake_clock):
+        arb = self._arbiter(fleet_dir)
+        _write_spec(fleet_dir / "submit", name="bad", min_np=0)
+        arb.tick()
+        assert "bad" not in arb.jobs
+        err = (fleet_dir / "rejected" / "bad.json.error").read_text()
+        assert "min_np" in err
+
+    def test_cancel_marker(self, fleet_dir, fake_clock):
+        arb = self._arbiter(fleet_dir)
+        _write_spec(fleet_dir / "submit")
+        arb.tick()
+        (fleet_dir / "cancel" / "spooled").write_text("cancel\n")
+        arb.tick()
+        assert arb.jobs["spooled"].handle.stopped
+
+
+class TestCLI:
+    def _main(self, *argv):
+        from tools.hvtpufleet.__main__ import main
+
+        return main(list(argv))
+
+    @pytest.mark.parametrize(
+        "field,overlay", _BAD_SPECS,
+        ids=[f"{f}-{i}" for i, (f, _) in enumerate(_BAD_SPECS)])
+    def test_submit_malformed_exits_2_naming_field(
+            self, tmp_path, capsys, field, overlay):
+        spec = _write_spec(tmp_path, **overlay)
+        rc = self._main("--fleet-dir", str(tmp_path), "submit",
+                        "--spec", spec)
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert f"field '{field}'" in err
+        # nothing reached the spool
+        assert not os.path.exists(
+            str(tmp_path / "submit" / "spooled.json"))
+
+    def test_submit_invalid_json_exits_2(self, tmp_path, capsys):
+        p = tmp_path / "broken.json"
+        p.write_text("{oops")
+        rc = self._main("--fleet-dir", str(tmp_path), "submit",
+                        "--spec", str(p))
+        assert rc == 2
+        assert "invalid JSON" in capsys.readouterr().err
+
+    def test_missing_fleet_dir_exits_2(self, tmp_path, capsys,
+                                       monkeypatch):
+        monkeypatch.delenv("HVTPU_FLEET_DIR", raising=False)
+        spec = _write_spec(tmp_path)
+        with pytest.raises(SystemExit) as ei:
+            self._main("submit", "--spec", spec)
+        assert ei.value.code == 2
+        assert "HVTPU_FLEET_DIR" in capsys.readouterr().err
+
+    def test_submit_spools_atomically(self, tmp_path, fleet_dir,
+                                      capsys):
+        spec = _write_spec(tmp_path, name="good", priority=4)
+        rc = self._main("--fleet-dir", str(fleet_dir), "submit",
+                        "--spec", spec)
+        assert rc == 0
+        assert "submitted 'good'" in capsys.readouterr().out
+        spooled = json.loads(
+            (fleet_dir / "submit" / "good.json").read_text())
+        assert spooled["priority"] == 4
+        # no half-written temp files left behind
+        assert [f for f in os.listdir(fleet_dir / "submit")
+                if f.endswith(".part")] == []
+
+    def test_list_without_server_exits_1(self, fleet_dir, capsys):
+        rc = self._main("--fleet-dir", str(fleet_dir), "list")
+        assert rc == 1
+        assert "no state" in capsys.readouterr().err
+
+    def test_list_renders_published_state(self, fleet_dir, fake_clock,
+                                          capsys):
+        arb = FleetArbiter(_FakeDiscovery({"h1": 4}),
+                           fleet_dir=str(fleet_dir),
+                           runner_factory=_FakeRunner,
+                           register_debug=False)
+        arb.submit(_spec("shown", min_np=2, max_np=2, priority=7))
+        arb.tick()
+        rc = self._main("--fleet-dir", str(fleet_dir), "list")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "4 slots" in out and "2 free" in out
+        assert re.search(r"shown\s+RUNNING\s+7\s+2", out)
+        rc = self._main("--fleet-dir", str(fleet_dir), "list", "--json")
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out)["jobs"][0][
+            "name"] == "shown"
+
+    def test_cancel_drops_marker(self, fleet_dir, capsys):
+        rc = self._main("--fleet-dir", str(fleet_dir), "cancel", "byejob")
+        assert rc == 0
+        assert (fleet_dir / "cancel" / "byejob").exists()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: two real elastic jobs, one pool, preemption via drain
+# ---------------------------------------------------------------------------
+
+_DELIVER_RE = re.compile(
+    r"DELIVER rank=(\d+) size=(\d+) gen=(\d+) epoch=(\d+) "
+    r"idx=\[([0-9, ]*)\]")
+
+
+def _job_env(deliver_log, epochs, samples, batch=4, sleep="0.25"):
+    return {
+        "PYTHONPATH": _REPO + os.pathsep + os.environ.get(
+            "PYTHONPATH", ""),
+        "HVTPU_CPU_DEVICES": "1",
+        "ELASTIC_EPOCHS": str(epochs),
+        "DATA_SAMPLES": str(samples),
+        "DATA_BATCH": str(batch),
+        "EPOCH_SLEEP": sleep,
+        "HVTPU_ELASTIC_DISCOVERY_INTERVAL": "0.2",
+        "FLEET_DELIVER_LOG": deliver_log,
+    }
+
+
+def _assert_exactly_once(deliver_log, epochs, samples, label):
+    text = open(deliver_log).read()
+    per_epoch = {e: [] for e in range(epochs)}
+    for m in _DELIVER_RE.finditer(text):
+        idx = [int(v) for v in m.group(5).split(",") if v.strip()]
+        per_epoch[int(m.group(4))].extend(idx)
+    for e in range(epochs):
+        got = sorted(per_epoch[e])
+        assert got == list(range(samples)), (
+            f"{label} epoch {e}: {len(got)} samples "
+            f"({len(set(got))} unique) — exactly-once violated")
+
+
+@pytest.mark.multiprocess
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_two_jobs_share_pool_preemption_drains_not_strikes(tmp_path):
+    """ISSUE-14 chaos acceptance: a low-priority job holds the whole
+    localhost pool; a high-priority arrival forces the arbiter to
+    reclaim half of it THROUGH THE DRAIN CHANNEL.  The victims exit
+    DRAIN_EXIT_CODE, the shrink costs no restart-budget strike (proven
+    by ``max_restarts=0`` — any charged relaunch would FAIL the job),
+    both jobs complete, and each delivers every sample of every epoch
+    exactly once."""
+    import time
+
+    script = os.path.join(_REPO, "tests", "fleet_data_script.py")
+    lo_log = str(tmp_path / "lo.deliver")
+    hi_log = str(tmp_path / "hi.deliver")
+    lo_epochs, lo_samples = 3, 32
+    hi_epochs, hi_samples = 2, 16
+    events = []
+    arb = FleetArbiter(
+        _FakeDiscovery({"localhost": 4}),
+        fleet_dir=str(tmp_path / "fleet"),
+        tick_s=0.3, drain_grace_s=60.0,
+        event_fn=lambda kind, **f: events.append(
+            (kind.replace("fleet.", "", 1), f)),
+        register_debug=False)
+    lo = arb.submit(JobSpec(
+        "lo", [sys.executable, script], priority=0, min_np=2, max_np=4,
+        max_restarts=0,
+        env=_job_env(lo_log, lo_epochs, lo_samples)))
+    deadline = time.time() + 300
+    hi = None
+    try:
+        while time.time() < deadline:
+            arb.tick()
+            if hi is None and os.path.exists(lo_log) and os.path.getsize(
+                    lo_log) > 0:
+                # lo is mid-training at np=4: the arrival preempts it
+                hi = arb.submit(JobSpec(
+                    "hi", [sys.executable, script], priority=10,
+                    min_np=2, max_np=2, max_restarts=0,
+                    env=_job_env(hi_log, hi_epochs, hi_samples)))
+            if arb.all_terminal():
+                break
+            time.sleep(0.3)
+    finally:
+        arb.close()
+    assert hi is not None, "lo never delivered a batch"
+    assert lo.state == DONE, (lo.state, lo.reason, events)
+    assert hi.state == DONE, (hi.state, hi.reason, events)
+    # the shrink went through the planned channel: a drain, no strike
+    assert lo.preemptions == 1
+    assert lo.handle.drains >= 1
+    assert lo.charged_restarts == 0 and hi.charged_restarts == 0
+    kinds = [k for k, _ in events]
+    assert "preempt" in kinds and "resized" in kinds
+    _assert_exactly_once(lo_log, lo_epochs, lo_samples, "lo")
+    _assert_exactly_once(hi_log, hi_epochs, hi_samples, "hi")

@@ -132,6 +132,23 @@ class TestFastChaosMatrix:
         assert brown["kv_retries"] > 0, "no injected error was retried"
         assert brown["audits"] == 3 * 256
 
+    def test_multi_job_arbiter_256(self):
+        # two jobs, one pool: the scenario itself asserts gang
+        # placement (never partial), per-job exactly-once accounting,
+        # and that every victim left through the drain channel (zero
+        # charged restarts).  Here we pin the external contract: both
+        # finish, the preemption shows up as planned exits, and the
+        # measured arbiter latencies are sane.
+        r = run_scenario("multi-job-arbiter", 256, seed=7)
+        pre = r["stats"]["phases"]["preempt"]
+        done = r["stats"]["phases"]["done"]
+        assert pre["victims"] == 128
+        assert pre["queue_wait_s"] > 0
+        assert 0 < pre["notice_to_commit_s"] < pre["resize_s"]
+        assert done["lo_final_np"] == 128 and done["hi_np"] == 128
+        assert r["stats"]["phases"]["inject"]["lo_incarnations"] == [
+            256, 256, 128]
+
     def test_stream_matrix_64(self):
         # split-burst + forced mispredict + membership-change-free
         # shutdown interleavings on the streamed plane; 256-rank and
@@ -153,7 +170,8 @@ def _dump(result):
 
 
 class TestDeterminism:
-    @pytest.mark.parametrize("name", ["steady-drain", "kill-blacklist"])
+    @pytest.mark.parametrize(
+        "name", ["steady-drain", "kill-blacklist", "multi-job-arbiter"])
     def test_same_seed_byte_identical(self, name):
         a = _dump(run_scenario(name, 64, seed=7))
         b = _dump(run_scenario(name, 64, seed=7))
@@ -169,7 +187,7 @@ class TestDeterminism:
         assert set(SCENARIOS) == {
             "thundering-rendezvous", "steady-drain", "rolling-preemption",
             "kill-blacklist", "kv-brownout", "straggler-tail",
-            "stream-matrix"}
+            "stream-matrix", "multi-job-arbiter"}
         with pytest.raises(KeyError, match="steady-drain"):
             run_scenario("no-such-scenario", 8)
 
@@ -196,6 +214,14 @@ class TestScale:
     def test_stream_matrix_256(self):
         r = run_scenario("stream-matrix", 256, seed=7)
         assert r["stats"]["phases"]["warmup"]["predicted_bursts"] > 0
+
+    def test_multi_job_arbiter_1024(self):
+        # acceptance scale: python -m tools.hvtpusim run
+        # multi-job-arbiter --ranks 1024 --seed 7
+        r = run_scenario("multi-job-arbiter", 1024, seed=7)
+        pre = r["stats"]["phases"]["preempt"]
+        assert pre["victims"] == 512
+        assert r["stats"]["phases"]["done"]["hi_np"] == 512
 
     def test_thundering_rendezvous_4096(self):
         r = run_scenario("thundering-rendezvous", 4096, seed=7)

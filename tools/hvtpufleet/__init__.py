@@ -1,0 +1,19 @@
+"""hvtpufleet — operator CLI for the hvtpu.fleet arbiter.
+
+Four subcommands against one fleet directory (``--fleet-dir`` /
+``HVTPU_FLEET_DIR``):
+
+- ``serve``   run a FleetArbiter over a discovery script, ticking until
+  interrupted (or ``--until-idle``).
+- ``submit``  validate a job-spec JSON CLIENT-SIDE (malformed specs
+  exit 2 naming the first bad field — nothing reaches the arbiter) and
+  drop it in the submit spool.
+- ``list``    print the arbiter's last published ``state.json``.
+- ``cancel``  drop a cancel marker for a named job.
+
+The transport is the repo's notice-file idiom: ``<fleet_dir>/submit/``
+and ``<fleet_dir>/cancel/`` spools consumed by the arbiter tick, and an
+atomically-replaced ``state.json`` published back.  No daemon socket,
+works over any shared filesystem, and the simulator exercises the same
+code paths without a network.
+"""

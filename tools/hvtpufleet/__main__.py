@@ -1,0 +1,162 @@
+"""CLI for the fleet arbiter (see package docstring)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+
+def _fleet_dir(args) -> str:
+    d = args.fleet_dir or os.environ.get("HVTPU_FLEET_DIR")
+    if not d:
+        print("hvtpufleet: --fleet-dir (or HVTPU_FLEET_DIR) is required",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return d
+
+
+def _cmd_serve(args) -> int:
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.fleet import FleetArbiter
+
+    d = _fleet_dir(args)
+    os.makedirs(os.path.join(d, "submit"), exist_ok=True)
+    os.makedirs(os.path.join(d, "cancel"), exist_ok=True)
+    arbiter = FleetArbiter(
+        HostDiscoveryScript(args.host_discovery_script),
+        fleet_dir=d,
+        tick_s=args.tick,
+        drain_grace_s=args.drain_grace,
+        verbose=not args.quiet,
+    )
+    try:
+        arbiter.run(until_idle=args.until_idle)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        arbiter.close()
+    if args.until_idle:
+        state = arbiter.debug_state()
+        failed = [j["name"] for j in state["jobs"]
+                  if j["state"] == "FAILED"]
+        if failed:
+            print(f"hvtpufleet: jobs failed: {', '.join(failed)}",
+                  file=sys.stderr)
+            return 1
+    return 0
+
+
+def _cmd_submit(args) -> int:
+    from horovod_tpu.fleet.job import FleetSpecError, JobSpec
+
+    # client-side validation: a malformed spec never reaches the spool
+    try:
+        spec = JobSpec.load(args.spec)
+    except FleetSpecError as e:
+        print(f"hvtpufleet: --spec: {e}", file=sys.stderr)
+        return 2
+    d = _fleet_dir(args)
+    spool = os.path.join(d, "submit")
+    os.makedirs(spool, exist_ok=True)
+    # atomic drop: the arbiter must never read a half-written spec
+    fd, tmp = tempfile.mkstemp(dir=spool, suffix=".part")
+    with os.fdopen(fd, "w") as f:
+        json.dump(spec.to_dict(), f, sort_keys=True, indent=1)
+    os.replace(tmp, os.path.join(spool, f"{spec.name}.json"))
+    print(f"hvtpufleet: submitted {spec.name!r} "
+          f"(priority={spec.priority}, min_np={spec.min_np})")
+    return 0
+
+
+def _cmd_list(args) -> int:
+    d = _fleet_dir(args)
+    path = os.path.join(d, "state.json")
+    try:
+        with open(path) as f:
+            state = json.load(f)
+    except OSError:
+        print(f"hvtpufleet: no state at {path} — is an arbiter "
+              f"serving this fleet dir?", file=sys.stderr)
+        return 1
+    if args.json:
+        json.dump(state, sys.stdout, sort_keys=True, indent=1)
+        print()
+        return 0
+    pool = state.get("pool", {})
+    print(f"pool: {pool.get('slots_total', 0)} slots "
+          f"({pool.get('slots_free', 0)} free) across "
+          f"{len(pool.get('hosts', {}))} hosts")
+    rows = [("JOB", "STATE", "PRI", "NP", "WAIT_S", "REASON")]
+    for j in state.get("jobs", []):
+        rows.append((
+            j.get("name", "?"), j.get("state", "?"),
+            str(j.get("priority", 0)),
+            str(sum((j.get("allocation") or {}).values())),
+            f"{j.get('queue_wait_s') or 0:.1f}",
+            (j.get("reason") or "")[:40],
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return 0
+
+
+def _cmd_cancel(args) -> int:
+    d = _fleet_dir(args)
+    spool = os.path.join(d, "cancel")
+    os.makedirs(spool, exist_ok=True)
+    with open(os.path.join(spool, args.name), "w") as f:
+        f.write("cancel\n")
+    print(f"hvtpufleet: cancel requested for {args.name!r}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hvtpufleet",
+        description="Operate a multi-job hvtpu fleet arbiter.")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="Fleet spool/state directory "
+                    "(default: $HVTPU_FLEET_DIR).")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("serve", help="Run the arbiter loop.")
+    s.add_argument("--host-discovery-script", required=True,
+                   help="Script printing 'host:slots' lines for the "
+                   "shared pool.")
+    s.add_argument("--tick", type=float, default=None,
+                   help="Arbiter tick period in seconds "
+                   "(default: $HVTPU_FLEET_TICK_SECONDS or 1).")
+    s.add_argument("--drain-grace", type=float, default=None,
+                   help="Seconds a preemption victim gets to drain "
+                   "before SIGTERM escalation (default: "
+                   "$HVTPU_FLEET_DRAIN_GRACE_SECONDS or 30).")
+    s.add_argument("--until-idle", action="store_true",
+                   help="Exit once every submitted job is terminal "
+                   "(nonzero if any FAILED).")
+    s.add_argument("--quiet", action="store_true")
+    s.set_defaults(fn=_cmd_serve)
+
+    s = sub.add_parser("submit", help="Validate and spool a job spec.")
+    s.add_argument("--spec", required=True,
+                   help="Path to the job-spec JSON.")
+    s.set_defaults(fn=_cmd_submit)
+
+    s = sub.add_parser("list", help="Show pool and job states.")
+    s.add_argument("--json", action="store_true",
+                   help="Raw state.json instead of the table.")
+    s.set_defaults(fn=_cmd_list)
+
+    s = sub.add_parser("cancel", help="Request cancellation of a job.")
+    s.add_argument("name", help="Job name to cancel.")
+    s.set_defaults(fn=_cmd_cancel)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,6 +122,81 @@ def bench_rows(ranks_list, seed: int = 0):
     return rows
 
 
+def fleet_bench_rows(ranks_list, seed: int = 0):
+    """Measured multi-job arbiter timings vs pool size: queue wait for
+    a gang-scheduled high-priority arrival, preemption notice → agreed
+    durable commit on the victim, and the victim's full resize latency
+    (drain + relaunch at the smaller world).  Virtual time on the
+    default healthy-link model."""
+    import logging
+
+    from horovod_tpu.sim.scenarios import multi_job_arbiter
+
+    # every simulated rank shares this process's logger, so the
+    # per-peer notice warning is O(ranks * victims) lines at 1024+ —
+    # half a million for a bench that reports five numbers
+    hvt_logger = logging.getLogger("horovod_tpu")
+    prior_level = hvt_logger.level
+    hvt_logger.setLevel(logging.ERROR)
+    try:
+        return _fleet_bench_rows(ranks_list, seed)
+    finally:
+        hvt_logger.setLevel(prior_level)
+
+
+def _fleet_bench_rows(ranks_list, seed):
+    from horovod_tpu.sim.scenarios import multi_job_arbiter
+
+    rows = []
+    for ranks in ranks_list:
+        ph = multi_job_arbiter(ranks, seed)["stats"]["phases"]
+        pre = ph["preempt"]
+        rows.append({
+            "ranks": ranks,
+            "queue_wait_s": round(pre["queue_wait_s"], 6),
+            "preempt_notice_to_commit_s": round(
+                pre["notice_to_commit_s"], 6),
+            "resize_s": round(pre["resize_s"], 6),
+            "victims": pre["victims"],
+            "measured": True,
+            "method": "fabric-sim virtual time, seed %d" % seed,
+        })
+        print(f"ranks={ranks}: queue wait {pre['queue_wait_s']:.3f} s, "
+              f"preempt notice→commit {pre['notice_to_commit_s']:.3f} s, "
+              f"resize {pre['resize_s']:.3f} s "
+              f"({pre['victims']} victims)", file=sys.stderr)
+    return rows
+
+
+def _cmd_bench_fleet(args) -> int:
+    ranks_list = [int(r) for r in args.ranks.split(",") if r.strip()]
+    rows = fleet_bench_rows(ranks_list, seed=args.seed)
+    print(json.dumps({"fleet_arbiter_sim": rows}, indent=1,
+                     sort_keys=True))
+    if args.update:
+        path = args.update
+        with open(path) as f:
+            doc = json.load(f)
+        doc["fleet_arbiter_sim"] = {
+            "note": (
+                "MEASURED on the fabric simulator: the real FleetArbiter "
+                "(horovod_tpu/fleet) arbitrating two jobs over one "
+                "virtual pool — a high-priority gang arrival preempts "
+                "half the low-priority world through the graceful-drain "
+                "channel (exit 79, zero budget strikes).  queue_wait_s "
+                "is submit → gang placement for the arrival; "
+                "preempt_notice_to_commit_s is drain notice → agreed "
+                "durable commit on the victim; resize_s is notice → "
+                "relaunch at the smaller world."),
+            "rows": rows,
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(f"updated {path}", file=sys.stderr)
+    return 0
+
+
 def _cmd_bench(args) -> int:
     ranks_list = [int(r) for r in args.ranks.split(",") if r.strip()]
     rows = bench_rows(ranks_list, seed=args.seed)
@@ -175,6 +250,15 @@ def main(argv=None) -> int:
         "--update", metavar="BENCH_SCALING.json",
         help="write the rows into this bench JSON")
     p_bench.set_defaults(fn=_cmd_bench)
+    p_fleet = sub.add_parser(
+        "bench-fleet", help="measured multi-job arbiter scaling rows")
+    p_fleet.add_argument(
+        "--ranks", default=",".join(str(r) for r in _BENCH_RANKS))
+    p_fleet.add_argument("--seed", type=int, default=0)
+    p_fleet.add_argument(
+        "--update", metavar="BENCH_SCALING.json",
+        help="write the rows into this bench JSON")
+    p_fleet.set_defaults(fn=_cmd_bench_fleet)
     args = ap.parse_args(argv)
     return args.fn(args)
 
