@@ -140,6 +140,16 @@ REQUIRED_METRIC_KEYS = (
     "hvtpu_step_exposed_comm_seconds",
     "hvtpu_step_overlap_fraction",
     "hvtpu_mfu",
+    # durable state plane (PR 15, core/durable.py): commit latency and
+    # bytes written by the crash-consistent checkpoint protocol,
+    # manifest-verification rejections (0 on a healthy run — nonzero
+    # means a torn/corrupt snapshot was caught and skipped), and
+    # restore-quorum rounds (one per elastic sync that consulted
+    # peers before picking a restore point).
+    "hvtpu_ckpt_commit_seconds",
+    "hvtpu_ckpt_bytes_written_total",
+    "hvtpu_ckpt_verify_failures_total",
+    "hvtpu_ckpt_restore_quorum_rounds_total",
 )
 
 

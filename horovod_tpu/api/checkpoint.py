@@ -19,6 +19,8 @@ API::
 from __future__ import annotations
 
 import atexit
+import hashlib
+import json
 import os
 import pickle
 import re
@@ -128,6 +130,8 @@ class Checkpointer:
                 else:
                     import shutil
 
+                    from ..core import durable as core_durable
+
                     # Stage into a FRESH .tmp: a leftover from a killed
                     # worker would otherwise leak its stale files into
                     # the final checkpoint (os.replace moves the whole
@@ -135,8 +139,22 @@ class Checkpointer:
                     tmp = target + ".tmp"
                     shutil.rmtree(tmp, ignore_errors=True)
                     os.makedirs(tmp)
-                    with open(os.path.join(tmp, "state.pkl"), "wb") as f:
-                        pickle.dump(payload, f)
+                    raw = pickle.dumps(payload)
+                    # fsync-then-rename + an integrity manifest inside
+                    # the staged dir (the durable commit protocol), so
+                    # a torn or bit-flipped state.pkl is rejected at
+                    # restore instead of silently unpickled
+                    core_durable.atomic_write(
+                        os.path.join(tmp, "state.pkl"), raw,
+                        detail=f"state.pkl@{step_dir_name(step)}")
+                    core_durable.atomic_write(
+                        os.path.join(tmp, core_durable.MANIFEST),
+                        json.dumps({
+                            "files": {"state.pkl": {
+                                "sha256": hashlib.sha256(raw).hexdigest(),
+                                "bytes": len(raw),
+                            }}}, sort_keys=True).encode(),
+                        detail=f"manifest@{step_dir_name(step)}")
                     # Overwrite semantics (orbax force=True parity)
                     # WITHOUT the lose-both window: os.replace of a
                     # directory onto an existing non-empty one raises
@@ -188,12 +206,26 @@ class Checkpointer:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    @staticmethod
+    def _verified(target: str) -> bool:
+        """Manifest verification of one step dir; steps written before
+        manifests existed (no MANIFEST.json) pass — there is nothing
+        recorded to check them against."""
+        from ..core import durable as core_durable
+
+        if not os.path.exists(os.path.join(target, core_durable.MANIFEST)):
+            return True
+        return core_durable.verify_snapshot(target)
+
     def restore(self, step: Optional[int] = None,
                 template: Optional[Dict[str, Any]] = None
                 ) -> Optional[Dict[str, Any]]:
         """Load ``step`` (default: newest); None when no checkpoint.
         ``template`` (a pytree of like-shaped arrays) enables orbax's
-        typed restoration."""
+        typed restoration.  A step failing manifest verification
+        (torn/corrupt) raises when it was requested explicitly and
+        falls back to the newest earlier intact step otherwise."""
+        explicit = step is not None
         if step is None:
             step = self.latest_step()
             if step is None:
@@ -208,6 +240,29 @@ class Checkpointer:
             # promoting the staged one — the rotated copy is the last
             # durable state; put it back
             os.replace(target + ".old", target)
+        if not os.path.isdir(target):
+            raise FileNotFoundError(
+                f"no checkpoint at step {step} under "
+                f"{self.directory!r}: neither {step_dir_name(step)} "
+                "nor its .old recovery copy exists")
+        if not self._verified(target):
+            if explicit:
+                raise ValueError(
+                    f"checkpoint step {step} under {self.directory!r} "
+                    "fails manifest verification (torn or corrupt)")
+            for s in reversed(self.all_steps()):
+                if s >= step:
+                    continue
+                if self._verified(self._step_dir(s)):
+                    print(f"hvtpu.Checkpointer: step {step} fails "
+                          f"manifest verification; falling back to "
+                          f"step {s}", file=sys.stderr)
+                    target = self._step_dir(s)
+                    break
+            else:
+                raise ValueError(
+                    f"every checkpoint under {self.directory!r} fails "
+                    "manifest verification")
         with open(os.path.join(target, "state.pkl"), "rb") as f:
             return pickle.load(f)
 

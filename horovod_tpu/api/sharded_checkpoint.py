@@ -37,6 +37,7 @@ step.  (Callers wanting the reference's rank-0 convention should use
 from __future__ import annotations
 
 import hashlib
+import io
 import json
 import os
 import re
@@ -46,6 +47,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from ..core import durable as core_durable
 from ..core import state as core_state
 from .checkpoint import list_steps, step_dir_name
 
@@ -137,24 +139,39 @@ class ShardedCheckpointer:
             })
             entries = []
             for fname, data, slices in pieces:
-                np.save(os.path.join(pieces_dir, fname), data)
-                entries.append({"file": fname, "slices": slices})
+                # serialize first so the manifest records the INTENDED
+                # hash/size — a torn/corrupt piece on disk then fails
+                # verify_step instead of being silently assembled
+                buf = io.BytesIO()
+                np.save(buf, data)
+                raw = buf.getvalue()
+                core_durable.atomic_write(
+                    os.path.join(pieces_dir, fname), raw,
+                    detail=f"{fname}@step{step}")
+                entries.append({
+                    "file": fname, "slices": slices,
+                    "sha256": hashlib.sha256(raw).hexdigest(),
+                    "bytes": len(raw),
+                })
             if entries:
                 manifest[key] = entries
         mpath = os.path.join(target, f"manifest_p{pid}.json")
-        with open(mpath + ".tmp", "w") as f:
-            json.dump(manifest, f)
-        os.replace(mpath + ".tmp", mpath)
+        core_durable.atomic_write(
+            mpath, json.dumps(manifest).encode(),
+            detail=f"manifest_p{pid}@step{step}")
 
         # 3. completion barrier, THEN the commit marker: a step dir
         #    without meta.json (some rank died mid-save) stays
-        #    invisible to all_steps/latest_step.
+        #    invisible to all_steps/latest_step.  meta.json rides the
+        #    same fsync-then-rename discipline — it is the commit
+        #    point, so a torn marker must be impossible, not merely
+        #    detectable.
         self._barrier(st)
         if st.rank == 0:
-            tmp = os.path.join(target, "meta.json.tmp")
-            with open(tmp, "w") as f:
-                json.dump(meta, f)
-            os.replace(tmp, os.path.join(target, "meta.json"))
+            core_durable.atomic_write(
+                os.path.join(target, "meta.json"),
+                json.dumps(meta).encode(),
+                detail=f"meta@step{step}")
         # and one more so no rank returns before the marker exists
         self._barrier(st)
 
@@ -165,6 +182,48 @@ class ShardedCheckpointer:
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()
         return steps[-1] if steps else None
+
+    def verify_step(self, step: int) -> bool:
+        """Integrity check of one step as THIS process sees it:
+        ``meta.json`` parses, every per-process manifest parses, and
+        every piece file matches its recorded sha256 + byte size.
+        Entries written before hashes existed (no ``sha256`` key) only
+        require the file to be present.  Failures count toward
+        ``hvtpu_ckpt_verify_failures_total``."""
+        target = self._step_dir(step)
+        try:
+            with open(os.path.join(target, "meta.json")) as f:
+                json.load(f)
+            names = os.listdir(target)
+        except (OSError, ValueError):
+            core_durable.note_verify_failure()
+            return False
+        for name in sorted(names):
+            if not (name.startswith("manifest_")
+                    and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(target, name)) as f:
+                    manifest = json.load(f)
+            except (OSError, ValueError):
+                core_durable.note_verify_failure()
+                return False
+            for entries in manifest.values():
+                for e in entries:
+                    p = os.path.join(target, "pieces", e["file"])
+                    try:
+                        with open(p, "rb") as f:
+                            raw = f.read()
+                    except OSError:
+                        core_durable.note_verify_failure()
+                        return False
+                    if "sha256" in e and (
+                            len(raw) != e.get("bytes")
+                            or hashlib.sha256(raw).hexdigest()
+                            != e["sha256"]):
+                        core_durable.note_verify_failure()
+                        return False
+        return True
 
     def restore(self, template, *, step: Optional[int] = None):
         """Rebuild the saved tree onto ``template``'s shardings.

@@ -15,8 +15,10 @@ Grammar (full reference in docs/robustness.md)::
     CLAUSE := SITE ":" ACTION ("@" SEL ("," SEL)*)?
     SITE   := kv.get | kv.put | heartbeat | collective.pre
             | collective.post | worker.step | data.next
+            | ckpt.write | ckpt.fsync | ckpt.rename
     ACTION := drop | delay(MS) | error | kill | preempt
             | corrupt | corrupt(nan) | corrupt(bitflip)
+            | torn | bitflip
     SEL    := rank=R[|R...] | pset=ID | count=N | prob=P | times=K
 
 Examples::
@@ -28,6 +30,10 @@ Examples::
     kv.put:error@prob=0.01               # 1% of KV writes fail (seeded)
     heartbeat:drop@rank=0,count=5,times=20   # beats 5..24 suppressed
     collective.pre:delay(250)@rank=2     # rank 2 lags every collective
+    ckpt.write:torn@prob=0.1             # 10% of snapshot payload
+                                         # writes truncated mid-file
+    ckpt.rename:kill@rank=0,count=2      # rank 0 dies at its 2nd
+                                         # commit-rename (torn commit)
 
 Selector semantics:
 
@@ -71,10 +77,21 @@ logger = logging.getLogger("horovod_tpu")
 #: (data/loader.py): ``delay`` stalls inside the DATA_WAIT trace span
 #: (an injected input straggler), ``drop`` loses one batch (the cursor
 #: advances past it), ``error`` surfaces a source failure.
+#: ``ckpt.write``/``ckpt.fsync``/``ckpt.rename`` are STORAGE sites in
+#: the durable commit protocol (core/durable.py): ``torn`` truncates
+#: the payload mid-write, ``bitflip`` flips one bit of the written
+#: bytes (both detected later by manifest verification), ``drop``
+#: suppresses the physical operation, ``kill`` dies mid-commit —
+#: exactly the host-loss-during-checkpoint failure the protocol must
+#: survive.
 SITES = ("kv.get", "kv.put", "heartbeat", "collective.pre",
-         "collective.post", "worker.step", "data.next")
+         "collective.post", "worker.step", "data.next",
+         "ckpt.write", "ckpt.fsync", "ckpt.rename")
 
-ACTIONS = ("drop", "delay", "error", "kill", "preempt", "corrupt")
+_STORAGE_SITES = ("ckpt.write", "ckpt.fsync", "ckpt.rename")
+
+ACTIONS = ("drop", "delay", "error", "kill", "preempt", "corrupt",
+           "torn", "bitflip")
 
 #: Module-level fast path: False means ``inject`` is never entered.
 ACTIVE = False
@@ -198,13 +215,18 @@ def parse_spec(spec: str) -> List[FaultClause]:
             action, delay_ms = "delay", float(m.group(1))
         elif mc:
             action, corrupt_mode = "corrupt", mc.group(1) or "nan"
-        elif action_s in ("drop", "error", "kill", "preempt"):
+        elif action_s in ("drop", "error", "kill", "preempt",
+                          "torn", "bitflip"):
             action = action_s
         else:
             raise FaultSpecError(
                 f"fault clause {raw!r}: unknown action {action_s!r} "
                 "(known: drop, delay(MS), error, kill, preempt, "
-                "corrupt[(nan|bitflip)])")
+                "corrupt[(nan|bitflip)], torn, bitflip)")
+        if action in ("torn", "bitflip") and site not in _STORAGE_SITES:
+            raise FaultSpecError(
+                f"fault clause {raw!r}: action {action!r} only applies "
+                f"at storage sites ({', '.join(_STORAGE_SITES)})")
         ranks = pset = prob = None
         count = 1
         # one-shot by default: a rank dies (kill) or departs (preempt)
@@ -304,15 +326,20 @@ class FaultRegistry:
                            "count to %s", path, exc_info=True)
 
     # -- the injection point -------------------------------------------
-    def _select(self, site: str, pset, tensor_site: bool
-                ) -> Optional[FaultClause]:
+    def _select(self, site: str, pset, tensor_site: bool,
+                storage_site: bool = False) -> Optional[FaultClause]:
         """First firing clause for ``site``.  ``corrupt`` clauses only
         fire at tensor sites (``inject_tensor``) — plain ``inject``
         call sites carry no data to poison, and silently consuming the
-        firing there would make the clause look like a no-op."""
+        firing there would make the clause look like a no-op.  The
+        same argument gates ``torn``/``bitflip`` to storage call sites
+        (``inject_storage``): only there is a byte stream to damage."""
         with self._lock:
             for clause in self._by_site.get(site, ()):
                 if clause.action == "corrupt" and not tensor_site:
+                    continue
+                if (clause.action in ("torn", "bitflip")
+                        and not storage_site):
                     continue
                 if clause.matches(self.rank, pset) and clause.should_fire():
                     return clause
@@ -364,6 +391,33 @@ class FaultRegistry:
         if fired is None:
             return False
         return self._execute(fired, site, detail)
+
+    def inject_storage(self, site: str, detail: Optional[str] = None
+                       ) -> Optional[str]:
+        """Storage-site injection point (``ckpt.*`` in the durable
+        commit protocol, core/durable.py).  Returns the damage the
+        caller must apply to the physical operation:
+
+        - ``"torn"`` — truncate the payload mid-write;
+        - ``"bitflip"`` — flip one bit of the written bytes;
+        - ``"drop"`` — suppress the operation entirely (an elided
+          fsync or rename IS a torn commit);
+        - ``None`` — proceed normally (after any delay; ``error``
+          raises, ``kill`` never returns)."""
+        fired = self._select(site, None, tensor_site=False,
+                             storage_site=True)
+        if fired is None:
+            return None
+        if fired.action in ("torn", "bitflip"):
+            self._persist_fired(fired)
+            logger.warning(
+                "hvtpu fault injection: %s storage damage [%s] at site "
+                "%s (rank %d%s)", fired.action, fired.source, site,
+                self.rank, f", op {detail}" if detail else "")
+            return fired.action
+        if self._execute(fired, site, detail):
+            return "drop"
+        return None
 
     def inject_tensor(self, site: str, tensor, pset=None,
                       detail: Optional[str] = None):
@@ -493,3 +547,16 @@ def inject_tensor(site: str, tensor, pset=None,
     if reg is None:
         return tensor
     return reg.inject_tensor(site, tensor, pset=pset, detail=detail)
+
+
+def inject_storage(site: str, detail: Optional[str] = None
+                   ) -> Optional[str]:
+    """Storage-site variant of :func:`inject` for the ``ckpt.*`` sites:
+    returns the damage mode the caller must apply (``"torn"`` /
+    ``"bitflip"`` / ``"drop"``) or None to proceed; ``delay`` sleeps,
+    ``error`` raises, ``kill`` never returns.  Checkpoint writes are
+    never a hot path, but callers still guard on ``faults.ACTIVE``."""
+    reg = _current()
+    if reg is None:
+        return None
+    return reg.inject_storage(site, detail=detail)

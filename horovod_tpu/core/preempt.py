@@ -343,6 +343,15 @@ class _DrainCoordinator:
         if self._exit_fn is not None:
             self._exit_fn(DRAIN_EXIT_CODE)
             return
+        # Drain any queued background checkpoint writes first: the
+        # drain commit may still be sitting in the durable writer's
+        # queue, and os._exit skips atexit hooks.
+        try:
+            from . import durable as core_durable
+
+            core_durable.quiesce_writers()
+        except Exception:
+            pass
         self._quiesce_data_loaders()
         try:
             from . import state as core_state

@@ -18,13 +18,17 @@ survey prescribes for TPU slices (orbax-style rank-0 checkpointing).
 from __future__ import annotations
 
 import copy
+import itertools
+import logging
 import os
 import pickle
-import tempfile
 from typing import Any, Callable, Dict, List, Optional
 
+from ..core import durable as core_durable
 from ..core import state as core_state
 from ..core.exceptions import HostsUpdatedInterrupt
+
+logger = logging.getLogger("horovod_tpu")
 
 
 def _state_dir() -> Optional[str]:
@@ -32,7 +36,47 @@ def _state_dir() -> Optional[str]:
 
 
 def _commit_path(dirname: str) -> str:
+    """Pre-durable-plane single-pickle location — READ-side compat
+    only: sync() falls back to it when a state dir holds no manifest-
+    committed snapshots (a job upgraded mid-flight)."""
     return os.path.join(dirname, "state_commit.pkl")
+
+
+#: Restore-quorum round counter: every rank calls sync() the same
+#: number of times (the collective contract), so a per-process counter
+#: yields matching namespaces without any extra coordination.
+_quorum_round = itertools.count()
+
+
+def _quorum_kv(st):
+    """The coordination KV for the restore quorum, wrapped in the
+    retry plane — None when no coordination service is up (single-
+    process runs, unit tests)."""
+    if not core_state._coordination_client_active():
+        return None
+    try:
+        from jax._src import distributed as _jd
+
+        client = _jd.global_state.client
+    except Exception:  # pragma: no cover - jax internals moved
+        return None
+    if client is None:
+        return None
+    from ..core.retry import resilient_kv
+
+    return resilient_kv(client, rank=st.rank)
+
+
+def _flush_durable_writes() -> None:
+    """Drain the background writer before any restore-side read: a
+    snapshot still in the queue is not yet on disk, and a write error
+    must surface before we decide what the latest durable commit is."""
+    try:
+        core_durable.shared_writer().flush()
+    except RuntimeError:
+        logger.warning("elastic state: background durable write failed; "
+                       "restoring from the last verified commit",
+                       exc_info=True)
 
 
 class State:
@@ -252,15 +296,43 @@ class ObjectState(State):
     def save_to_memory(self):
         self._saved = self._capture()
 
+    #: Monotonic durable-commit seq, seeded from disk on first save so
+    #: a relaunched incarnation continues the sequence instead of
+    #: overwriting the commits it must restore from.
+    _ckpt_seq = 0
+
     def save(self):
+        """Durable snapshot through the commit protocol
+        (core/durable.py): the payload is pickled HERE — the snapshot-
+        to-memory at the boundary — and the disk write (tmp → fsync →
+        rename → manifest-last) runs on the background writer unless
+        ``HVTPU_CKPT_ASYNC=0``."""
         self.save_to_memory()
         d = _state_dir()
         if d and core_state.global_state().rank == 0:
             os.makedirs(d, exist_ok=True)
-            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
-            with os.fdopen(fd, "wb") as f:
-                pickle.dump(self._to_disk_payload(), f)
-            os.replace(tmp, _commit_path(d))
+            payload = pickle.dumps(self._to_disk_payload())
+            if self._ckpt_seq == 0:
+                self._ckpt_seq = max(
+                    core_durable.list_snapshots(d), default=0)
+            self._ckpt_seq += 1
+            seq = self._ckpt_seq
+
+            def _write() -> None:
+                core_durable.write_snapshot(
+                    d, seq, {"state.pkl": payload})
+
+            if core_durable._async_enabled():
+                core_durable.shared_writer().submit(_write)
+            else:
+                _write()
+
+    def wait_durable(self):
+        """Block until every queued background durable write is on
+        disk; re-raises a captured write error.  The drain and reset
+        exits quiesce the writer themselves — this is for callers that
+        need read-your-writes (tests, external checkpoint shippers)."""
+        core_durable.shared_writer().flush()
 
     def restore(self):
         """Roll back to the last commit (parity: State.restore after
@@ -268,18 +340,59 @@ class ObjectState(State):
         self._apply(copy.deepcopy(self._saved))
         self.on_reset()
 
+    def _quorum_agree(self, local_best: Optional[int]) -> Optional[int]:
+        """Min-agree ``local_best`` across ranks over the coordination
+        KV.  A quorum failure degrades to this rank's local best —
+        safe because only rank 0's pick is loaded and its broadcast
+        carries the payload to everyone (divergence-impossible by
+        construction; the quorum exists so rank 0 never picks a commit
+        a peer does not have durable)."""
+        st = core_state.global_state()
+        if st.size <= 1:
+            return local_best
+        kv = _quorum_kv(st)
+        round_no = next(_quorum_round)
+        if kv is None:
+            return local_best
+        gen = os.environ.get("HVTPU_ELASTIC_GENERATION", "0") or "0"
+        try:
+            return core_durable.restore_quorum(
+                kv, rank=st.rank, size=st.size, local_best=local_best,
+                namespace=f"hvtpu/ckpt/quorum/{gen}/{round_no}")
+        except Exception:  # noqa: BLE001 — degrade, never diverge
+            logger.warning(
+                "elastic state: restore quorum failed; falling back to "
+                "this rank's local best commit", exc_info=True)
+            return local_best
+
+    def _agree_restore_seq(self, d: str) -> Optional[int]:
+        """The restore point: each rank's highest locally-VERIFIED
+        commit (torn/corrupt snapshots discarded by manifest
+        verification), min-agreed across ranks."""
+        _flush_durable_writes()
+        return self._quorum_agree(core_durable.latest_verified(d))
+
     def sync(self):
-        """Make every rank identical: after a restart, load the durable
-        commit (if any) on rank 0, then broadcast rank 0's payload
-        (parity: ObjectState.sync broadcasting from rank 0)."""
+        """Make every rank identical: after a restart, agree on the
+        restore commit (verify manifests, discard torn/corrupt
+        snapshots, KV quorum on the highest commit durable
+        EVERYWHERE), load it on rank 0, then broadcast rank 0's
+        payload (parity: ObjectState.sync broadcasting from rank 0)."""
         from ..api import functions as api_functions
 
         st = core_state.require_init("elastic state sync")
-        if st.rank == 0:
-            d = _state_dir()
-            if d and os.path.exists(_commit_path(d)) and not self._synced:
-                with open(_commit_path(d), "rb") as f:
-                    self._from_disk_payload(pickle.load(f))
+        d = _state_dir()
+        if d and not self._synced:
+            agreed = self._agree_restore_seq(d)
+            if st.rank == 0:
+                if agreed is not None:
+                    files = core_durable.read_snapshot(d, agreed)
+                    self._from_disk_payload(
+                        pickle.loads(files["state.pkl"]))
+                elif os.path.exists(_commit_path(d)):
+                    # pre-durable-plane layout (job upgraded mid-run)
+                    with open(_commit_path(d), "rb") as f:
+                        self._from_disk_payload(pickle.load(f))
         payload = api_functions.broadcast_object(
             self._capture(), root_rank=0
         )
@@ -369,11 +482,10 @@ class ShardedJaxState(JaxState):
     broadcast path.
 
     Commit is collective (all ranks call ``commit()`` at the same
-    boundary — already the elastic contract); the two newest commits
-    are retained.
+    boundary — already the elastic contract); the newest
+    ``HVTPU_CKPT_KEEP`` commits are retained.
     """
 
-    _KEEP_COMMITS = 2
     # every process writes its shards: the durable save is collective,
     # so the commit policy may not promote it at a pending resize (the
     # SIGUSR1 flag is not rank-synchronous)
@@ -427,16 +539,39 @@ class ShardedJaxState(JaxState):
         step = api_functions.broadcast_object(step, root_rank=0)
         ckpt.save(step, arrays)
         if st.rank == 0:
-            fd, tmp = tempfile.mkstemp(dir=_state_dir(), suffix=".tmp")
-            with os.fdopen(fd, "wb") as f:
-                pickle.dump({"step": step, "rest": rest,
-                             "array_attrs": sorted(arrays)}, f)
-            os.replace(tmp, _commit_path(_state_dir()))
-            # retention: drop all but the newest _KEEP_COMMITS steps
+            # the rest-pickle commits through the durable protocol as
+            # snapshot seq == step: its manifest-last rename is what
+            # makes step N restorable (the shard write above already
+            # barriered, so a commit here is never ahead of its pieces)
+            payload = pickle.dumps({"step": step, "rest": rest,
+                                    "array_attrs": sorted(arrays)})
+            core_durable.write_snapshot(
+                _state_dir(), step, {"sharded_rest.pkl": payload})
+            # retention: drop shard steps beyond the HVTPU_CKPT_KEEP
+            # window (write_snapshot already GC'd the rest commits)
             import shutil
 
-            for s in ckpt.all_steps()[:-self._KEEP_COMMITS]:
+            keep = core_durable._keep()
+            for s in ckpt.all_steps()[:-keep]:
                 shutil.rmtree(ckpt._step_dir(s), ignore_errors=True)
+
+    def _local_best_sharded(self, d: str) -> Optional[int]:
+        """Highest step whose rest-commit AND this rank's view of the
+        sharded pieces both pass manifest verification — each rank
+        vouches for the shards it can actually read, which is exactly
+        what the quorum needs to agree on a step restorable
+        EVERYWHERE."""
+        from ..api.sharded_checkpoint import ShardedCheckpointer
+
+        ckpt = ShardedCheckpointer(d)
+        root = _state_dir()
+        for seq in reversed(core_durable.list_snapshots(root)):
+            if not core_durable.verify_snapshot(
+                    core_durable.snapshot_path(root, seq)):
+                continue
+            if ckpt.verify_step(seq):
+                return seq
+        return None
 
     def sync(self):
         from ..api import functions as api_functions
@@ -444,17 +579,27 @@ class ShardedJaxState(JaxState):
 
         st = core_state.require_init("elastic state sync")
         d = self._sharded_dir()
-        # Rank 0 ALONE decides the branch and broadcasts it: a per-rank
-        # os.path.exists over a shared filesystem can disagree across
-        # hosts (NFS attribute caches), and divergent branches would
-        # desync the collective sequence — some ranks inside the
-        # restore's make_array_from_callback, others not.
+        # Every rank verifies its own view and votes; rank 0 ALONE
+        # loads the agreed step and broadcasts the decision: a
+        # per-rank os.path.exists over a shared filesystem can
+        # disagree across hosts (NFS attribute caches), and divergent
+        # branches would desync the collective sequence — some ranks
+        # inside the restore's make_array_from_callback, others not.
+        agreed = None
+        if d and not self._synced:
+            _flush_durable_writes()
+            agreed = self._quorum_agree(self._local_best_sharded(d))
         if st.rank == 0:
             disk = None
-            if d and not self._synced and os.path.exists(
-                    _commit_path(_state_dir())):
-                with open(_commit_path(_state_dir()), "rb") as f:
-                    disk = pickle.load(f)
+            if d and not self._synced:
+                if agreed is not None:
+                    files = core_durable.read_snapshot(
+                        _state_dir(), agreed)
+                    disk = pickle.loads(files["sharded_rest.pkl"])
+                elif os.path.exists(_commit_path(_state_dir())):
+                    # pre-durable-plane layout (job upgraded mid-run)
+                    with open(_commit_path(_state_dir()), "rb") as f:
+                        disk = pickle.load(f)
             msg = {"disk": disk}
         else:
             msg = None

@@ -167,6 +167,14 @@ def _exit_for_reset(reason: str):
         file=sys.stderr,
         flush=True,
     )
+    # os._exit skips atexit hooks: flush queued background checkpoint
+    # writes now or the last durable commit may never reach disk.
+    try:
+        from ..core import durable as core_durable
+
+        core_durable.quiesce_writers()
+    except Exception:
+        pass
     try:
         core_state.shutdown()
     except Exception:

@@ -252,7 +252,7 @@ class FleetArbiter:
             h = j.handle
             if h is None:
                 continue
-            j.charged_restarts = h.charged_restarts
+            j.charged_restarts = j.restarts_base + h.charged_restarts
             code = h.poll()
             if code is not None:
                 j.exit_code = code
@@ -446,6 +446,62 @@ class FleetArbiter:
                             np=new_np, signal=asc.last_signal)
                 self._start_shrink(j, new_np, reason="autoscale")
 
+    # -- crash recovery ---------------------------------------------------
+    def recover(self) -> int:
+        """Resume from a previous arbiter incarnation's ``state.json``:
+        every non-terminal job is resubmitted as PENDING with its
+        restart/preemption accounting restored.  Worker processes were
+        children of the dead arbiter, so there is nothing to adopt —
+        the next tick gang-launches each recovered job afresh and its
+        elastic state dir (the durable commit plane) makes the resume
+        exact.  Terminal jobs stay forgotten (their record lives in
+        the event log).  Returns the number of jobs recovered; a
+        missing or unreadable state.json recovers nothing."""
+        d = self.fleet_dir
+        if not d:
+            return 0
+        try:
+            with open(os.path.join(d, "state.json")) as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            return 0
+        recovered = 0
+        with self._lock:
+            for row in state.get("jobs", []):
+                if not isinstance(row, dict) or row.get("state") in (
+                        DONE, FAILED):
+                    continue
+                spec_d = row.get("spec")
+                if not isinstance(spec_d, dict):
+                    # a pre-spec state.json (older arbiter): the job
+                    # cannot be reconstructed — surface, don't guess
+                    self._event("recover_skipped",
+                                job=str(row.get("name")),
+                                error="state.json row carries no spec")
+                    continue
+                try:
+                    spec = JobSpec.from_dict(spec_d)
+                except FleetSpecError as e:
+                    self._event("recover_skipped",
+                                job=str(row.get("name")),
+                                error=str(e)[:300])
+                    continue
+                existing = self.jobs.get(spec.name)
+                if existing is not None and not existing.terminal:
+                    continue  # already resubmitted (idempotent recover)
+                job = self._submit_locked(spec)
+                try:
+                    job.preemptions = int(row.get("preemptions") or 0)
+                    job.restarts_base = int(
+                        row.get("charged_restarts") or 0)
+                    job.charged_restarts = job.restarts_base
+                except (TypeError, ValueError):
+                    pass
+                recovered += 1
+                self._event("recover", job=job.name,
+                            prior_state=row.get("state"))
+        return recovered
+
     # -- spool protocol (CLI ↔ arbiter) ----------------------------------
     def _intake_spool(self) -> None:  # hvtpulint: requires(_lock)
         d = self.fleet_dir
@@ -458,9 +514,26 @@ class FleetArbiter:
                     continue
                 path = os.path.join(sub, fn)
                 try:
-                    self._submit_locked(JobSpec.load(path))
+                    spec = JobSpec.load(path)
                 except FleetSpecError as e:
                     self._reject(fn, str(e))
+                else:
+                    existing = self.jobs.get(spec.name)
+                    if (existing is not None and not existing.terminal
+                            and existing.spec.to_dict()
+                            == spec.to_dict()):
+                        # this exact submit already landed — an
+                        # arbiter that crashed between intake and
+                        # unlink (or recover() beat the spool to it).
+                        # Consume the file instead of rejecting the
+                        # live job's own spec as a duplicate.
+                        self._event("spool_duplicate", spool=fn,
+                                    job=spec.name)
+                    else:
+                        try:
+                            self._submit_locked(spec)
+                        except FleetSpecError as e:
+                            self._reject(fn, str(e))
                 try:
                     os.unlink(path)
                 except OSError:

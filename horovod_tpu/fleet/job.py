@@ -297,6 +297,10 @@ class Job:
         self.exit_code: Optional[int] = None
         self.preemptions = 0     # arbiter-initiated planned shrinks
         self.charged_restarts = 0  # budget-charged relaunches observed
+        # pre-crash counter recovered from state.json: runner handles
+        # count from zero each arbiter incarnation, so _reap reports
+        # restarts_base + handle.charged_restarts
+        self.restarts_base = 0
         # pending planned shrink: grace deadline — expiry escalates to
         # a charged restart via handle.escalate()
         self.shrink_deadline: Optional[float] = None
@@ -337,6 +341,10 @@ class Job:
         h = self.handle
         out = {
             "name": self.name,
+            # the full spec rides along so a restarted arbiter can
+            # resubmit non-terminal jobs from state.json alone
+            # (FleetArbiter.recover)
+            "spec": self.spec.to_dict(),
             "state": self.state,
             "priority": self.spec.priority,
             "min_np": self.spec.min_np,

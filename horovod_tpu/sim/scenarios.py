@@ -54,6 +54,22 @@ multi-job-arbiter         the REAL FleetArbiter sharing one pool
                           (exit-79 victims, zero charged restarts),
                           gang start of the high job, and per-job
                           exactly-once sample accounting.
+checkpoint-storm          every rank runs the real durable commit
+                          protocol (core/durable.py) with injected
+                          ``ckpt.write`` torn/bitflip damage on two
+                          victims' final commit, then storms the
+                          restore path: manifest verification + the
+                          KV restore quorum.  Asserts the agreed
+                          restore point is the min over per-rank
+                          maxima, durable everywhere, and damage only
+                          ever lowers the pick.
+compression-negotiation   mixed-precision negotiation through the
+                          real controller: a dense fp32 allreduce
+                          plus an int8-compressed sidecar per cycle.
+                          Asserts every rank sees the identical
+                          negotiated schedule with the sidecar at the
+                          int8 wire dtype, never fused into the fp32
+                          burst.
 ========================  =============================================
 """
 
@@ -1248,6 +1264,268 @@ def multi_job_arbiter(ranks: int, seed: int = 0, *, lo_steps: int = 8,
 
 
 # ---------------------------------------------------------------------------
+# checkpoint-storm: the durable state plane under storage chaos
+# ---------------------------------------------------------------------------
+
+def checkpoint_storm(ranks: int, seed: int = 0, *, commits: int = 4,
+                     payload_kb: int = 8, compute_s: float = 0.05,
+                     disk_base_s: float = 0.002,
+                     disk_bps: float = 200e6,
+                     torn_rank: Optional[int] = None,
+                     bitflip_rank: Optional[int] = None) -> Dict:
+    """Every rank runs the REAL durable commit protocol
+    (core/durable.py) against its own state directory, with injected
+    storage damage on two victims' FINAL commit: one torn write (the
+    commit never lands — its manifest is truncated) and one bit flip
+    (the commit LOOKS landed and only hash verification can reject
+    it).  Then all ranks storm the restore path at once: verify local
+    snapshots, publish the highest verified seq, and run the
+    restore quorum over the simulated KV.  Asserts the agreed restore
+    point is the min over per-rank maxima, is durable on EVERY rank,
+    and that neither damaged snapshot is ever picked — a victim's
+    damage lowers the pick, never diverges it."""
+    import shutil as _shutil
+    import tempfile
+
+    from ..core import durable as core_durable
+
+    if torn_rank is None:
+        torn_rank = max(1, ranks // 4)
+    if bitflip_rank is None:
+        bitflip_rank = max(2, ranks // 2)
+    assert torn_rank != bitflip_rank, "victims must differ"
+    kernel, fabric = _fresh(ranks, seed)
+    # each commit is two atomic_writes (payload, then manifest); the
+    # final commit's payload is ckpt.write invocation 2*commits-1
+    last_payload = 2 * commits - 1
+    root = tempfile.mkdtemp(prefix="hvtpu-ckpt-storm-")
+    commit_t: List[float] = []
+    quorum_t: List[float] = []
+    best: Dict[int, Optional[int]] = {}
+    agreed: Dict[int, Optional[int]] = {}
+
+    def make(rank: int):
+        if rank == torn_rank:
+            # torn payload AND (via unlimited times) torn manifest of
+            # the final commit: the commit point is never reached
+            spec = f"ckpt.write:torn@rank={rank},count={last_payload}"
+        elif rank == bitflip_rank:
+            # one flipped bit in the final payload, manifest intact:
+            # the snapshot parses as committed, verification rejects it
+            spec = (f"ckpt.write:bitflip@rank={rank},"
+                    f"count={last_payload},times=1")
+        else:
+            spec = ""
+
+        def body():
+            d = os.path.join(root, f"rank{rank}")
+            ctx = RankContext(kernel, rank, ranks, fault_spec=spec)
+            size_b = payload_kb * 1024
+            with ctx.activate():
+                for seq in range(1, commits + 1):
+                    kernel.sleep(compute_s)
+                    stamp = f"{seed}/{rank}/{seq}/".encode()
+                    data = (stamp * (size_b // len(stamp) + 1))[:size_b]
+                    t0 = kernel.now
+                    # modeled disk latency (real writes land on tmpfs
+                    # in zero virtual time)
+                    kernel.sleep(disk_base_s + len(data) / disk_bps)
+                    core_durable.write_snapshot(
+                        d, seq, {"state.pkl": data}, fsync=False)
+                    commit_t.append(kernel.now - t0)
+                    kernel.log("ckpt_commit", rank=rank, seq=seq)
+                # the restore storm: every rank verifies its local
+                # snapshots and votes; min over votes is the pick
+                lb = core_durable.latest_verified(d)
+                best[rank] = lb
+                kernel.log("ckpt_local_best", rank=rank,
+                           best=-1 if lb is None else lb)
+                t1 = kernel.now
+                a = core_durable.restore_quorum(
+                    fabric.client(rank, caps="str"), rank=rank,
+                    size=ranks, local_best=lb,
+                    namespace="hvtpu/ckpt/quorum/0/0", timeout_s=600.0)
+                agreed[rank] = a
+                quorum_t.append(kernel.now - t1)
+                kernel.log("ckpt_quorum", rank=rank,
+                           agreed=-1 if a is None else a)
+        return body
+
+    try:
+        with _env(HVTPU_CKPT_KEEP="2", HVTPU_CKPT_FSYNC="0"):
+            for r in range(ranks):
+                kernel.spawn(f"rank{r}", make(r))
+            kernel.run(max_virtual_s=_DEF_BUDGET_S)
+
+        assert len(agreed) == ranks, "some ranks never finished"
+        # undamaged ranks verified their final commit; both victims
+        # fell back to the previous one
+        for r in range(ranks):
+            want = commits - 1 if r in (torn_rank, bitflip_rank) \
+                else commits
+            assert best[r] == want, (
+                f"rank {r} local best {best[r]}, expected {want}")
+        # the torn victim's final attempt is visibly UNcommitted; the
+        # bitflip victim's is committed-but-rejected (hash mismatch)
+        torn_d = core_durable.snapshot_path(
+            os.path.join(root, f"rank{torn_rank}"), commits)
+        assert core_durable._committed(torn_d) is None, (
+            "torn final commit must not reach the commit point")
+        flip_d = core_durable.snapshot_path(
+            os.path.join(root, f"rank{bitflip_rank}"), commits)
+        assert core_durable._committed(flip_d) is not None, (
+            "bitflip leaves the manifest intact")
+        assert not core_durable.verify_snapshot(flip_d), (
+            "bit-flipped payload must fail hash verification")
+        # agreement: one value, the min over per-rank maxima, durable
+        # (verified) on every rank — the damage delayed the pick, it
+        # never diverged it
+        picks = set(agreed.values())
+        assert picks == {commits - 1}, (
+            f"ranks disagree on the restore point: {sorted(picks)}")
+        for r in range(ranks):
+            p = core_durable.snapshot_path(
+                os.path.join(root, f"rank{r}"), commits - 1)
+            assert core_durable.verify_snapshot(p), (
+                f"agreed commit {commits - 1} not durable on rank {r}")
+    finally:
+        _shutil.rmtree(root, ignore_errors=True)
+
+    commit_s = sorted(commit_t)
+    quorum_s = sorted(quorum_t)
+    stats = {"phases": {
+        "commit": {
+            "commits": len(commit_t),
+            "payload_kb": payload_kb,
+            "commit_p50_s": round(_pct(commit_s, 0.50), 9),
+            "commit_p99_s": round(_pct(commit_s, 0.99), 9),
+            "commit_max_s": round(commit_s[-1], 9) if commit_s else 0.0,
+        },
+        "restore_quorum": {
+            "agreed_seq": commits - 1,
+            "torn_rank": torn_rank,
+            "bitflip_rank": bitflip_rank,
+            "quorum_p50_s": round(_pct(quorum_s, 0.50), 9),
+            "quorum_max_s": round(quorum_s[-1], 9) if quorum_s else 0.0,
+            "virtual_s": round(kernel.now, 6),
+        }}, "kv_ops": dict(fabric.ops)}
+    return _result("checkpoint-storm", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
+# compression-negotiation: int8-sidecar agreement through the real
+# controller
+# ---------------------------------------------------------------------------
+
+def compression_negotiation(ranks: int, seed: int = 0, *,
+                            cycles: int = 4) -> Dict:
+    """Mixed-precision negotiation through the REAL EagerController
+    over the simulated KVTransport: every rank enqueues a dense fp32
+    allreduce AND an int8-compressed sidecar (EQuARX-style) each
+    cycle.  The wire dtype is the fusion/caching signature, so the
+    coordinator must keep the two streams apart and every rank must
+    see the SAME negotiated response schedule — int8 ops at the int8
+    wire dtype, never fused into the fp32 burst.  Asserts identical
+    per-rank schedules and that every future resolves."""
+    from ..comm.compression import Int8Compressor
+    from ..eager.controller import EagerController, KVTransport
+    from ..native import wire
+
+    kernel, fabric = _fresh(ranks, seed)
+    int8_id = wire.DTYPE_IDS["int8"]
+    schedules: Dict[int, List] = {}
+    cycle_times: Dict[int, List[float]] = {}
+
+    def make(rank: int):
+        def body():
+            ctx = RankContext(kernel, rank, ranks)
+            transport = KVTransport(
+                rank, ranks, client=fabric.client(rank, caps="bytes"),
+                timeout_s=600.0, poll_s=1.0)
+            ctrl = EagerController(rank, ranks, transport=transport,
+                                   cycle_time_ms=1.0, manual=True)
+            sched = schedules[rank] = []
+            times = cycle_times.setdefault(rank, [])
+            # spy on the execution dispatch: the one choke point every
+            # released ResponseList passes through on BOTH the manual
+            # lockstep and the streamed plane — what lands here IS the
+            # schedule this rank will execute
+            orig = ctrl._dispatch_execution
+
+            def spy(rl, finished):
+                for rs in rl.responses:
+                    if rs.type == wire.ALLREDUCE:
+                        sched.append((tuple(rs.tensor_names), rs.dtype))
+                return orig(rl, finished)
+
+            ctrl._dispatch_execution = spy
+            with ctx.activate():
+                for cycle in range(cycles):
+                    t0 = kernel.now
+                    dense = ctrl.enqueue(
+                        "allreduce", [1.0, float(rank)],
+                        name=f"dense.{cycle}")
+                    sidecar = ctrl.enqueue(
+                        "allreduce", [0.5, float(rank), -1.0, 2.0],
+                        name=f"sidecar.{cycle}",
+                        compression=Int8Compressor)
+                    ctrl.run_cycle_once()
+                    for fut in (dense, sidecar):
+                        assert fut.done(), (
+                            f"rank {rank} cycle {cycle}: future "
+                            "unresolved after the lockstep cycle")
+                        fut.result(timeout=0)
+                    times.append(kernel.now - t0)
+                    kernel.log("negotiated", rank=rank, cycle=cycle)
+                ctrl.request_shutdown()
+                while not ctrl._shutdown_seen.is_set():
+                    ctrl.run_cycle_once()
+                ctrl.stop()
+        return body
+
+    with patch_data_plane(), _env(HVTPU_EAGER_STREAM=None):
+        for r in range(ranks):
+            kernel.spawn(f"rank{r}", make(r))
+        kernel.run(max_virtual_s=_DEF_BUDGET_S)
+
+    assert len(schedules) == ranks, "some ranks never negotiated"
+    # agreement: byte-identical negotiated schedule on every rank
+    base = schedules[0]
+    for r in range(1, ranks):
+        assert schedules[r] == base, (
+            f"rank {r} negotiated a different schedule:\n"
+            f"  rank 0: {base}\n  rank {r}: {schedules[r]}")
+    # the int8 sidecars crossed the wire at the int8 dtype, one
+    # response per cycle, and never shared a response with fp32 ops
+    sidecars = [s for s in base
+                if any(n.startswith("sidecar.") for n in s[0])]
+    assert len(sidecars) == cycles, (
+        f"expected {cycles} sidecar responses, got {sidecars}")
+    for names, dtype in sidecars:
+        assert dtype == int8_id, (
+            f"sidecar response {names} at wire dtype {dtype}, "
+            f"expected int8 ({int8_id})")
+        assert all(n.startswith("sidecar.") for n in names), (
+            f"int8 sidecar fused with non-int8 ops: {names}")
+    dense = [s for s in base
+             if any(n.startswith("dense.") for n in s[0])]
+    assert len(dense) == cycles and all(
+        d != int8_id for _, d in dense), (
+        f"dense fp32 stream polluted by the sidecar: {dense}")
+
+    all_times = sorted(t for ts in cycle_times.values() for t in ts)
+    stats = {"phases": {"negotiate": {
+        "cycles": cycles,
+        "sidecar_responses": len(sidecars),
+        "cycle_p50_s": round(_pct(all_times, 0.50), 9),
+        "cycle_max_s": round(all_times[-1], 9) if all_times else 0.0,
+        "virtual_s": round(kernel.now, 6),
+    }}, "kv_ops": dict(fabric.ops)}
+    return _result("compression-negotiation", ranks, seed, kernel,
+                   stats)
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1260,6 +1538,8 @@ SCENARIOS = {
     "straggler-tail": straggler_tail,
     "stream-matrix": stream_matrix,
     "multi-job-arbiter": multi_job_arbiter,
+    "checkpoint-storm": checkpoint_storm,
+    "compression-negotiation": compression_negotiation,
 }
 
 

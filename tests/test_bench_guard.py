@@ -105,6 +105,18 @@ class TestMetricsEmbedding:
         assert "hvtpu_data_batches_delivered_total" in required
         assert "hvtpu_data_samples_delivered_total" in required
 
+    def test_required_keys_cover_durable_state_plane(self, bench):
+        required = set(bench.REQUIRED_METRIC_KEYS)
+        assert {"hvtpu_ckpt_commit_seconds",
+                "hvtpu_ckpt_bytes_written_total",
+                "hvtpu_ckpt_verify_failures_total",
+                "hvtpu_ckpt_restore_quorum_rounds_total"} <= required
+        # histogram condenses to {count, sum}; counters to scalars
+        m = bench.condense_metrics({})
+        assert m["hvtpu_ckpt_commit_seconds"] == {"count": 0,
+                                                 "sum": 0.0}
+        assert m["hvtpu_ckpt_verify_failures_total"] == 0
+
     def test_report_embeds_data_stall_row(self, bench):
         report = bench.build_report(metric="m", value=1.0, unit="u",
                                     elapsed_seconds=10.0)
@@ -346,3 +358,44 @@ class TestFleetArbiterSimSchema:
             assert row["preempt_notice_to_commit_s"] < row["resize_s"]
             # half the low-priority world is reclaimed for the arrival
             assert row["victims"] == row["ranks"] // 2
+
+
+class TestCheckpointStormSimSchema:
+    """BENCH_SCALING.json carries MEASURED durable-state-plane rows
+    from the fabric simulator (tools/hvtpusim bench-ckpt): commit
+    latency through the real commit protocol and restore-quorum
+    latency at 64-1024 virtual ranks.  These back the
+    docs/robustness.md durable-plane latency claims."""
+
+    REQUIRED_ROW_KEYS = {
+        "ranks", "commit_p50_s", "commit_p99_s", "quorum_p50_s",
+        "quorum_max_s", "agreed_seq", "measured", "method",
+    }
+
+    @pytest.fixture
+    def doc(self):
+        with open(os.path.join(_ROOT, "BENCH_SCALING.json")) as f:
+            return json.load(f)
+
+    def test_measured_rows_present_and_complete(self, doc):
+        sim = doc["checkpoint_storm_sim"]
+        assert "measured" in sim["note"].lower()
+        rows = sim["rows"]
+        assert {r["ranks"] for r in rows} >= {64, 256, 1024}
+        for row in rows:
+            assert self.REQUIRED_ROW_KEYS <= set(row), row.get("ranks")
+            assert row["measured"] is True
+            assert "fabric-sim" in row["method"]
+
+    def test_timings_are_finite_positive_virtual_seconds(self, doc):
+        for row in doc["checkpoint_storm_sim"]["rows"]:
+            for key in ("commit_p50_s", "commit_p99_s", "quorum_p50_s",
+                        "quorum_max_s"):
+                v = row[key]
+                assert isinstance(v, (int, float)) and 0 < v < 3600, (
+                    f"ranks={row['ranks']} {key}={v!r}")
+            assert row["commit_p50_s"] <= row["commit_p99_s"]
+            assert row["quorum_p50_s"] <= row["quorum_max_s"]
+            # both storage victims fell back one commit: the agreed
+            # restore point is commits-1 (the scenario default is 4)
+            assert row["agreed_seq"] == 3

@@ -91,7 +91,8 @@ class TestCheckpointer:
         ckpt.save(7, {"v": jnp.asarray(3.0)})
         ckpt.wait()
         target = d / "step_000000000007"
-        assert sorted(os.listdir(target)) == ["state.pkl"]
+        assert sorted(os.listdir(target)) == ["MANIFEST.json",
+                                              "state.pkl"]
         assert float(np.asarray(ckpt.restore(7)["v"])) == 3.0
         assert not stale.exists()
 
@@ -115,6 +116,55 @@ class TestCheckpointer:
         assert float(np.asarray(ckpt.restore(7)["v"])) == 1.0
         # the recovery also put the directory back for listing
         assert ckpt.all_steps() == [7]
+
+    def test_restore_missing_step_and_old_raises_clear_error(
+            self, hvt, tmp_path):
+        """An explicit step with neither its dir nor the .old recovery
+        copy present must fail with a diagnostic naming both, not an
+        opaque open() traceback from deeper in the loader."""
+        import jax.numpy as jnp
+
+        ckpt = hvt.Checkpointer(str(tmp_path / "ck"), use_orbax=False)
+        ckpt.save(7, {"v": jnp.asarray(1.0)})
+        ckpt.wait()
+        with pytest.raises(FileNotFoundError) as ei:
+            ckpt.restore(5)
+        msg = str(ei.value)
+        assert "step 5" in msg and ".old" in msg
+
+    def test_restore_corrupt_explicit_step_raises(self, hvt, tmp_path):
+        """A bit-flipped state.pkl behind an intact manifest is
+        rejected when that step was requested explicitly."""
+        import jax.numpy as jnp
+
+        d = tmp_path / "ck"
+        ckpt = hvt.Checkpointer(str(d), use_orbax=False)
+        ckpt.save(3, {"v": jnp.asarray(1.0)})
+        ckpt.wait()
+        p = d / "step_000000000003" / "state.pkl"
+        raw = bytearray(p.read_bytes())
+        raw[len(raw) // 2] ^= 0x01
+        p.write_bytes(bytes(raw))
+        with pytest.raises(ValueError, match="manifest verification"):
+            ckpt.restore(3)
+
+    def test_restore_latest_falls_back_past_corrupt_step(self, hvt,
+                                                         tmp_path):
+        """Latest-step restore skips a corrupt newest checkpoint and
+        loads the previous retained one."""
+        import jax.numpy as jnp
+
+        d = tmp_path / "ck"
+        ckpt = hvt.Checkpointer(str(d), use_orbax=False)
+        ckpt.save(1, {"v": jnp.asarray(1.0)})
+        ckpt.save(2, {"v": jnp.asarray(2.0)})
+        ckpt.wait()
+        p = d / "step_000000000002" / "state.pkl"
+        raw = bytearray(p.read_bytes())
+        raw[len(raw) // 2] ^= 0x01
+        p.write_bytes(bytes(raw))
+        out = ckpt.restore()
+        assert float(np.asarray(out["v"])) == 1.0
 
     def test_async_save_overlaps(self, hvt, tmp_path):
         import jax.numpy as jnp

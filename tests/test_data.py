@@ -224,6 +224,7 @@ class TestLoader:
         it = iter(ld)
         first = [next(it)["y"].tolist() for _ in range(3)]
         st.save()
+        st.wait_durable()
         ld.close()
 
         ld2 = _make_loader(n=24, batch=4, seed=9)
@@ -231,9 +232,13 @@ class TestLoader:
         try:
             import pickle
 
-            with open(os.path.join(str(tmp_path), "state_commit.pkl"),
-                      "rb") as f:
-                st2._from_disk_payload(pickle.load(f))
+            from horovod_tpu.core import durable as core_durable
+
+            seq = core_durable.latest_verified(str(tmp_path))
+            assert seq is not None
+            payload = core_durable.read_snapshot(
+                str(tmp_path), seq)["state.pkl"]
+            st2._from_disk_payload(pickle.loads(payload))
             assert ld2.state.cursor == 12 and ld2.state.seed == 9
             rest = [v for b in ld2 for v in b["y"].tolist()]
             flat = [v for b in first for v in b] + rest

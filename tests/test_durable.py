@@ -1,0 +1,304 @@
+"""Unit tests for the durable state plane (core/durable.py).
+
+Covers the PR 15 commit protocol in isolation: manifest-last
+ordering, torn/bitflip detection, retention GC, the restore quorum
+against a fake KV, and the background writer's error-surfacing
+contract.  The end-to-end chaos runs (kill mid-commit under a real
+2-proc elastic job) live in test_faults.py; the 256-1024 virtual-rank
+storm lives in test_sim.py.
+"""
+
+import os
+import pickle
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.core import durable
+from horovod_tpu.core import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def _files(n=1, size=256):
+    return {f"f{i}.pkl": bytes([i]) * size for i in range(n)}
+
+
+# ---------------------------------------------------------------------------
+# atomic_write + commit protocol
+# ---------------------------------------------------------------------------
+
+
+class TestAtomicWrite:
+    def test_roundtrip_leaves_no_tmp(self, tmp_path):
+        p = str(tmp_path / "blob")
+        n = durable.atomic_write(p, b"hello", fsync=False)
+        assert n == 5
+        assert open(p, "rb").read() == b"hello"
+        assert sorted(os.listdir(tmp_path)) == ["blob"]
+
+    def test_overwrite_is_atomic_replace(self, tmp_path):
+        p = str(tmp_path / "blob")
+        durable.atomic_write(p, b"one", fsync=False)
+        durable.atomic_write(p, b"two", fsync=False)
+        assert open(p, "rb").read() == b"two"
+
+
+class TestCommitProtocol:
+    def test_write_read_roundtrip(self, tmp_path):
+        root = str(tmp_path)
+        files = _files(3)
+        d = durable.write_snapshot(root, 7, files, fsync=False)
+        assert os.path.isdir(d)
+        assert durable.latest_verified(root) == 7
+        assert durable.read_snapshot(root, 7) == files
+
+    def test_manifest_written_last_is_the_commit_point(self, tmp_path):
+        # one payload file = ckpt.write invocation 1 is the payload,
+        # invocation 2 the manifest.  Tear the manifest: the payload
+        # is intact on disk yet the snapshot is NOT committed —
+        # proving the manifest is the commit point.
+        root = str(tmp_path)
+        faults.install("ckpt.write:torn@count=2", rank=0)
+        durable.write_snapshot(root, 1, _files(1), fsync=False)
+        faults.uninstall()
+        d = durable.snapshot_path(root, 1)
+        assert os.path.exists(os.path.join(d, "f0.pkl"))
+        assert durable._committed(d) is None
+        assert durable.latest_verified(root) is None
+        with pytest.raises(FileNotFoundError):
+            durable.read_snapshot(root, 1)
+
+    def test_torn_payload_rejected_by_verification(self, tmp_path):
+        root = str(tmp_path)
+        durable.write_snapshot(root, 1, _files(1), fsync=False)
+        # invocation 1 = the payload of seq 2; its manifest (written
+        # after the tear) records the INTENDED hash, so verification
+        # catches the damage even though the commit "landed"
+        faults.install("ckpt.write:torn@count=1,times=1", rank=0)
+        durable.write_snapshot(root, 2, _files(1), fsync=False)
+        faults.uninstall()
+        d2 = durable.snapshot_path(root, 2)
+        assert durable._committed(d2) is not None
+        assert not durable.verify_snapshot(d2)
+        # ...and restore walks down to the last good commit
+        assert durable.latest_verified(root) == 1
+
+    def test_bitflip_rejected_by_verification(self, tmp_path):
+        root = str(tmp_path)
+        durable.write_snapshot(root, 1, _files(1), fsync=False)
+        faults.install("ckpt.write:bitflip@count=1,times=1", rank=0)
+        durable.write_snapshot(root, 2, _files(1), fsync=False)
+        faults.uninstall()
+        d2 = durable.snapshot_path(root, 2)
+        # a single flipped bit: sizes match, only the hash catches it
+        assert durable._committed(d2) is not None
+        assert not durable.verify_snapshot(d2)
+        assert durable.latest_verified(root) == 1
+
+    def test_elided_rename_leaves_uncommitted_tmp(self, tmp_path):
+        root = str(tmp_path)
+        faults.install("ckpt.rename:drop@count=2", rank=0)
+        durable.write_snapshot(root, 3, _files(1), fsync=False)
+        faults.uninstall()
+        d = durable.snapshot_path(root, 3)
+        assert os.path.exists(
+            os.path.join(d, durable.MANIFEST + ".tmp"))
+        assert durable._committed(d) is None
+
+    def test_verify_failure_counts_metric(self, tmp_path):
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        root = str(tmp_path)
+        d = durable.write_snapshot(root, 1, _files(1), fsync=False)
+        with open(os.path.join(d, "f0.pkl"), "ab") as f:
+            f.write(b"x")
+        def count():
+            fam = obs_metrics.snapshot().get(
+                "hvtpu_ckpt_verify_failures_total", {})
+            return fam.get("values", {}).get("", 0.0)
+
+        before = count()
+        assert not durable.verify_snapshot(d)
+        assert count() == before + 1
+
+    def test_rewrite_of_same_seq_starts_clean(self, tmp_path):
+        root = str(tmp_path)
+        durable.write_snapshot(root, 1, _files(2), fsync=False)
+        durable.write_snapshot(root, 1, {"only.pkl": b"z"}, fsync=False)
+        assert durable.read_snapshot(root, 1) == {"only.pkl": b"z"}
+
+
+class TestRetention:
+    def test_gc_keeps_newest_k_commits(self, tmp_path):
+        root = str(tmp_path)
+        for seq in range(5):
+            durable.write_snapshot(root, seq, _files(1), fsync=False,
+                                   keep=2)
+        assert durable.list_snapshots(root) == [3, 4]
+
+    def test_gc_spares_inflight_newer_than_newest_commit(self, tmp_path):
+        root = str(tmp_path)
+        durable.write_snapshot(root, 1, _files(1), fsync=False, keep=1)
+        # an in-flight (uncommitted) attempt newer than every commit
+        os.makedirs(durable.snapshot_path(root, 9))
+        durable.gc_snapshots(root, keep=1)
+        assert durable.list_snapshots(root) == [1, 9]
+        # once seq 10 commits, the dead seq-9 leftover is collected
+        durable.write_snapshot(root, 10, _files(1), fsync=False, keep=1)
+        assert durable.list_snapshots(root) == [10]
+
+    def test_keep_knob_default(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HVTPU_CKPT_KEEP", "3")
+        root = str(tmp_path)
+        for seq in range(6):
+            durable.write_snapshot(root, seq, _files(1), fsync=False)
+        assert durable.list_snapshots(root) == [3, 4, 5]
+
+
+# ---------------------------------------------------------------------------
+# restore quorum
+# ---------------------------------------------------------------------------
+
+
+class _FakeKV:
+    """Pre-seeded coordination KV: peers' votes are already published."""
+
+    def __init__(self, votes=None):
+        self.store = dict(votes or {})
+        self.sets = []
+
+    def key_value_set(self, key, value):
+        self.sets.append((key, value))
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key not in self.store:
+            raise TimeoutError(f"timed out waiting for {key}")
+        return self.store[key]
+
+
+class TestRestoreQuorum:
+    NS = "hvtpu/ckpt/quorum/0/0"
+
+    def _votes(self, *bests):
+        return {f"{self.NS}/vote/{r}": str(v)
+                for r, v in enumerate(bests)}
+
+    def test_unanimous(self):
+        kv = _FakeKV(self._votes(5, 5, 5))
+        assert durable.restore_quorum(
+            kv, rank=0, size=3, local_best=5, namespace=self.NS) == 5
+
+    def test_straggler_lowers_the_pick_never_diverges_it(self):
+        votes = self._votes(5, 3, 5)
+        picks = {
+            r: durable.restore_quorum(
+                _FakeKV(votes), rank=r, size=3,
+                local_best=[5, 3, 5][r], namespace=self.NS)
+            for r in range(3)
+        }
+        assert set(picks.values()) == {3}
+
+    def test_any_empty_rank_yields_none(self):
+        kv = _FakeKV(self._votes(5, -1, 5))
+        assert durable.restore_quorum(
+            kv, rank=0, size=3, local_best=5, namespace=self.NS) is None
+
+    def test_local_none_votes_minus_one(self):
+        kv = _FakeKV()
+        assert durable.restore_quorum(
+            kv, rank=0, size=1, local_best=None,
+            namespace=self.NS) is None
+        assert kv.sets == [(f"{self.NS}/vote/0", "-1")]
+
+    def test_timeout_propagates_to_caller(self):
+        kv = _FakeKV(self._votes(5))  # peer 1 never votes
+        with pytest.raises(TimeoutError):
+            durable.restore_quorum(
+                kv, rank=0, size=2, local_best=5, namespace=self.NS,
+                timeout_s=0.01)
+
+
+# ---------------------------------------------------------------------------
+# background writer
+# ---------------------------------------------------------------------------
+
+
+class TestDurableWriter:
+    def test_flush_waits_for_queued_writes(self, tmp_path):
+        w = durable.DurableWriter(maxsize=4)
+        done = []
+        gate = threading.Event()
+
+        def work():
+            gate.wait(5)
+            done.append(1)
+
+        w.submit(work)
+        gate.set()
+        w.flush()
+        assert done == [1]
+        w.close()
+
+    def test_error_surfaces_on_next_flush(self):
+        w = durable.DurableWriter(maxsize=4)
+
+        def boom():
+            raise OSError("disk on fire")
+
+        w.submit(boom)
+        with pytest.raises(RuntimeError, match="background write"):
+            w.flush()
+        # the error is consumed: the writer is usable again
+        w.flush()
+        w.close()
+
+    def test_error_surfaces_on_next_submit(self):
+        w = durable.DurableWriter(maxsize=4)
+
+        def boom():
+            raise OSError("disk on fire")
+
+        w.submit(boom)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                w.submit(lambda: None)
+            except RuntimeError:
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("queued error never surfaced on submit")
+        w.close()
+
+    def test_close_is_idempotent_and_rejects_submits(self):
+        w = durable.DurableWriter(maxsize=4)
+        w.submit(lambda: None)
+        w.close()
+        w.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            w.submit(lambda: None)
+
+    def test_shared_writer_recreated_after_quiesce(self):
+        a = durable.shared_writer()
+        assert durable.shared_writer() is a
+        durable.quiesce_writers()
+        b = durable.shared_writer()
+        assert b is not a
+        durable.quiesce_writers()
+
+    def test_quiesce_never_raises(self):
+        w = durable.shared_writer()
+
+        def boom():
+            raise OSError("late failure")
+
+        w.submit(boom)
+        durable.quiesce_writers()  # must swallow, not raise

@@ -93,11 +93,17 @@ class TestObjectState:
 
     def test_commit_persists_to_state_dir(self, hvt, tmp_path,
                                           monkeypatch):
+        from horovod_tpu.core import durable as core_durable
+
         monkeypatch.setenv("HVTPU_ELASTIC_STATE_DIR", str(tmp_path))
         state = elastic.ObjectState(epoch=0)
         state.epoch = 4
         state.commit()
-        assert (tmp_path / "state_commit.pkl").exists()
+        state.wait_durable()
+        # the commit landed as a manifest-verified snapshot under
+        # commits/ (write-tmp → fsync → rename, manifest last)
+        seq = core_durable.latest_verified(str(tmp_path))
+        assert seq is not None
         # a fresh state syncs from the durable commit
         state2 = elastic.ObjectState(epoch=0)
         state2.sync()
@@ -127,30 +133,40 @@ class TestObjectState:
         EVERY commit."""
         import pickle
 
+        from horovod_tpu.core import durable as core_durable
+
         monkeypatch.setenv("HVTPU_ELASTIC_STATE_DIR", str(tmp_path))
         state = elastic.ObjectState(epoch=0)
         state.set_commit_policy(every_n_commits=3)
-        path = tmp_path / "state_commit.pkl"
+
+        def disk_epoch():
+            state.wait_durable()
+            seq = core_durable.latest_verified(str(tmp_path))
+            if seq is None:
+                return None
+            payload = core_durable.read_snapshot(
+                str(tmp_path), seq)["state.pkl"]
+            return pickle.loads(payload)["epoch"]
 
         state.epoch = 1
         state.commit()   # count 1: memory only
-        assert not path.exists()
+        assert disk_epoch() is None
         # rollback still lands on the newest (memory) commit
         state.epoch = 99
         state.restore()
         assert state.epoch == 1
         state.epoch = 2
         state.commit()   # count 2: memory only
-        assert not path.exists()
+        assert disk_epoch() is None
         state.epoch = 3
         state.commit()   # count 3: durable
-        assert pickle.loads(path.read_bytes())["epoch"] == 3
+        assert disk_epoch() == 3
         state.epoch = 4
         state.commit()   # count 4: memory only — disk stays at 3
-        assert pickle.loads(path.read_bytes())["epoch"] == 3
+        assert disk_epoch() == 3
         # explicit save() is the unconditional escape hatch
         state.save()
-        assert pickle.loads(path.read_bytes())["epoch"] == 4
+        assert disk_epoch() == 4
 
     def test_commit_policy_validates(self, hvt):
         state = elastic.ObjectState(epoch=0)
@@ -165,20 +181,26 @@ class TestObjectState:
         before raising HostsUpdatedInterrupt (rank-local states)."""
         import pickle
 
+        from horovod_tpu.core import durable as core_durable
         from horovod_tpu.elastic.state import _HostUpdateFlag
 
         monkeypatch.setenv("HVTPU_ELASTIC_STATE_DIR", str(tmp_path))
         state = elastic.ObjectState(epoch=0)
         state.set_commit_policy(every_n_commits=10)
-        path = tmp_path / "state_commit.pkl"
         state.epoch = 1
         state.commit()
-        assert not path.exists()  # throttled
+        state.wait_durable()
+        assert core_durable.latest_verified(str(tmp_path)) is None
         state.epoch = 2
         _HostUpdateFlag.instance().set()
         with pytest.raises(elastic.HostsUpdatedInterrupt):
             state.commit()
-        assert pickle.loads(path.read_bytes())["epoch"] == 2
+        state.wait_durable()
+        seq = core_durable.latest_verified(str(tmp_path))
+        assert seq is not None
+        payload = core_durable.read_snapshot(
+            str(tmp_path), seq)["state.pkl"]
+        assert pickle.loads(payload)["epoch"] == 2
 
     def test_host_update_flag_raises_at_commit(self, hvt):
         from horovod_tpu.elastic.state import _HostUpdateFlag

@@ -58,6 +58,27 @@ class TestParse:
         assert cs[2].corrupt_mode == "nan"
         assert cs[1].site == "collective.post"
 
+    def test_storage_grammar(self):
+        cs = faults.parse_spec(
+            "ckpt.write:torn@rank=1,count=3; "
+            "ckpt.write:bitflip@count=5,times=1; "
+            "ckpt.fsync:drop; "
+            "ckpt.rename:kill@rank=0,count=2")
+        assert [c.site for c in cs] == [
+            "ckpt.write", "ckpt.write", "ckpt.fsync", "ckpt.rename"]
+        assert cs[0].action == "torn" and cs[0].times == 0  # unlimited
+        assert cs[1].action == "bitflip" and cs[1].times == 1
+        assert cs[3].action == "kill" and cs[3].times == 1
+
+    @pytest.mark.parametrize("bad", [
+        "kv.put:torn",              # torn only means something on bytes
+        "worker.step:bitflip",
+        "collective.pre:torn@rank=1",
+    ])
+    def test_storage_damage_limited_to_storage_sites(self, bad):
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_spec(bad)
+
     def test_empty_spec_yields_nothing(self):
         assert faults.parse_spec("") == []
         assert faults.parse_spec(" ; ; ") == []
@@ -205,6 +226,27 @@ class TestInjectionSites:
         assert faults.inject("kv.put") is False
         assert faults.inject("kv.put") is False
 
+    def test_storage_clause_never_fires_at_plain_inject(self):
+        """A torn clause outside inject_storage has no byte stream to
+        damage; plain inject() must neither fire nor spend budget
+        (same argument as corrupt at non-tensor sites)."""
+        faults.install("ckpt.write:torn@times=1", rank=0)
+        assert faults.inject("ckpt.write") is False
+        assert faults.inject_storage("ckpt.write") == "torn"
+
+    def test_inject_storage_damage_modes(self):
+        faults.install(
+            "ckpt.write:bitflip@times=1; ckpt.fsync:drop@times=1",
+            rank=0)
+        assert faults.inject_storage("ckpt.write") == "bitflip"
+        assert faults.inject_storage("ckpt.write") is None  # spent
+        assert faults.inject_storage("ckpt.fsync") == "drop"
+
+    def test_inject_storage_error_raises(self):
+        faults.install("ckpt.write:error@times=1", rank=0)
+        with pytest.raises(faults.InjectedFault):
+            faults.inject_storage("ckpt.write")
+
     def test_bitflip_corrupts_non_float_dtypes(self):
         import jax.numpy as jnp
         import numpy as np
@@ -325,3 +367,92 @@ def test_chaos_kv_error_burst_job_survives(tmp_path):
     out = res.stdout + res.stderr
     assert res.returncode == 0, out[-3000:]
     assert "DONE size=2 epoch=4" in out, out[-3000:]
+
+
+# ---------------------------------------------------------------------------
+# acceptance (PR 15): kill mid-commit at each storage site — every rank
+# recovers to the last FULLY-durable commit, never a torn/corrupt one
+# ---------------------------------------------------------------------------
+
+
+def _launch_storage_chaos(tmp_path, fault_spec, epochs=5, timeout=240):
+    """2-proc elastic run with the durable commit protocol under the
+    given storage fault spec.  Epoch N's snapshot is commit N, and each
+    commit is exactly two ckpt.write/fsync/rename invocations (payload,
+    then manifest), so count=3 targets commit 2's payload op."""
+    from conftest import make_discovery_script
+
+    _hosts, disc = make_discovery_script(tmp_path, "localhost:2")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_EPOCHS"] = str(epochs)
+    env["EPOCH_SLEEP"] = "0.2"
+    env["HVTPU_ELASTIC_DISCOVERY_INTERVAL"] = "0.2"
+    cmd = [
+        sys.executable, "-m", "horovod_tpu.runner",
+        "--host-discovery-script", disc,
+        "--min-np", "2", "--cpu-devices", "1", "--verbose",
+        "--max-restarts", "3",
+        "--fault-spec", fault_spec,
+        "--", sys.executable, _SCRIPT,
+    ]
+    res = subprocess.run(cmd, env=env, cwd=_REPO, timeout=timeout,
+                         capture_output=True, text=True)
+    return res, res.stdout + res.stderr
+
+
+def _assert_rolled_back_to_last_durable(res, out):
+    assert res.returncode == 0, out[-4000:]
+    assert "fault injection: killing rank 0" in out, out[-4000:]
+    # rank 0 (the ObjectState writer rank) died mid-commit of epoch
+    # 2's snapshot, so the last fully-durable commit is epoch 1.  The
+    # restore quorum must land every rank there — never on the torn
+    # attempt — which replays epoch 1: the epoch-1 line prints twice.
+    assert out.count("EPOCH epoch=1 ") == 2, out[-4000:]
+    assert "DONE size=2 epoch=5" in out, out[-4000:]
+    assert out.count("launching 2 workers") == 2, out[-4000:]
+
+
+@pytest.mark.multiprocess
+def test_kill_mid_commit_at_ckpt_write_recovers(tmp_path):
+    """Tier-1 storage-chaos smoke: rank 0 dies inside the payload
+    write of commit 2 (ckpt.write invocation 3).  The torn attempt has
+    no manifest, so it never existed as far as restore is concerned."""
+    res, out = _launch_storage_chaos(
+        tmp_path, "ckpt.write:kill@rank=0,count=3")
+    _assert_rolled_back_to_last_durable(res, out)
+
+
+@pytest.mark.multiprocess
+@pytest.mark.chaos
+@pytest.mark.slow  # tier-1 keeps the ckpt.write smoke; -m chaos runs all 3
+def test_kill_mid_commit_at_ckpt_fsync_recovers(tmp_path):
+    res, out = _launch_storage_chaos(
+        tmp_path, "ckpt.fsync:kill@rank=0,count=3")
+    _assert_rolled_back_to_last_durable(res, out)
+
+
+@pytest.mark.multiprocess
+@pytest.mark.chaos
+@pytest.mark.slow  # tier-1 keeps the ckpt.write smoke; -m chaos runs all 3
+def test_kill_mid_commit_at_ckpt_rename_recovers(tmp_path):
+    res, out = _launch_storage_chaos(
+        tmp_path, "ckpt.rename:kill@rank=0,count=3")
+    _assert_rolled_back_to_last_durable(res, out)
+
+
+@pytest.mark.multiprocess
+def test_bitflip_snapshot_rejected_with_fallback(tmp_path):
+    """Acceptance: a bitflip-corrupted snapshot (commit 2's payload)
+    parses as committed but fails sha256 verification at restore; the
+    restore falls back to the previous retained snapshot and the
+    quorum lands every rank on epoch 1."""
+    res, out = _launch_storage_chaos(
+        tmp_path,
+        "ckpt.write:bitflip@rank=0,count=3,times=1; "
+        "worker.step:kill@rank=0,count=3")
+    assert res.returncode == 0, out[-4000:]
+    assert "bitflip storage damage" in out, out[-4000:]
+    # the corrupt commit 2 must be SKIPPED: both ranks replay epoch 1
+    assert out.count("EPOCH epoch=1 ") == 2, out[-4000:]
+    assert "DONE size=2 epoch=5" in out, out[-4000:]

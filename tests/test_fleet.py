@@ -760,6 +760,108 @@ class TestSpoolProtocol:
         assert arb.jobs["spooled"].handle.stopped
 
 
+class TestCrashRecovery:
+    """``hvtpufleet serve`` restart: state.json + spool resume without
+    double-launching anything (PR 15 satellite)."""
+
+    def _arbiter(self, fleet_dir, events=None):
+        def event_fn(kind, **fields):
+            if events is not None:
+                events.append((kind.replace("fleet.", "", 1), fields))
+
+        return FleetArbiter(_FakeDiscovery({"h1": 4}),
+                            fleet_dir=str(fleet_dir), tick_s=0.5,
+                            runner_factory=_FakeRunner,
+                            event_fn=event_fn, register_debug=False)
+
+    def test_recover_resumes_running_job_once(self, fleet_dir,
+                                              fake_clock):
+        # incarnation 1: spool a job, tick it to RUNNING, then "crash"
+        # (no close; the state.json published by the tick is all the
+        # next incarnation gets)
+        arb1 = self._arbiter(fleet_dir)
+        _write_spec(fleet_dir / "submit")
+        arb1.tick()
+        assert arb1.jobs["spooled"].state == RUNNING
+        arb1.jobs["spooled"].preemptions = 3
+        arb1.jobs["spooled"].handle.charged_restarts = 1
+        arb1.tick()  # publish the counters
+
+        # the crash window: intake submitted the spec but died before
+        # unlinking the spool file
+        _write_spec(fleet_dir / "submit")
+
+        events = []
+        arb2 = self._arbiter(fleet_dir, events)
+        assert arb2.recover() == 1
+        job = arb2.jobs["spooled"]
+        assert job.state == PENDING  # workers died with the arbiter
+        assert job.preemptions == 3
+        assert job.charged_restarts == 1
+        assert ("recover", {"job": "spooled",
+                            "prior_state": "RUNNING"}) in events
+
+        arb2.tick()
+        # the stale spool file is consumed as a duplicate, not
+        # rejected, and the job gang-launches exactly once
+        assert not os.path.exists(
+            str(fleet_dir / "submit" / "spooled.json"))
+        assert not os.path.exists(
+            str(fleet_dir / "rejected" / "spooled.json.error"))
+        assert "spool_duplicate" in [k for k, _ in events]
+        assert "submit_rejected" not in [k for k, _ in events]
+        assert arb2.jobs["spooled"].state == RUNNING
+        assert arb2.jobs["spooled"].handle.started
+
+    def test_recover_skips_terminal_jobs(self, fleet_dir, fake_clock):
+        arb1 = self._arbiter(fleet_dir)
+        _write_spec(fleet_dir / "submit", name="done-job")
+        _write_spec(fleet_dir / "submit", name="live-job")
+        arb1.tick()
+        arb1.jobs["done-job"].handle.exit(0)
+        arb1.tick()  # reaps done-job, publishes both rows
+        assert arb1.jobs["done-job"].state == DONE
+
+        arb2 = self._arbiter(fleet_dir)
+        assert arb2.recover() == 1
+        assert "done-job" not in arb2.jobs
+        assert arb2.jobs["live-job"].state == PENDING
+
+    def test_recover_without_state_json_is_a_noop(self, fleet_dir,
+                                                  fake_clock):
+        arb = self._arbiter(fleet_dir)
+        assert arb.recover() == 0
+        (fleet_dir / "state.json").write_text("{not json")
+        assert arb.recover() == 0
+
+    def test_recover_is_idempotent(self, fleet_dir, fake_clock):
+        arb1 = self._arbiter(fleet_dir)
+        _write_spec(fleet_dir / "submit")
+        arb1.tick()
+
+        arb2 = self._arbiter(fleet_dir)
+        assert arb2.recover() == 1
+        assert arb2.recover() == 0  # already live — nothing doubles
+        assert len(arb2.jobs) == 1
+
+    def test_changed_spool_spec_after_recovery_is_rejected(
+            self, fleet_dir, fake_clock):
+        # same name, *different* spec in the spool: that is a real
+        # duplicate-name submit, not the crash window — reject it
+        arb1 = self._arbiter(fleet_dir)
+        _write_spec(fleet_dir / "submit")
+        arb1.tick()
+        _write_spec(fleet_dir / "submit", priority=7)
+
+        events = []
+        arb2 = self._arbiter(fleet_dir, events)
+        arb2.recover()
+        arb2.tick()
+        err = (fleet_dir / "rejected" / "spooled.json.error").read_text()
+        assert "already exists" in err
+        assert arb2.jobs["spooled"].spec.priority == 0
+
+
 class TestCLI:
     def _main(self, *argv):
         from tools.hvtpufleet.__main__ import main

@@ -294,6 +294,49 @@ def test_sharded_elastic_state_resync_across_topologies(tmp_path):
         hvt.shutdown()
 
 
+def test_replicated_vs_sharded_attr_split_across_resize(hvt,
+                                                        tmp_path,
+                                                        monkeypatch):
+    """PR 15 edge case: one attr replicated (P()), one sharded
+    (P("world")), one plain — committed, then restored into a fresh
+    state whose arrays carry DIFFERENT shardings (the resized world's
+    layout).  The split must route each attr down the right plane:
+    arrays reassemble onto the new shardings, the plain attr rides the
+    rank-0 pickle."""
+    import horovod_tpu.elastic as elastic
+
+    monkeypatch.setenv("HVTPU_ELASTIC_STATE_DIR", str(tmp_path))
+    mesh = hvt.world_mesh()
+    rows = NamedSharding(mesh, P("world"))
+    repl = NamedSharding(mesh, P())
+    w = np.arange(64, dtype=np.float32).reshape(8, 8)
+    m = np.arange(16, dtype=np.float32)
+    state = elastic.ShardedJaxState(
+        params={"w": jax.device_put(w, rows)},
+        ema={"m": jax.device_put(m, repl)},
+        epoch=0,
+    )
+    state.epoch = 5
+    state.commit()
+    state.wait_durable()
+
+    # the "resized" trainer: same global shapes, swapped layouts —
+    # params now replicated, ema now sharded
+    cols = NamedSharding(mesh, P(None, "world"))
+    fresh = elastic.ShardedJaxState(
+        params={"w": jax.device_put(np.zeros((8, 8), np.float32),
+                                    cols)},
+        ema={"m": jax.device_put(np.zeros(16, np.float32), rows)},
+        epoch=0,
+    )
+    fresh.sync()
+    assert fresh.epoch == 5
+    assert fresh.params["w"].sharding == cols
+    assert fresh.ema["m"].sharding == rows
+    np.testing.assert_array_equal(np.asarray(fresh.params["w"]), w)
+    np.testing.assert_array_equal(np.asarray(fresh.ema["m"]), m)
+
+
 def test_sharded_state_sync_rejects_missing_array_template(hvt,
                                                            tmp_path,
                                                            monkeypatch):

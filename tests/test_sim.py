@@ -149,6 +149,30 @@ class TestFastChaosMatrix:
         assert r["stats"]["phases"]["inject"]["lo_incarnations"] == [
             256, 256, 128]
 
+    def test_checkpoint_storm_256(self):
+        # the scenario itself asserts the durable-plane contract
+        # (torn commit never lands, bitflip rejected by hashes, one
+        # agreed restore point verified on every rank); here we pin
+        # the measured latency rows the bench embeds
+        r = run_scenario("checkpoint-storm", 256, seed=7)
+        commit = r["stats"]["phases"]["commit"]
+        quorum = r["stats"]["phases"]["restore_quorum"]
+        assert commit["commits"] == 256 * 4
+        assert 0 < commit["commit_p50_s"] <= commit["commit_p99_s"]
+        assert quorum["agreed_seq"] == 3
+        assert quorum["torn_rank"] != quorum["bitflip_rank"]
+        assert 0 < quorum["quorum_p50_s"] <= quorum["quorum_max_s"]
+
+    def test_compression_negotiation_256(self):
+        # int8 sidecar agreement through the real controller: the
+        # scenario asserts identical per-rank schedules and dtype
+        # separation; pin the external shape here
+        r = run_scenario("compression-negotiation", 256, seed=7)
+        neg = r["stats"]["phases"]["negotiate"]
+        assert neg["cycles"] == 4
+        assert neg["sidecar_responses"] == 4
+        assert 0 < neg["cycle_p50_s"] <= neg["cycle_max_s"]
+
     def test_stream_matrix_64(self):
         # split-burst + forced mispredict + membership-change-free
         # shutdown interleavings on the streamed plane; 256-rank and
@@ -171,7 +195,8 @@ def _dump(result):
 
 class TestDeterminism:
     @pytest.mark.parametrize(
-        "name", ["steady-drain", "kill-blacklist", "multi-job-arbiter"])
+        "name", ["steady-drain", "kill-blacklist", "multi-job-arbiter",
+                 "checkpoint-storm", "compression-negotiation"])
     def test_same_seed_byte_identical(self, name):
         a = _dump(run_scenario(name, 64, seed=7))
         b = _dump(run_scenario(name, 64, seed=7))
@@ -187,7 +212,8 @@ class TestDeterminism:
         assert set(SCENARIOS) == {
             "thundering-rendezvous", "steady-drain", "rolling-preemption",
             "kill-blacklist", "kv-brownout", "straggler-tail",
-            "stream-matrix", "multi-job-arbiter"}
+            "stream-matrix", "multi-job-arbiter", "checkpoint-storm",
+            "compression-negotiation"}
         with pytest.raises(KeyError, match="steady-drain"):
             run_scenario("no-such-scenario", 8)
 
@@ -222,6 +248,12 @@ class TestScale:
         pre = r["stats"]["phases"]["preempt"]
         assert pre["victims"] == 512
         assert r["stats"]["phases"]["done"]["hi_np"] == 512
+
+    def test_checkpoint_storm_1024(self):
+        r = run_scenario("checkpoint-storm", 1024, seed=7)
+        quorum = r["stats"]["phases"]["restore_quorum"]
+        assert quorum["agreed_seq"] == 3
+        assert quorum["quorum_max_s"] > 0
 
     def test_thundering_rendezvous_4096(self):
         r = run_scenario("thundering-rendezvous", 4096, seed=7)

@@ -33,6 +33,10 @@ def _cmd_serve(args) -> int:
         verbose=not args.quiet,
     )
     try:
+        recovered = arbiter.recover()
+        if recovered and not args.quiet:
+            print(f"hvtpufleet: recovered {recovered} job(s) from "
+                  "state.json", file=sys.stderr)
         arbiter.run(until_idle=args.until_idle)
     except KeyboardInterrupt:
         pass
