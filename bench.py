@@ -150,6 +150,16 @@ REQUIRED_METRIC_KEYS = (
     "hvtpu_ckpt_bytes_written_total",
     "hvtpu_ckpt_verify_failures_total",
     "hvtpu_ckpt_restore_quorum_rounds_total",
+    # flight recorder + anomaly detection (PR 16, obs/flight.py,
+    # obs/anomaly.py, fleet/health.py): ring appends prove the black
+    # box was recording; incident count is 0 on a healthy run — a
+    # nonzero value names a round that tripped a detector.  The fleet
+    # gauges stay 0 outside an arbiter-run fleet (no _seconds suffix:
+    # condense_metrics zero-fills gauges as scalars).
+    "hvtpu_flight_events_total",
+    "hvtpu_incidents_total",
+    "hvtpu_fleet_job_step_rate",
+    "hvtpu_fleet_job_incidents",
 )
 
 

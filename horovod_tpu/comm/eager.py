@@ -42,6 +42,7 @@ profile of an eager-only program reads as designed behavior.
 from __future__ import annotations
 
 import functools
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -63,6 +64,29 @@ from .compression import NoneCompressor
 from .reduce_ops import ReduceOp, normalize_op
 
 
+# Threads executing a controller-agreed schedule: their async ops
+# already passed the ``collective.pre`` site at the issuance boundary
+# (EagerController.enqueue), so the dispatch below must not fire it a
+# second time — one firing per op keeps ``count=N`` staging exact.
+_EXEC_TL = threading.local()
+
+
+class _ControllerExecution:
+    def __enter__(self):
+        _EXEC_TL.active = True
+        return self
+
+    def __exit__(self, *exc):
+        _EXEC_TL.active = False
+        return False
+
+
+def controller_execution():
+    """Context manager the eager mini-controller's executor wraps its
+    data-plane dispatch in (see ``_record_collective``)."""
+    return _ControllerExecution()
+
+
 def _record_collective(kind: str, x, p: int, compression=None,
                        pset=None):
     """Registry bookkeeping for one eager collective: per-kind count,
@@ -80,8 +104,14 @@ def _record_collective(kind: str, x, p: int, compression=None,
     ``corrupt`` this rank's INPUT tensor (NaN-poison rides the wire to
     every peer, exercising the optimizer's coordinated non-finite
     guard).  Returns the (possibly poisoned) tensor.  The empty-spec
-    cost is one module-attribute read."""
-    if faults.ACTIVE:
+    cost is one module-attribute read.
+
+    Async ops fire the site at their issuance boundary instead
+    (EagerController.enqueue) — that is where a delayed rank is
+    observable as coordinator arrival skew, the straggler class the
+    anomaly plane names ranks for — and the executor suppresses the
+    duplicate dispatch-time firing via ``controller_execution``."""
+    if faults.ACTIVE and not getattr(_EXEC_TL, "active", False):
         x = faults.inject_tensor("collective.pre", x, pset=pset,
                                  detail=kind)
     obs_metrics.op_counter(kind).inc()

@@ -72,6 +72,7 @@ from ..core import clock
 from ..core import faults
 from ..core import preempt
 from ..core.exceptions import HorovodInternalError
+from ..obs import flight
 from ..obs import tracing
 from ..obs import metrics as obs_metrics
 
@@ -288,6 +289,13 @@ class SyncStallInspector:
                     still.append(r)
                 elif val != desc:
                     _M_ABORTS.inc()
+                    if flight.ACTIVE:
+                        flight.note("collective_mismatch", collective=desc,
+                                    process_set=set_id, op_seq=seq,
+                                    peer_rank=r, peer_desc=val)
+                    flight.dump_postmortem(
+                        "collective_mismatch", collective=desc,
+                        peer_rank=r)
                     raise HorovodInternalError(
                         _mismatch_msg(set_id, seq, self.rank, desc,
                                       r, val))
@@ -305,6 +313,14 @@ class SyncStallInspector:
             blamable = [r for r in pending if r not in draining]
             if self.abort_s > 0 and elapsed > self.abort_s and blamable:
                 _M_ABORTS.inc()
+                if flight.ACTIVE:
+                    flight.note("stall_abort", collective=desc,
+                                process_set=set_id, op_seq=seq,
+                                waited_s=round(elapsed, 3),
+                                ranks_missing=sorted(blamable))
+                flight.dump_postmortem(
+                    "stall_abort", collective=desc,
+                    ranks_missing=sorted(blamable))
                 raise HorovodInternalError(
                     _stall_abort_msg(desc, set_id, seq, elapsed,
                                      self.abort_s, blamable))
@@ -329,6 +345,11 @@ class SyncStallInspector:
                         "stall_warning", collective=desc,
                         process_set=set_id, op_seq=seq,
                         waited_s=elapsed, ranks_missing=sorted(blamable))
+                if flight.ACTIVE:
+                    flight.note("stall_warning", collective=desc,
+                                process_set=set_id, op_seq=seq,
+                                waited_s=round(elapsed, 3),
+                                ranks_missing=sorted(blamable))
             # back off from a near-spin (normal skew is sub-ms) to a
             # 20ms poll for genuinely late peers
             sleep = min(0.02, sleep * 2 if sleep else 0.0002)
@@ -777,6 +798,9 @@ class AmortizedStallInspector:
             if fail:
                 self.failure = fail
                 _M_ABORTS.inc()
+                if flight.ACTIVE:
+                    flight.note("stall_abort", detail=fail[:300])
+                flight.dump_postmortem("stall_abort")
         for r, rem, desc, sid in drain_notes:
             logger.info(
                 "rank %d draining (%.0fs grace remaining); holding "
@@ -794,6 +818,11 @@ class AmortizedStallInspector:
                     "stall_warning", collective=desc, process_set=sid,
                     op_seq=op, waited_s=age,
                     ranks_missing=sorted(behind))
+            if flight.ACTIVE:
+                flight.note("stall_warning", collective=desc,
+                            process_set=sid, op_seq=op,
+                            waited_s=round(age, 3),
+                            ranks_missing=sorted(behind))
 
 
 def _make_inspector(st, cfg):

@@ -48,6 +48,7 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import clock
+from ..obs import flight
 from ..obs import metrics as obs_metrics
 from .exceptions import HvtpuDivergenceError
 
@@ -251,10 +252,15 @@ def verify(tree: Any, label: str = "params", *, action: Optional[str] = None,
         "ranks": sorted({r for vals in divergent.values()
                          for r in _majority_outliers(vals)}),
     }
+    if flight.ACTIVE:
+        flight.note("audit", label=label, action=action,
+                    divergent=len(divergent), ranks=report["ranks"])
     if divergent:
         _M_DIVERGENCES.inc()
         text = format_report(label, divergent)
         if action == "abort":
+            flight.dump_postmortem("divergence", label=label,
+                                   ranks=report["ranks"])
             raise HvtpuDivergenceError(text)
         logger.warning("%s", text)
     return report

@@ -62,6 +62,7 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from . import clock, faults
+from ..obs import flight
 from ..obs import metrics as obs_metrics
 
 logger = logging.getLogger("horovod_tpu")
@@ -277,6 +278,10 @@ def write_snapshot(root: str, seq: int, files: Dict[str, bytes], *,
         json.dumps(manifest, sort_keys=True).encode(),
         fsync=fsync, detail=f"manifest@c{seq}")
     _M_COMMIT_S.observe(clock.monotonic() - t0)
+    if flight.ACTIVE:
+        flight.note("durable_commit", seq=seq,
+                    files=len(files),
+                    commit_s=round(clock.monotonic() - t0, 6))
     gc_snapshots(root, keep=keep)
     return d
 

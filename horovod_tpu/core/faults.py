@@ -374,9 +374,14 @@ class FaultRegistry:
             return False
         # kill: flush and hard-exit — simulate a worker dying mid-op
         # (exit 1 = crash, NOT the reset code: the driver must treat
-        # this as an unplanned death, exactly like a real one).
+        # this as an unplanned death, exactly like a real one).  The
+        # flight recorder flushes its black box first: a killed rank
+        # still leaves a postmortem behind.
         import sys
 
+        from ..obs import flight as _flight
+
+        _flight.dump_postmortem("fault_kill", site=site)
         print(f"hvtpu fault injection: killing rank {self.rank} "
               f"([{fired.source}] at {site})", file=sys.stderr, flush=True)
         sys.stdout.flush()

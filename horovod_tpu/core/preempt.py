@@ -57,6 +57,7 @@ import threading
 from typing import Dict, Optional
 
 from . import clock
+from ..obs import flight
 from ..obs import metrics as obs_metrics
 from ..obs import tracing
 
@@ -231,6 +232,9 @@ class _DrainCoordinator:
                 tracing.instant(
                     "drain_begin", rank=self.rank, source=self._reason,
                     grace_s=self.grace_s)
+            if flight.ACTIVE:
+                flight.note("drain_begin", rank=self.rank,
+                            source=self._reason, grace_s=self.grace_s)
             self._arm_grace_timer()
             self._post_notice_key()
         # 3. observe peers' notices and drain plans
@@ -334,6 +338,13 @@ class _DrainCoordinator:
         if tracing.ACTIVE:
             tracing.instant("drain_exit", rank=self.rank,
                             committed=False)
+        if flight.ACTIVE:
+            flight.note("drain_exit", rank=self.rank, committed=False,
+                        grace_s=self.grace_s)
+        # force-exit without a commit boundary is a fatal-path story
+        # worth a black box: what was the loop doing all grace long?
+        flight.dump_postmortem("drain_grace_expired",
+                               grace_s=self.grace_s)
         self._planned_exit()
 
     def _planned_exit(self) -> None:
@@ -458,6 +469,10 @@ class _DrainCoordinator:
             tracing.instant(
                 "drain_commit", rank=self.rank, commit=commit_count,
                 departing=departing, waited_s=round(elapsed, 3))
+        if flight.ACTIVE:
+            flight.note("drain_commit", rank=self.rank,
+                        commit=commit_count, departing=departing,
+                        waited_s=round(elapsed, 3))
         if self._grace_timer is not None:
             self._grace_timer.cancel()
         if departing:

@@ -38,6 +38,7 @@ import os
 import random
 from typing import Any, Callable, Optional, Tuple
 
+from ..obs import flight
 from ..obs import metrics as obs_metrics
 from . import clock
 from . import faults
@@ -254,6 +255,9 @@ class ResilientKV:
 
     def _on_retry(self, attempt: int, exc) -> None:
         _M_KV_RETRIES.inc()
+        if flight.ACTIVE:
+            flight.note("kv_retry", rank=self._rank, attempt=attempt,
+                        error=type(exc).__name__)
 
     def _call(self, fn, *args):
         try:
@@ -262,6 +266,9 @@ class ResilientKV:
         except Exception as e:
             if kv_retryable(e):
                 _M_KV_EXHAUSTED.inc()
+                if flight.ACTIVE:
+                    flight.note("kv_retry_exhausted", rank=self._rank,
+                                error=str(e)[:200])
             raise
 
     # Fault injection happens INSIDE the retried closures below, so an

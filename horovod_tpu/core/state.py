@@ -52,6 +52,9 @@ class GlobalState:
         self.timeline = None
         # Autotuner (horovod_tpu.obs.autotune), if enabled.
         self.autotuner = None
+        # Fleet health publisher (fleet/health.py), rank 0 only when
+        # HVTPU_FLEET_JOB names the owning fleet job.
+        self.health_reporter = None
 
 
 _state = GlobalState()
@@ -370,6 +373,71 @@ def init(config: Optional[Config] = None) -> GlobalState:
                 _stepprof.install()
         except Exception:
             pass
+        # Flight recorder (obs/flight.py): always-on bounded event ring
+        # + postmortem dumps on fatal paths; HVTPU_FLIGHT=0 opts out.
+        # Failure degrades to no black box, never a broken init.
+        try:
+            from ..obs import flight as _flight
+
+            if _flight.env_enabled():
+                _flight.install(
+                    rank=_state.rank, size=_state.size,
+                    generation=int(_os.environ.get(
+                        "HVTPU_ELASTIC_GENERATION", "0") or 0),
+                    out_dir=(_os.environ.get("HVTPU_FLIGHT_DIR")
+                             or cfg.trace_dir or "."),
+                    window=_flight.env_window())
+        except Exception:
+            _logging.getLogger("horovod_tpu").warning(
+                "flight recorder disabled: install failed",
+                exc_info=True)
+        # Online anomaly detection (obs/anomaly.py): robust-z detectors
+        # over the step/comm/skew series; HVTPU_ANOMALY=0 opts out.
+        try:
+            from ..obs import anomaly as _anomaly
+
+            if _anomaly.env_enabled():
+                _anomaly.install(rank=_state.rank, size=_state.size)
+        except Exception:
+            _logging.getLogger("horovod_tpu").warning(
+                "anomaly detection disabled: install failed",
+                exc_info=True)
+        # Fleet health publisher (fleet/health.py): when this worker
+        # belongs to a fleet job (HVTPU_FLEET_JOB, injected by the
+        # fleet runner), rank 0 publishes a compact health summary
+        # under the job's prefixed KV namespace each interval.
+        if _state.rank == 0 and _os.environ.get("HVTPU_FLEET_JOB"):
+            try:
+                _hclient = None
+                if _state.size >= 1:
+                    try:
+                        from jax._src import distributed as _jd
+
+                        _hclient = _jd.global_state.client
+                        if _hclient is not None:
+                            from .retry import resilient_kv
+
+                            _hclient = resilient_kv(
+                                _hclient, rank=_state.rank)
+                    except Exception:
+                        _hclient = None
+                # A KV client is optional: without one the reporter
+                # still mirrors summaries to HVTPU_FLEET_HEALTH_DIR
+                # (the file channel the arbiter actually polls — it is
+                # not a member of this job's coordination world).
+                if (_hclient is not None
+                        or _os.environ.get("HVTPU_FLEET_HEALTH_DIR")):
+                    from ..fleet import health as _health
+
+                    _state.health_reporter = _health.HealthReporter(
+                        _hclient,
+                        _os.environ["HVTPU_FLEET_JOB"],
+                        rank=_state.rank)
+                    _state.health_reporter.start()
+            except Exception:
+                _logging.getLogger("horovod_tpu").warning(
+                    "fleet health publisher disabled: install failed",
+                    exc_info=True)
         if cfg.autotune:
             from ..obs.autotune import Autotuner
 
@@ -405,6 +473,29 @@ def shutdown():
             from ..obs import tracing as _tracing
 
             _tracing.uninstall()
+        except Exception:
+            pass
+        # Stop the fleet health publisher BEFORE the coordination
+        # client goes away (its loop writes the fleet KV namespace).
+        if _state.health_reporter is not None:
+            try:
+                _state.health_reporter.stop()
+            except Exception:
+                pass
+            _state.health_reporter = None
+        # Anomaly engine + flight recorder: uninstall is idempotent and
+        # restores the SIGUSR2 handler; the ring is dropped (postmortems
+        # only exist for fatal paths, not clean shutdowns).
+        try:
+            from ..obs import anomaly as _anomaly
+
+            _anomaly.uninstall()
+        except Exception:
+            pass
+        try:
+            from ..obs import flight as _flight
+
+            _flight.uninstall()
         except Exception:
             pass
         _state.autotuner = None

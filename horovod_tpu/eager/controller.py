@@ -48,6 +48,8 @@ from ..core import faults
 from ..core import preempt
 from ..core import retry as core_retry
 from ..core.exceptions import HorovodInternalError, HvtpuMismatchError
+from ..obs import anomaly
+from ..obs import flight
 from ..obs import metrics as obs_metrics
 from ..obs import tracing
 
@@ -821,6 +823,16 @@ class EagerController:
                 f"controller thread died: {self._thread_error!r}"
             )
         x = jnp.asarray(tensor)
+        if faults.ACTIVE:
+            # The ``collective.pre`` site fires HERE, at the issuance
+            # boundary, for async ops: a delay lands before the
+            # announcement reaches the coordinator, so it shows up as
+            # arrival skew (the straggler signal obs/anomaly names
+            # ranks from) instead of vanishing inside the joint
+            # execution barrier.  The executor's dispatch suppresses
+            # the second firing (comm/eager.controller_execution).
+            x = faults.inject_tensor("collective.pre", x,
+                                     pset=process_set, detail=kind)
         name = name or self._auto_name(kind)
         kind_to_type = {
             "allreduce": wire.ALLREDUCE,
@@ -1080,6 +1092,8 @@ class EagerController:
             "cache-resync re-anchor", why)
         if tracing.ACTIVE:
             tracing.instant("mispredict", why=why)
+        if flight.ACTIVE:
+            flight.note("mispredict", why=why)
         self._reset_predict_state()
         force = getattr(self._ctrl, "force_resync", None)
         if force is not None:
@@ -1323,6 +1337,11 @@ class EagerController:
             if tracing.ACTIVE:
                 tracing.instant("arrival_skew", tensor=name,
                                 skew_s=skew, last_rank=last)
+            # straggler detection: the same drained sample feeds the
+            # online anomaly plane, which names the offending rank by
+            # joining these last-arriver observations
+            if anomaly.ACTIVE:
+                anomaly.on_arrival_skew(name, skew, last)
 
     def _service_once(self) -> bool:
         """Rank-0 coordination service: ingest newly streamed request
@@ -1601,6 +1620,8 @@ class EagerController:
         else:
             if parsed.cache_resync:
                 _M_RESYNC.inc()
+                if flight.ACTIVE:
+                    flight.note("resync", drained=drained)
             _M_CACHE_HITS.inc(len(parsed.cache_hits))
         return parsed
 
@@ -1734,6 +1755,11 @@ class EagerController:
             if (self.stall_abort_s > 0
                     and s["waiting_s"] > self.stall_abort_s):
                 obs_metrics.counter("hvtpu_stall_aborts_total").inc()
+                if flight.ACTIVE:
+                    flight.note("stall_abort", tensor=s["name"],
+                                waited_s=round(s["waiting_s"], 3),
+                                ranks_missing=s["missing"])
+                flight.dump_postmortem("stall_abort", tensor=s["name"])
                 raise HorovodInternalError(
                     f"collective {s['name']!r} stalled for "
                     f"{s['waiting_s']:.0f}s; missing ranks {s['missing']}"
@@ -1765,6 +1791,11 @@ class EagerController:
                         waited_s=waited, rank=self.rank)
             if self.stall_abort_s > 0 and waited > self.stall_abort_s:
                 obs_metrics.counter("hvtpu_stall_aborts_total").inc()
+                if flight.ACTIVE:
+                    flight.note("stall_abort", tensor=name,
+                                waited_s=round(waited, 3),
+                                rank=self.rank)
+                flight.dump_postmortem("stall_abort", tensor=name)
                 raise HorovodInternalError(
                     f"collective {name!r} stalled for {waited:.0f}s on "
                     f"rank {self.rank}"
@@ -1917,6 +1948,11 @@ class EagerController:
             p.future.set_error(err_cls(rs.error))
 
     def _execute(self, rl: wire.ResponseList, finished: List[int]):
+        with eager_comm.controller_execution():
+            self._execute_responses(rl, finished)
+
+    def _execute_responses(self, rl: wire.ResponseList,
+                            finished: List[int]):
         for rs in rl.responses:
             # Responses are broadcast to every rank; only member ranks
             # of the response's process set execute it (parity: each

@@ -24,6 +24,7 @@ import sys
 import tempfile
 from typing import Dict, List, Optional
 
+from ..obs import flight
 from ..obs import metrics as obs_metrics
 from ..runner import hosts as hosts_mod
 from ..runner import safe_shell_exec
@@ -374,7 +375,18 @@ class ElasticDriver:
             # — unless the restart budget says this workload is
             # crash-looping and relaunching forever helps nobody.
             _M_RESTARTS.inc()
+            if flight.ACTIVE:
+                flight.note("elastic_restart",
+                            generation=self._generation - 1,
+                            size=np_now)
             if not self._restart_budget_ok():
+                # The job is dead for good: flush a driver-side black
+                # box (ring may be empty — the snapshots matter here;
+                # per-rank rings live in the workers' own postmortems).
+                flight.dump_postmortem(
+                    "restart_budget_exhausted",
+                    generation=self._generation - 1,
+                    crashes=self._last_crash_summary or "")
                 return 1
 
     def _restart_budget_ok(self) -> bool:

@@ -12,7 +12,9 @@ terminates the process with a dedicated exit code when the world must
 change, and the elastic driver relaunches everyone; committed state is
 reloaded through ``state.sync()`` in the fresh incarnation.  Driver →
 worker notification rides SIGUSR1 instead of the reference's HTTP
-notification service — same commit-boundary semantics.
+notification service — same commit-boundary semantics.  (SIGUSR2 is
+taken by the flight recorder for on-demand postmortem dumps — see
+obs/flight.py and docs/robustness.md.)
 """
 
 from __future__ import annotations
@@ -28,6 +30,7 @@ from ..core import faults
 from ..core import state as core_state
 from ..core.exceptions import (DrainInterrupt, HorovodInternalError,
                                HostsUpdatedInterrupt)
+from ..obs import flight
 from ..obs import metrics as obs_metrics
 from .state import State, _HostUpdateFlag
 
@@ -142,6 +145,8 @@ def run(func):
             # Peer loss mid-collective: roll back so the durable commit
             # reflects the last good step, then ask for a new world.
             _M_RESETS.inc(reason="collective_failure")
+            if flight.ACTIVE:
+                flight.note("worker_reset", reason="collective_failure")
             state.restore()
             _exit_for_reset("collective failure")
         except DrainInterrupt as e:
@@ -151,11 +156,28 @@ def run(func):
             # from it with zero lost steps.  Must precede the parent
             # HostsUpdatedInterrupt handler.
             _M_RESETS.inc(reason="peer_drain")
+            if flight.ACTIVE:
+                flight.note("worker_reset", reason="peer_drain",
+                            peer=e.rank)
             _exit_for_reset(
                 f"peer drain (rank {e.rank} departing, planned)")
         except HostsUpdatedInterrupt:
             _M_RESETS.inc(reason="hosts_updated")
+            if flight.ACTIVE:
+                flight.note("worker_reset", reason="hosts_updated")
             _exit_for_reset("hosts updated")
+        except BaseException as e:
+            # Unhandled user/runtime exception: this process is about
+            # to die on a path nobody anticipated — exactly what the
+            # black box exists for.  Dump, then re-raise untouched.
+            if not isinstance(e, SystemExit) or (e.code or 0) != 0:
+                if flight.ACTIVE:
+                    flight.note("worker_exception",
+                                error=type(e).__name__,
+                                detail=str(e)[:300])
+                flight.dump_postmortem(
+                    "unhandled_exception", error=type(e).__name__)
+            raise
 
     return wrapper
 

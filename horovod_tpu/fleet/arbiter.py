@@ -87,6 +87,19 @@ _M_RESIZE_S = obs_metrics.histogram(
 _M_AUTOSCALE = obs_metrics.counter(
     "hvtpu_fleet_autoscale_events_total",
     "Autoscale decisions applied (label: direction = grow | shrink).")
+_M_JOB_STEP_RATE = obs_metrics.gauge(
+    "hvtpu_fleet_job_step_rate",
+    "Per-job EWMA optimizer steps/second from the latest fleet health "
+    "summary (label: job; 0 until the job publishes).")
+_M_JOB_INCIDENTS = obs_metrics.gauge(
+    "hvtpu_fleet_job_incidents",
+    "Per-job total anomaly incidents from the latest fleet health "
+    "summary (label: job).")
+_M_JOB_STALL_AGE = obs_metrics.gauge(
+    "hvtpu_fleet_job_stall_age_seconds",
+    "Per-job stall age from the latest fleet health summary: seconds "
+    "since the last completed step while a newer stall warning is "
+    "outstanding; 0 when healthy (label: job).")
 
 
 class FleetArbiter:
@@ -100,7 +113,8 @@ class FleetArbiter:
                  event_fn: Optional[Callable[..., None]] = None,
                  blacklist_cooldown: Optional[float] = None,
                  verbose: bool = False,
-                 register_debug: bool = True):
+                 register_debug: bool = True,
+                 health_client=None):
         self.hosts = HostManager(discovery,
                                  cooldown_base_s=blacklist_cooldown)
         if fleet_dir is None:
@@ -127,6 +141,10 @@ class FleetArbiter:
                 return ElasticJobRunner(j, _base, verbose=self.verbose)
 
         self._runner_factory = runner_factory
+        # Optional KV client reaching the jobs' prefixed health keys
+        # (fleet/health.py): each tick pulls fleet/<job>/health and
+        # folds it into state.json + the per-job fleet gauges.
+        self._health_client = health_client
         self._lock = threading.RLock()
         self.jobs: Dict[str, Job] = {}  # hvtpulint: guarded-by(_lock)
         self._autoscalers: Dict[str, Autoscaler] = {}  # hvtpulint: guarded-by(_lock)
@@ -205,6 +223,7 @@ class FleetArbiter:
             self._fail_oversized()
             self._schedule()
             self._autoscale_tick()
+            self._poll_health()
             self._publish()
 
     def _refresh_pool(self) -> None:  # hvtpulint: requires(_lock)
@@ -557,6 +576,25 @@ class FleetArbiter:
         except OSError:
             pass
 
+    def _poll_health(self) -> None:  # hvtpulint: requires(_lock)
+        """Pull each live job's health summary (fleet/health.py) off
+        the shared KV when one exists (the fabric simulator), else off
+        the per-job health-file channel the ElasticJobRunner handle
+        exposes as ``health_dir``; a missing/None read keeps the
+        previous summary so one flaky tick doesn't blank the rollup."""
+        from . import health as health_mod
+
+        for j in self._live_jobs():
+            summary = None
+            if self._health_client is not None:
+                summary = health_mod.read(self._health_client, j.name)
+            if summary is None:
+                hd = getattr(j.handle, "health_dir", None)
+                if hd:
+                    summary = health_mod.read_file(hd)
+            if summary is not None:
+                j.health = summary
+
     def _publish(self) -> None:  # hvtpulint: requires(_lock)
         counts = {s: 0 for s in STATES}
         for j in self.jobs.values():
@@ -568,6 +606,15 @@ class FleetArbiter:
                    for n in j.allocation.values())
         _M_SLOTS_TOTAL.set(total)
         _M_SLOTS_USED.set(min(used, total) if total else used)
+        for j in self._live_jobs():
+            h = j.health
+            if h:
+                _M_JOB_STEP_RATE.set(
+                    float(h.get("step_rate") or 0.0), job=j.name)
+                _M_JOB_INCIDENTS.set(
+                    float(h.get("incidents_total") or 0.0), job=j.name)
+                _M_JOB_STALL_AGE.set(
+                    float(h.get("stall_age_s") or 0.0), job=j.name)
         if self.fleet_dir:
             self._write_state_json()
 

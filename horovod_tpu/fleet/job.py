@@ -308,6 +308,9 @@ class Job:
         self.shrink_escalated = False
         self.cancelled = False
         self.unschedulable_reported = False
+        # latest fleet health summary (fleet/health.py), pulled by the
+        # arbiter each tick; None until the job publishes one
+        self.health: Optional[Dict[str, Any]] = None
 
     @property
     def name(self) -> str:
@@ -357,6 +360,7 @@ class Job:
             "charged_restarts": self.charged_restarts,
             "queue_wait_s": (round(self.queue_wait_s, 6)
                              if self.queue_wait_s is not None else None),
+            "health": self.health,
         }
         return out
 

@@ -75,8 +75,15 @@ class ElasticJobRunner:
         self._dir = os.path.join(base_dir, spec.name)
         self.state_dir = os.path.join(self._dir, "state")
         self.notice_dir = os.path.join(self._dir, "notice")
+        # File channel for the fleet health rollup: rank 0's reporter
+        # mirrors summaries here (fleet/health.py) and the arbiter's
+        # _poll_health reads them off this handle attribute — the
+        # arbiter is not a member of the job's coordination world, so
+        # the job's KV alone cannot carry health to it.
+        self.health_dir = os.path.join(self._dir, "health")
         os.makedirs(self.state_dir, exist_ok=True)
         os.makedirs(self.notice_dir, exist_ok=True)
+        os.makedirs(self.health_dir, exist_ok=True)
         self._discovery = AllocationDiscovery()
         self._driver = ElasticDriver(
             command=list(spec.command),
@@ -91,7 +98,7 @@ class ElasticJobRunner:
             restart_window=spec.restart_window,
             drain_grace=spec.drain_grace,
             notice_dir=self.notice_dir,
-            extra_env=spec.env,
+            extra_env=self._job_env(spec),
         )
         self._driver.listener = self._on_driver_event
         self._lock = threading.Lock()
@@ -105,6 +112,16 @@ class ElasticJobRunner:
         self.drains = 0
         self._exit: Optional[int] = None
         self._thread: Optional[threading.Thread] = None
+
+    def _job_env(self, spec) -> Dict[str, str]:
+        # Workers learn their fleet job name so rank 0's HealthReporter
+        # (installed by core/state.init) publishes under the right
+        # fleet/<job>/ KV prefix, and the health-file directory the
+        # arbiter polls for the rollup.  Explicit spec.env entries win.
+        env = dict(spec.env or {})
+        env.setdefault("HVTPU_FLEET_JOB", spec.name)
+        env.setdefault("HVTPU_FLEET_HEALTH_DIR", self.health_dir)
+        return env
 
     # -- lifecycle ------------------------------------------------------
     def start(self, allocation: Dict[str, int]) -> None:

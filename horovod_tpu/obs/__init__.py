@@ -1,3 +1,5 @@
+from . import anomaly
+from . import flight
 from . import metrics
 from . import profile
 from . import stepprof
@@ -21,6 +23,10 @@ __all__ = [
     "load_profile",
     # step-level overlap profiler (obs/stepprof.py)
     "stepprof",
+    # flight recorder + postmortems (obs/flight.py)
+    "flight",
+    # online anomaly detection + incidents (obs/anomaly.py)
+    "anomaly",
     # metrics registry + Prometheus exposition (obs/metrics.py)
     "metrics",
     "metrics_registry",

@@ -341,10 +341,19 @@ def note_step(examples: float = 0.0, steps: float = 1.0):
             "hvtpu_examples_total", "Training examples processed."
         ).inc(examples)
     # Step-boundary hook for the overlap profiler (import deferred:
-    # stepprof imports this module for its registry).
+    # stepprof imports this module for its registry).  The returned
+    # step record feeds the flight ring and the anomaly detectors —
+    # both behind single module-attribute guards when disabled.
     from . import stepprof as _stepprof
     if _stepprof.ACTIVE:
-        _stepprof.note_step_boundary(steps=steps)
+        rec = _stepprof.note_step_boundary(steps=steps)
+        if rec is not None:
+            from . import anomaly as _anomaly
+            from . import flight as _flight
+            if _flight.ACTIVE:
+                _flight.note("step", **rec)
+            if _anomaly.ACTIVE:
+                _anomaly.on_step(rec)
     now = time.monotonic()
     with _STEP_LOCK:
         prev = _STEP_STATE["t"]

@@ -255,13 +255,39 @@ class _Collector:
         with self._lock:
             self._flops_per_step = flops
 
-    def note_step_boundary(self, steps: float = 1.0):
+    @staticmethod
+    def _windows_since(ring, prev: float, now: float) -> list:
+        """Windows of ``ring`` overlapping ``(prev, now)``, scanning
+        newest-first and stopping once the ring is clearly older than
+        the step.  Entries append in ~completion order, so instead of
+        filtering all ``maxlen`` (4096) entries every step we bail
+        after a slack run of pre-``prev`` windows; the slack absorbs
+        mild cross-thread append reordering."""
+        out = []
+        stale = 0
+        for item in reversed(ring):
+            t0, t1 = item[0], item[1]
+            if t1 > prev:
+                stale = 0
+                if t0 < now:
+                    out.append((t0, t1))
+            else:
+                stale += 1
+                if stale >= 64:
+                    break
+        out.reverse()
+        return out
+
+    def note_step_boundary(self, steps: float = 1.0) -> Optional[dict]:
         """Close the step window ending now; emit per-step metrics.
 
         Called (via ``metrics.note_step``) once per host-loop dispatch;
         ``steps`` is the optimizer steps folded into the dispatch
         (lax.scan loops).  Without a device profile the comm union is
-        reported as exposed — the host-side upper bound.
+        reported as exposed — the host-side upper bound.  Returns the
+        step record (also kept as ``last_step`` in the /debug state) —
+        the feed for the anomaly detectors and the flight ring — or
+        None on the first/degenerate boundary.
         """
         now = time.time()
         if tracing.ACTIVE:
@@ -273,29 +299,29 @@ class _Collector:
             self._step_t = now
             self._steps += steps
             if prev is None or now <= prev:
-                return
+                return None
             # Windows stay in the ring (join_device_profile reads them
             # across step boundaries); the step only counts overlap
             # with its own window, so stale entries age out via maxlen
             # without double counting.
-            comm = [(t0, t1) for t0, t1, _n, _b in self._comm
-                    if t1 > prev and t0 < now]
-            data = [(t0, t1) for t0, t1 in self._data
-                    if t1 > prev and t0 < now]
+            comm = self._windows_since(self._comm, prev, now)
+            data = self._windows_since(self._data, prev, now)
             flops = self._flops_per_step
         parts = decompose(prev, now, comm=comm, data=data)
         EXPOSED_COMM.observe(parts["exposed_comm"])
         wall = now - prev
         if flops:
             MFU.set(flops * steps / (wall * peak_flops()))
+        rec = {
+            "step_wall_s": round(wall, 6),
+            "steps": steps,
+            "exposed_comm_s": round(parts["exposed_comm"], 6),
+            "data_wait_s": round(parts["data_wait"], 6),
+            "collectives": len(comm),
+        }
         with self._lock:
-            self._last = {
-                "step_wall_s": round(wall, 6),
-                "steps": steps,
-                "exposed_comm_s": round(parts["exposed_comm"], 6),
-                "data_wait_s": round(parts["data_wait"], 6),
-                "collectives": len(comm),
-            }
+            self._last = rec
+        return rec
 
     def debug_state(self) -> dict:
         with self._lock:
@@ -324,8 +350,8 @@ def note_data_wait(t0: float, t1: float):
     _collector.note_data_wait(t0, t1)
 
 
-def note_step_boundary(steps: float = 1.0):
-    _collector.note_step_boundary(steps)
+def note_step_boundary(steps: float = 1.0) -> Optional[dict]:
+    return _collector.note_step_boundary(steps)
 
 
 def set_step_flops(flops: Optional[float]):

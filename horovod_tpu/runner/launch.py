@@ -140,6 +140,15 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                    help="serve Prometheus text-format metrics from each "
                         "worker at http://host:(PORT+local_rank)/metrics "
                         "(exported as HVTPU_METRICS_PORT)")
+    p.add_argument("--flight-dir", default=None,
+                   help="directory for flight-recorder postmortem dumps "
+                        "(postmortem-<rank>-<gen>.json, written on fatal "
+                        "paths or SIGUSR2; exported as HVTPU_FLIGHT_DIR; "
+                        "merge with python -m tools.hvtputrace "
+                        "postmortem)")
+    p.add_argument("--flight-window", type=int, default=None,
+                   help="flight-recorder ring capacity in events "
+                        "(exported as HVTPU_FLIGHT_WINDOW; default 2048)")
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log", default=None)
     p.add_argument("--compression", default=None,
@@ -335,6 +344,8 @@ def build_worker_env(
             "HVTPU_TIMELINE": args.timeline_filename,
             "HVTPU_TRACE": args.trace_dir,
             "HVTPU_METRICS_PORT": args.metrics_port,
+            "HVTPU_FLIGHT_DIR": getattr(args, "flight_dir", None),
+            "HVTPU_FLIGHT_WINDOW": getattr(args, "flight_window", None),
             "HVTPU_AUTOTUNE_LOG": args.autotune_log,
             "HVTPU_COMPRESSION": args.compression,
             "HVTPU_STALL_CHECK_TIME_SECONDS": args.stall_check_time,

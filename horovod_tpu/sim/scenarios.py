@@ -63,6 +63,11 @@ checkpoint-storm          every rank runs the real durable commit
                           restore point is the min over per-rank
                           maxima, durable everywhere, and damage only
                           ever lowers the pick.
+anomaly-detection         one rank's link degraded mid-run via
+                          ``set_link``; the real AnomalyEngine, fed
+                          per-cycle arrival skew, must raise a
+                          straggler incident naming exactly that rank.
+                          Measures detection latency (virtual s).
 compression-negotiation   mixed-precision negotiation through the
                           real controller: a dense fp32 allreduce
                           plus an int8-compressed sidecar per cycle.
@@ -1526,6 +1531,128 @@ def compression_negotiation(ranks: int, seed: int = 0, *,
 
 
 # ---------------------------------------------------------------------------
+# anomaly-detection
+# ---------------------------------------------------------------------------
+
+def anomaly_detection(ranks: int, seed: int = 0, *, cycles: int = 32,
+                      degrade_after: int = 12,
+                      straggler: Optional[int] = None,
+                      slowdown: float = 400.0) -> Dict:
+    """One virtual rank's link degrades mid-run; the REAL
+    :class:`~..obs.anomaly.AnomalyEngine`, fed per-cycle arrival skew
+    exactly as rank 0's controller drain feeds it, must raise a
+    ``straggler`` incident *naming that rank*.  Detection latency =
+    virtual seconds from the ``set_link`` degradation to the first
+    incident.
+
+    Mechanics: every rank runs ``cycles`` barrier-ish steps — sleep
+    (compute), one KV round trip (paying its own link), then posting
+    its arrival time.  An aggregator task (the rank-0 role) gathers
+    each cycle's arrivals with a single ``dir_get``, computes
+    skew/last-arriver, and feeds the engine.  After the aggregator has
+    scored ``degrade_after`` healthy cycles it degrades ``straggler``'s
+    link ``slowdown``× (latency and bandwidth) via ``set_link``."""
+    from ..obs.anomaly import AnomalyConfig, AnomalyEngine
+
+    kernel, fabric = _fresh(ranks, seed)
+    if straggler is None:
+        straggler = max(1, ranks // 2)
+    step_s = 0.25
+    engine = AnomalyEngine(
+        rank=0, size=ranks,
+        config=AnomalyConfig(window=16, warmup=8, threshold=6.0,
+                             min_rel=0.5, cooldown_s=0.0))
+    degrade_t: List[float] = []
+    detect_t: List[float] = []
+    skews: List[float] = []
+
+    def worker(rank: int):
+        client = fabric.client(rank, caps="str")
+
+        def body():
+            ctx = RankContext(kernel, rank, ranks, generation=0)
+            with ctx.activate():
+                for c in range(cycles):
+                    kernel.sleep(step_s)
+                    # one round trip on this rank's own link — the
+                    # degraded straggler pays its inflated latency
+                    # here, so its posted arrival time drifts late.
+                    try:
+                        client.key_value_try_get("go")
+                    except KeyError:
+                        pass
+                    client.key_value_set(
+                        f"arr/{c}/{rank:05d}", repr(kernel.now))
+        return body
+
+    def aggregator():
+        client = fabric.client(0, caps="dir")
+        ctx = RankContext(kernel, 0, ranks, generation=0)
+        with ctx.activate():
+            for c in range(cycles):
+                while True:
+                    items = client.key_value_dir_get(f"arr/{c}/")
+                    if len(items) >= ranks:
+                        break
+                    kernel.sleep(0.01)
+                arrivals = {int(k.rsplit("/", 1)[1]): float(v)
+                            for k, v in items}
+                last = max(arrivals, key=lambda r: arrivals[r])
+                skew = max(arrivals.values()) - min(arrivals.values())
+                skews.append(skew)
+                fired = engine.on_arrival_skew(
+                    f"grad.{c}", skew, last)
+                if fired and not detect_t and any(
+                        i["kind"] == "straggler" for i in fired):
+                    detect_t.append(kernel.now)
+                    kernel.log("straggler_detected", cycle=c,
+                               ranks=fired[0]["ranks"])
+                client.key_value_delete(f"arr/{c}/")
+                if c + 1 == degrade_after:
+                    base = fabric.link(straggler)
+                    fabric.set_link(
+                        straggler,
+                        latency_s=base.latency_s * slowdown,
+                        bandwidth_bps=base.bandwidth_bps / slowdown)
+                    degrade_t.append(kernel.now)
+                    kernel.log("link_degraded", rank=straggler,
+                               slowdown=slowdown)
+
+    for r in range(ranks):
+        kernel.spawn(f"rank{r}", worker(r))
+    kernel.spawn("aggregator", aggregator)
+    kernel.run(max_virtual_s=_DEF_BUDGET_S)
+
+    incidents = [i for i in engine.incidents()
+                 if i["kind"] == "straggler"]
+    assert degrade_t, "degradation never happened"
+    assert incidents, (
+        f"no straggler incident after a {slowdown}x link degradation "
+        f"of rank {straggler}")
+    first = incidents[0]
+    assert first["ranks"] == [straggler], (
+        f"incident blamed ranks {first['ranks']}, expected "
+        f"[{straggler}]")
+    assert detect_t and detect_t[0] >= degrade_t[0], (
+        "incident fired before the degradation")
+    healthy = sorted(skews[:degrade_after])
+    stats = {"phases": {"detect": {
+        "cycles": cycles,
+        "straggler_rank": straggler,
+        "blamed_ranks": first["ranks"],
+        "slowdown": slowdown,
+        "incidents": len(incidents),
+        "first_zscore": first["zscore"],
+        "healthy_skew_p50_s": round(_pct(healthy, 0.50), 9),
+        "degrade_t_s": round(degrade_t[0], 6),
+        "detect_t_s": round(detect_t[0], 6),
+        "detection_latency_s": round(detect_t[0] - degrade_t[0], 6),
+        "virtual_s": round(kernel.now, 6),
+    }}, "kv_ops": dict(fabric.ops)}
+    return _result("anomaly-detection", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1540,6 +1667,7 @@ SCENARIOS = {
     "multi-job-arbiter": multi_job_arbiter,
     "checkpoint-storm": checkpoint_storm,
     "compression-negotiation": compression_negotiation,
+    "anomaly-detection": anomaly_detection,
 }
 
 

@@ -399,3 +399,49 @@ class TestCheckpointStormSimSchema:
             # both storage victims fell back one commit: the agreed
             # restore point is commits-1 (the scenario default is 4)
             assert row["agreed_seq"] == 3
+
+
+class TestAnomalyDetectionSimSchema:
+    """BENCH_SCALING.json carries MEASURED straggler-detection-latency
+    rows from the fabric simulator (tools/hvtpusim bench-anomaly): the
+    real AnomalyEngine fed per-cycle arrival skew while one virtual
+    rank's link degrades mid-run.  These back the
+    docs/observability.md incident-detection claims."""
+
+    REQUIRED_ROW_KEYS = {
+        "ranks", "detection_latency_p50_s", "detection_latency_max_s",
+        "seeds", "measured", "method",
+    }
+
+    @pytest.fixture
+    def doc(self):
+        with open(os.path.join(_ROOT, "BENCH_SCALING.json")) as f:
+            return json.load(f)
+
+    def test_measured_rows_present_and_complete(self, doc):
+        sim = doc["anomaly_detection_sim"]
+        assert "straggler" in sim["note"].lower()
+        rows = sim["rows"]
+        assert {r["ranks"] for r in rows} >= {256, 1024}
+        for row in rows:
+            assert self.REQUIRED_ROW_KEYS <= set(row), row.get("ranks")
+            assert row["measured"] is True
+            assert "fabric-sim" in row["method"]
+
+    def test_latencies_are_finite_positive_virtual_seconds(self, doc):
+        for row in doc["anomaly_detection_sim"]["rows"]:
+            p50 = row["detection_latency_p50_s"]
+            mx = row["detection_latency_max_s"]
+            for v in (p50, mx):
+                assert isinstance(v, (int, float)) and 0 < v < 3600, (
+                    f"ranks={row['ranks']} latency={v!r}")
+            assert p50 <= mx
+            assert row["seeds"] >= 3
+
+    def test_required_keys_cover_flight_and_incidents(self):
+        import bench
+
+        required = set(bench.REQUIRED_METRIC_KEYS)
+        assert {"hvtpu_flight_events_total", "hvtpu_incidents_total",
+                "hvtpu_fleet_job_step_rate",
+                "hvtpu_fleet_job_incidents"} <= required

@@ -1,0 +1,599 @@
+"""Flight recorder + online anomaly detection (obs/flight, obs/anomaly,
+fleet/health, hvtputrace postmortem).
+
+Acceptance shape (ISSUE PR 16):
+
+- a 2-process chaos job with ``collective.pre:delay`` on rank 1 must
+  raise a ``straggler`` incident that *names rank 1*, and the same job
+  on a clean control run must raise zero incidents;
+- a ``worker.step:kill`` chaos job must leave merged postmortems from
+  both ranks that ``hvtputrace postmortem`` fuses into one timeline;
+- with the planes disabled the hot-path hook must be a single
+  module-attribute test (same contract as tracing.ACTIVE), timeit-
+  enforced below;
+- the detector itself must pass a synthetic-series matrix: silent
+  through warmup, fires on a step change and a single spike, stays
+  silent through slow drift.
+"""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+import horovod_tpu
+from horovod_tpu.fleet import health
+from horovod_tpu.obs import anomaly, flight
+from horovod_tpu.obs import metrics as obs_metrics
+from horovod_tpu.runner import RunError, run
+import tools.hvtputrace as hvtputrace
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(horovod_tpu.__file__))
+_ENV = {"PYTHONPATH": _REPO_ROOT + os.pathsep
+        + os.environ.get("PYTHONPATH", "")}
+
+
+def _read_json(path):
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    """Unit tests here install/uninstall the process singletons; make
+    sure no test leaks an installed recorder/engine into the next."""
+    flight.uninstall()
+    anomaly.uninstall()
+    yield
+    flight.uninstall()
+    anomaly.uninstall()
+
+
+# --------------------------------------------------------------------------
+# FlightRecorder unit tests
+# --------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_counts_drops(self, tmp_path):
+        rec = flight.FlightRecorder(out_dir=str(tmp_path), window=16)
+        for i in range(20):
+            rec.note("tick", {"i": i})
+        evs = rec.events()
+        assert len(evs) == 16
+        # oldest events fell off the front
+        assert [e["i"] for e in evs] == list(range(4, 20))
+        st = rec.debug_state()
+        assert st["appended"] == 20
+        assert st["dropped"] == 4
+        assert st["window"] == 16
+        assert st["kinds"] == {"tick": 16}
+
+    def test_events_carry_wall_timestamps(self, tmp_path):
+        rec = flight.FlightRecorder(out_dir=str(tmp_path), window=32)
+        rec.note("a")
+        rec.note("b", {"x": 1})
+        evs = rec.events()
+        assert [e["kind"] for e in evs] == ["a", "b"]
+        assert evs[0]["t_wall"] <= evs[1]["t_wall"]
+        # wall-converted: near the recorder's own anchor, not monotonic
+        assert abs(evs[0]["t_wall"] - rec.wall_anchor) < 60.0
+        assert evs[1]["x"] == 1
+
+    def test_last_event_t(self, tmp_path):
+        rec = flight.FlightRecorder(out_dir=str(tmp_path), window=32)
+        assert rec.last_event_t("step") is None
+        rec.note("step")
+        t1 = rec.last_event_t("step")
+        rec.note("step")
+        assert rec.last_event_t("step") >= t1
+
+    def test_dump_schema_and_reason_accumulation(self, tmp_path):
+        rec = flight.FlightRecorder(rank=3, size=8, generation=2,
+                                    out_dir=str(tmp_path), window=32)
+        rec.note("step", {"n": 1})
+        path = rec.dump("stall_abort", tensor="grad.0")
+        assert path == str(tmp_path / "postmortem-3-2.json")
+        doc = _read_json(path)
+        assert doc["schema"] == flight.POSTMORTEM_SCHEMA
+        assert doc["rank"] == 3 and doc["size"] == 8
+        assert doc["generation"] == 2
+        assert doc["reason"] == "stall_abort"
+        assert doc["reasons"] == ["stall_abort"]
+        assert doc["detail"] == {"tensor": "grad.0"}
+        assert "wall_anchor" in doc["clock"]
+        assert "mono_anchor" in doc["clock"]
+        assert any(e["kind"] == "step" for e in doc["events"])
+        assert isinstance(doc["debug"], dict)
+        assert isinstance(doc["metrics"], dict)
+        # a second trigger overwrites the file but accumulates reasons
+        path2 = rec.dump("sigusr2")
+        assert path2 == path
+        doc2 = _read_json(path)
+        assert doc2["reason"] == "sigusr2"
+        assert doc2["reasons"] == ["stall_abort", "sigusr2"]
+
+    def test_dump_never_raises(self, tmp_path):
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("x")
+        rec = flight.FlightRecorder(out_dir=str(blocker / "sub"))
+        assert rec.dump("boom") is None  # swallowed, not raised
+
+
+# --------------------------------------------------------------------------
+# module plumbing: install/uninstall, env gates, SIGUSR2
+# --------------------------------------------------------------------------
+
+class TestFlightModule:
+    def test_install_uninstall_flip_active(self, tmp_path):
+        assert flight.ACTIVE is False
+        rec = flight.install(rank=1, size=2, out_dir=str(tmp_path),
+                             sigusr2=False)
+        assert rec is not None and flight.ACTIVE is True
+        assert flight.get_recorder() is rec
+        assert flight.install(out_dir=str(tmp_path)) is rec  # idempotent
+        flight.note("hello", a=1)
+        assert any(e["kind"] == "hello" for e in rec.events())
+        assert obs_metrics.debug_snapshot()["flight"]["active"] is True
+        flight.uninstall()
+        assert flight.ACTIVE is False
+        assert flight.get_recorder() is None
+        assert "flight" not in obs_metrics.debug_snapshot()
+        flight.uninstall()  # double-uninstall is a no-op
+
+    def test_env_opt_out(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("HVTPU_FLIGHT", "0")
+        assert flight.env_enabled() is False
+        assert flight.install(out_dir=str(tmp_path)) is None
+        assert flight.ACTIVE is False
+
+    def test_env_window_parsing(self, monkeypatch):
+        monkeypatch.delenv("HVTPU_FLIGHT_WINDOW", raising=False)
+        assert flight.env_window() == 2048
+        monkeypatch.setenv("HVTPU_FLIGHT_WINDOW", "100")
+        assert flight.env_window() == 100
+        monkeypatch.setenv("HVTPU_FLIGHT_WINDOW", "4")
+        assert flight.env_window() == 16  # floor
+        monkeypatch.setenv("HVTPU_FLIGHT_WINDOW", "junk")
+        assert flight.env_window() == 2048
+
+    def test_dump_postmortem_without_recorder_needs_dir(
+            self, monkeypatch, tmp_path):
+        assert flight.get_recorder() is None
+        monkeypatch.delenv("HVTPU_FLIGHT_DIR", raising=False)
+        # no recorder + no destination: never litters the CWD
+        assert flight.dump_postmortem("restart_budget_exhausted") is None
+        monkeypatch.setenv("HVTPU_FLIGHT_DIR", str(tmp_path))
+        path = flight.dump_postmortem("restart_budget_exhausted")
+        assert path == str(tmp_path / "postmortem-driver-0.json")
+        doc = _read_json(path)
+        assert doc["rank"] == "driver"
+        assert doc["events"] == []  # transient recorder: empty ring
+
+    def test_sigusr2_dumps_on_demand(self, tmp_path):
+        rec = flight.install(rank=0, size=1, out_dir=str(tmp_path))
+        assert rec is not None
+        os.kill(os.getpid(), signal.SIGUSR2)
+        path = tmp_path / "postmortem-0-0.json"
+        deadline = time.monotonic() + 10.0
+        while not path.exists() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        doc = _read_json(path)
+        assert doc["reason"] == "sigusr2"
+        assert any(e["kind"] == "sigusr2" for e in doc["events"])
+
+
+# --------------------------------------------------------------------------
+# disabled-path overhead: one module-attribute test, nothing more
+# --------------------------------------------------------------------------
+
+def test_disabled_hooks_are_one_attribute_check():
+    """Acceptance: with the planes off, the seam guards
+    ``if flight.ACTIVE: ...`` / ``if anomaly.ACTIVE: ...`` cost one
+    module-attribute read — the same budget tracing.ACTIVE holds."""
+    import timeit
+
+    assert flight.ACTIVE is False
+    assert anomaly.ACTIVE is False
+    n = 100_000
+    t = timeit.timeit(
+        lambda: flight.ACTIVE and flight.note("x", a=1), number=n)
+    assert t / n < 5e-6, f"flight: {t / n * 1e9:.0f} ns/op"
+    t = timeit.timeit(
+        lambda: anomaly.ACTIVE and anomaly.on_step({}), number=n)
+    assert t / n < 5e-6, f"anomaly: {t / n * 1e9:.0f} ns/op"
+
+
+# --------------------------------------------------------------------------
+# detector unit matrix: warmup / step change / spike / drift
+# --------------------------------------------------------------------------
+
+def _cfg(**kw):
+    base = dict(window=16, warmup=8, threshold=6.0, ewma_alpha=0.15,
+                min_rel=0.25, cooldown_s=0.0)
+    base.update(kw)
+    return anomaly.AnomalyConfig(**base)
+
+
+class TestRobustDetector:
+    def test_silent_through_warmup(self):
+        det = anomaly.RobustDetector(_cfg())
+        # even wild values produce no verdicts before warmup samples
+        for v in [1.0, 50.0, 1.0, 80.0, 2.0, 1.0, 99.0, 1.0]:
+            assert det.update(v) is None
+        assert det.samples == 8
+
+    def test_step_change_fires(self):
+        det = anomaly.RobustDetector(_cfg())
+        for i in range(20):
+            assert det.update(1.0 + (i % 3) * 0.01) is None
+        v = det.update(10.0)
+        assert v is not None
+        assert v["zscore"] >= 6.0
+        assert 0.9 < v["baseline"] < 1.1
+        assert v["value"] == 10.0
+
+    def test_single_spike_fires_once_without_shifting_baseline(self):
+        det = anomaly.RobustDetector(_cfg())
+        for i in range(20):
+            det.update(1.0 + (i % 3) * 0.01)
+        assert det.update(10.0) is not None  # the spike
+        # the spike entered the window but median/MAD shrug it off:
+        # healthy samples afterwards stay silent
+        for i in range(10):
+            assert det.update(1.0 + (i % 3) * 0.01) is None
+
+    def test_slow_drift_does_not_fire(self):
+        det = anomaly.RobustDetector(_cfg())
+        v = 1.0
+        for _ in range(60):
+            assert det.update(v) is None
+            v *= 1.01  # +1%/sample: EWMA and the window track it
+        assert det.ewma == pytest.approx(v, rel=0.2)
+
+    def test_low_side_never_fires(self):
+        det = anomaly.RobustDetector(_cfg())
+        for i in range(20):
+            det.update(1.0 + (i % 3) * 0.01)
+        assert det.update(0.001) is None  # faster is never an incident
+
+
+class TestAnomalyEngine:
+    def test_straggler_incident_names_the_rank(self):
+        eng = anomaly.AnomalyEngine(
+            rank=0, size=8,
+            config=_cfg(window=8, warmup=4, min_rel=1.0))
+        for i in range(8):
+            assert eng.on_arrival_skew(f"g.{i}", 0.001 + (i % 2) * 1e-4,
+                                       last_rank=i % 8) == []
+        fired = eng.on_arrival_skew("g.slow", 0.5, last_rank=5)
+        assert len(fired) == 1
+        inc = fired[0]
+        assert inc["kind"] == "straggler"
+        assert inc["ranks"] == [5]
+        assert inc["detail"]["tensor"] == "g.slow"
+        assert eng.counts() == {"straggler": 1}
+        assert eng.incidents()[-1]["kind"] == "straggler"
+        assert eng.debug_state()["recent"][-1]["kind"] == "straggler"
+
+    def test_cooldown_rate_limits(self):
+        eng = anomaly.AnomalyEngine(
+            rank=0, size=2,
+            config=_cfg(window=8, warmup=4, cooldown_s=3600.0))
+        for i in range(8):
+            eng.on_arrival_skew("g", 0.001, last_rank=i % 2)
+        eng.on_arrival_skew("g", 0.5, last_rank=1)
+        eng.on_arrival_skew("g", 0.6, last_rank=1)
+        assert eng.counts() == {"straggler": 1}
+
+    def test_on_step_fires_step_time(self):
+        eng = anomaly.AnomalyEngine(
+            rank=2, size=4, config=_cfg(window=8, warmup=4))
+        for _ in range(10):
+            assert eng.on_step({"step_wall_s": 0.1, "steps": 1}) == []
+        fired = eng.on_step({"step_wall_s": 5.0, "steps": 1})
+        kinds = {i["kind"] for i in fired}
+        assert "step_time" in kinds
+        # process-local signal: blames this rank
+        inc = next(i for i in fired if i["kind"] == "step_time")
+        assert inc["ranks"] == [2]
+
+    def test_engine_install_respects_env_gate(self, monkeypatch):
+        monkeypatch.setenv("HVTPU_ANOMALY", "off")
+        assert anomaly.install() is None
+        assert anomaly.ACTIVE is False
+
+    def test_config_from_env(self, monkeypatch):
+        monkeypatch.setenv("HVTPU_ANOMALY_WINDOW", "12")
+        monkeypatch.setenv("HVTPU_ANOMALY_WARMUP", "2")  # floored to 4
+        monkeypatch.setenv("HVTPU_ANOMALY_THRESHOLD", "5.5")
+        monkeypatch.setenv("HVTPU_ANOMALY_MIN_REL", "0.5")
+        monkeypatch.setenv("HVTPU_ANOMALY_COOLDOWN_S", "2")
+        cfg = anomaly.AnomalyConfig.from_env()
+        assert cfg.window == 12
+        assert cfg.warmup == 4
+        assert cfg.threshold == 5.5
+        assert cfg.min_rel == 0.5
+        assert cfg.cooldown_s == 2.0
+
+
+# --------------------------------------------------------------------------
+# postmortem merge unit tests (tools/hvtputrace)
+# --------------------------------------------------------------------------
+
+def _fake_dump(dirpath, rank, events, *, offset_us=None, gen=0,
+               reason="stall_abort"):
+    clock = {"wall_anchor": 100.0, "mono_anchor": 0.0}
+    if offset_us is not None:
+        clock["offset_us"] = offset_us
+    doc = {
+        "schema": flight.POSTMORTEM_SCHEMA,
+        "rank": rank, "size": 2, "generation": gen,
+        "reason": reason, "reasons": [reason],
+        "t_wall": 110.0, "clock": clock,
+        "events": events, "debug": {}, "metrics": {},
+    }
+    path = os.path.join(str(dirpath), f"postmortem-{rank}-{gen}.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    return path
+
+
+class TestPostmortemMerge:
+    def test_merge_corrects_clocks_and_sorts(self, tmp_path):
+        _fake_dump(tmp_path, 0,
+                   [{"t_wall": 100.0, "kind": "a"},
+                    {"t_wall": 101.0, "kind": "b"}],
+                   offset_us=0.0)
+        # rank 1's clock runs 0.5 s fast; offset_us corrects it back
+        _fake_dump(tmp_path, 1,
+                   [{"t_wall": 100.9, "kind": "c", "x": 7}],
+                   offset_us=-500_000.0)
+        rep = hvtputrace.postmortem_merge(str(tmp_path))
+        assert rep["ranks"] == [0, 1]
+        assert [e["kind"] for e in rep["timeline"]] == ["a", "c", "b"]
+        c = rep["timeline"][1]
+        assert c["t"] == pytest.approx(100.4)
+        assert c["rank"] == 1 and c["x"] == 7
+        assert all(p["clock_corrected"] for p in rep["per_rank"])
+
+    def test_uncorrected_rank_is_flagged(self, tmp_path):
+        _fake_dump(tmp_path, 0, [{"t_wall": 1.0, "kind": "a"}],
+                   offset_us=0.0)
+        _fake_dump(tmp_path, 1, [{"t_wall": 2.0, "kind": "b"}])
+        rep = hvtputrace.postmortem_merge(str(tmp_path))
+        flags = {p["rank"]: p["clock_corrected"] for p in rep["per_rank"]}
+        assert flags == {0: True, 1: False}
+        text = hvtputrace.render_postmortem(rep)
+        assert "UNCORRECTED" in text
+        assert "[rank 1] b" in text
+
+    def test_render_tail_limits_timeline(self, tmp_path):
+        _fake_dump(tmp_path, 0,
+                   [{"t_wall": float(i), "kind": f"k{i}"}
+                    for i in range(10)], offset_us=0.0)
+        text = hvtputrace.render_postmortem(
+            hvtputrace.postmortem_merge(str(tmp_path)), tail=3)
+        assert "3 of 10 events" in text
+        assert "k9" in text and "k0" not in text
+
+    def test_empty_dir_raises_with_guidance(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="HVTPU_FLIGHT_DIR"):
+            hvtputrace.load_postmortems(str(tmp_path))
+
+    def test_cli_postmortem_subcommand(self, tmp_path, capsys):
+        from tools.hvtputrace.__main__ import main
+        _fake_dump(tmp_path, 0, [{"t_wall": 1.0, "kind": "a"}],
+                   offset_us=0.0)
+        assert main(["postmortem", str(tmp_path)]) == 0
+        assert "hvtputrace postmortem" in capsys.readouterr().out
+
+
+# --------------------------------------------------------------------------
+# fleet health rollup unit tests
+# --------------------------------------------------------------------------
+
+class _FakeKV:
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value):
+        self.store[key] = value
+
+    def key_value_try_get(self, key):
+        return self.store.get(key)
+
+
+class TestFleetHealth:
+    def test_summarize_shape(self):
+        s = health.summarize(rank=0, generation=1)
+        for k in ("t_wall", "steps", "step_rate", "incidents",
+                  "incidents_total", "stall_age_s", "restarts",
+                  "interval_s"):
+            assert k in s
+        assert s["generation"] == 1 and s["restarts"] == 1
+
+    def test_summarize_reports_stall_age(self, tmp_path):
+        rec = flight.install(out_dir=str(tmp_path), sigusr2=False)
+        rec.note("step")
+        time.sleep(0.02)
+        rec.note("stall_warning", {"tensor": "g"})
+        s = health.summarize(rank=0, generation=0)
+        assert s["stall_age_s"] > 0.0
+        # a newer step clears the stall age
+        rec.note("step")
+        assert health.summarize(rank=0, generation=0)["stall_age_s"] == 0.0
+
+    def test_publish_read_round_trip_and_staleness(self):
+        kv = _FakeKV()
+        rep = health.HealthReporter(kv, "trainer", rank=0, interval_s=5.0)
+        published = rep.publish_once()
+        assert published is not None
+        assert "fleet/trainer/health" in kv.store
+        got = health.read(kv, "trainer")
+        assert got["job"] == "trainer"
+        assert got["stale"] is False
+        # an old summary reads back stale after STALE_INTERVALS cadences
+        later = published["t_wall"] + published["interval_s"] * 10
+        assert health.read(kv, "trainer", now_wall=later)["stale"] is True
+        assert health.read(kv, "missing-job") is None
+
+    def test_publish_once_never_raises(self):
+        class _Broken:
+            def key_value_set(self, key, value):
+                raise RuntimeError("kv down")
+
+            def key_value_try_get(self, key):
+                raise RuntimeError("kv down")
+
+        rep = health.HealthReporter(_Broken(), "j", rank=0, interval_s=5)
+        assert rep.publish_once() is None
+        assert health.read(_Broken(), "j") is None
+
+    def test_file_channel_round_trip_and_staleness(self, tmp_path):
+        # no KV at all: a real deployment's arbiter is not a member of
+        # the job's coordination world, so the file channel carries it
+        rep = health.HealthReporter(None, "trainer", rank=0,
+                                    interval_s=5.0,
+                                    file_dir=str(tmp_path))
+        published = rep.publish_once()
+        assert published is not None
+        assert (tmp_path / health.HEALTH_FILE).is_file()
+        got = health.read_file(str(tmp_path))
+        assert got["job"] == "trainer"
+        assert got["stale"] is False
+        later = published["t_wall"] + published["interval_s"] * 10
+        assert health.read_file(
+            str(tmp_path), now_wall=later)["stale"] is True
+        assert health.read_file(str(tmp_path / "missing")) is None
+
+    def test_file_channel_tolerates_torn_file_and_broken_kv(
+            self, tmp_path):
+        (tmp_path / health.HEALTH_FILE).write_text('{"t_wall": 1,')
+        assert health.read_file(str(tmp_path)) is None
+
+        class _Broken:
+            def key_value_set(self, key, value):
+                raise RuntimeError("kv down")
+
+        # KV down but the file channel still lands the summary
+        rep = health.HealthReporter(_Broken(), "j", rank=0,
+                                    interval_s=5.0,
+                                    file_dir=str(tmp_path))
+        assert rep.publish_once() is not None
+        assert health.read_file(str(tmp_path))["job"] == "j"
+
+
+# --------------------------------------------------------------------------
+# 2-process chaos acceptance
+# --------------------------------------------------------------------------
+
+_ANOMALY_ENV = {
+    # small window/warmup so the job fires within a short run; a high
+    # relative floor (value must exceed 21x the median skew) keeps the
+    # clean control run silent under CPU scheduler jitter while a
+    # 300 ms injected delay clears it by orders of magnitude.
+    "HVTPU_ANOMALY_WINDOW": "8",
+    "HVTPU_ANOMALY_WARMUP": "6",
+    "HVTPU_ANOMALY_THRESHOLD": "8",
+    "HVTPU_ANOMALY_MIN_REL": "20",
+    "HVTPU_ANOMALY_COOLDOWN_S": "0",
+    # arrival-skew drain lives in the Python controller twin
+    "HVTPU_FORCE_PY_CONTROLLER": "1",
+}
+
+
+def _make_anomaly_body():
+    # defined inside a factory so cloudpickle ships it by value (the
+    # worker can't import the tests package)
+    def _anomaly_body():
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvt
+        from horovod_tpu.obs import anomaly as _anomaly
+
+        hvt.init()
+        assert _anomaly.ACTIVE is True
+        # async ops: issuance goes through the eager controller, whose
+        # coordinator records per-op arrival skew (the straggler feed);
+        # sync collectives never announce and leave no skew trail
+        for i in range(24):
+            h = hvt.allreduce_async(jnp.ones((256,), jnp.float32),
+                                    name=f"g{i}")
+            hvt.synchronize(h)
+        eng = _anomaly.get_engine()
+        counts = eng.counts() if eng else {}
+        blamed = sorted({r for i in (eng.incidents() if eng else [])
+                         if i["kind"] == "straggler" for r in i["ranks"]})
+        hvt.shutdown()
+        return (counts, blamed)
+
+    return _anomaly_body
+
+
+@pytest.mark.multiprocess
+def test_straggler_incident_names_rank_2proc():
+    """Chaos: a 300 ms pre-collective (issuance-boundary) delay on
+    rank 1, after 9 healthy collectives establish the baseline, raises
+    a straggler incident blaming exactly rank 1 on the coordinator."""
+    env = dict(
+        _ENV, **_ANOMALY_ENV,
+        HVTPU_FAULT_SPEC="collective.pre:delay(300)@rank=1,count=10",
+    )
+    results = run(_make_anomaly_body(), np=2, cpu_devices=1, env=env,
+                  start_timeout=300.0)
+    counts0, blamed0 = results[0]
+    assert counts0.get("straggler", 0) >= 1, counts0
+    assert blamed0 == [1], blamed0
+
+
+@pytest.mark.multiprocess
+def test_clean_control_run_raises_zero_incidents_2proc():
+    """Control: the same job with no fault injected must stay silent —
+    the detector's floor absorbs healthy loopback jitter."""
+    env = dict(_ENV, **_ANOMALY_ENV)
+    results = run(_make_anomaly_body(), np=2, cpu_devices=1, env=env,
+                  start_timeout=300.0)
+    for counts, blamed in results:
+        assert counts == {}, counts
+        assert blamed == []
+
+
+@pytest.mark.multiprocess
+def test_worker_kill_leaves_postmortems_both_ranks_2proc(tmp_path):
+    """Chaos: both workers die at their 2nd step; each flight recorder
+    dumps a fault_kill postmortem on the way down, and hvtputrace
+    fuses them into one two-rank timeline."""
+
+    def body():
+        import horovod_tpu as hvt
+        from horovod_tpu.elastic import worker as _worker
+
+        hvt.init()
+        for _ in range(4):
+            _worker.note_step()
+        hvt.shutdown()
+        return "survived"  # unreachable: the kill fires at step 2
+
+    env = dict(
+        _ENV,
+        HVTPU_FLIGHT_DIR=str(tmp_path),
+        HVTPU_FAULT_SPEC="worker.step:kill@rank=0|1,count=2",
+    )
+    with pytest.raises(RunError):
+        run(body, np=2, cpu_devices=1, env=env, start_timeout=300.0)
+
+    for rank in (0, 1):
+        doc = _read_json(tmp_path / f"postmortem-{rank}-0.json")
+        assert doc["schema"] == flight.POSTMORTEM_SCHEMA
+        assert doc["reason"] == "fault_kill"
+        assert doc["rank"] == rank
+        assert doc["detail"]["site"] == "worker.step"
+        assert any(e["kind"] == "flight_start" for e in doc["events"])
+
+    from tools.hvtputrace.__main__ import main
+    assert main(["postmortem", str(tmp_path)]) == 0
+    rep = hvtputrace.postmortem_merge(str(tmp_path))
+    assert rep["ranks"] == [0, 1]
+    assert {e["rank"] for e in rep["timeline"]} == {0, 1}

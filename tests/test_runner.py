@@ -326,6 +326,17 @@ class TestFlagPlumbing:
         # unset: never exported, tracing stays off on the workers
         assert "HVTPU_TRACE" not in self._env_for(["-np", "2"])
 
+    def test_flight_flags(self):
+        env = self._env_for(["-np", "2", "--flight-dir", "/tmp/fl",
+                             "--flight-window", "512"])
+        assert env["HVTPU_FLIGHT_DIR"] == "/tmp/fl"
+        assert env["HVTPU_FLIGHT_WINDOW"] == "512"
+        # unset: never exported — the recorder falls back to its env
+        # defaults (ring on, dumps beside the trace dir / CWD)
+        bare = self._env_for(["-np", "2"])
+        assert "HVTPU_FLIGHT_DIR" not in bare
+        assert "HVTPU_FLIGHT_WINDOW" not in bare
+
     def test_env_passthrough_set_and_copy(self):
         env = self._env_for(
             ["-np", "2", "-x", "FOO=bar", "-x", "INHERITED"])

@@ -213,7 +213,7 @@ class TestDeterminism:
             "thundering-rendezvous", "steady-drain", "rolling-preemption",
             "kill-blacklist", "kv-brownout", "straggler-tail",
             "stream-matrix", "multi-job-arbiter", "checkpoint-storm",
-            "compression-negotiation"}
+            "compression-negotiation", "anomaly-detection"}
         with pytest.raises(KeyError, match="steady-drain"):
             run_scenario("no-such-scenario", 8)
 

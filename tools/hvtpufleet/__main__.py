@@ -108,6 +108,53 @@ def _cmd_list(args) -> int:
     return 0
 
 
+def _fmt_health(j):
+    """One `top` row's health columns from a job's attached summary
+    (written by the arbiter's health poll into state.json)."""
+    h = j.get("health")
+    if not isinstance(h, dict):
+        return "-", "-", "-", "-"
+    rate = h.get("step_rate")
+    incid = h.get("incidents_total", 0)
+    restarts = h.get("restarts", 0)
+    stall = h.get("stall_age_s") or 0.0
+    stale = " *" if h.get("stale") else ""
+    return (f"{rate:.2f}{stale}" if isinstance(rate, (int, float))
+            else "-",
+            str(incid), str(restarts),
+            f"{stall:.0f}" if stall else "-")
+
+
+def _cmd_top(args) -> int:
+    d = _fleet_dir(args)
+    path = os.path.join(d, "state.json")
+    try:
+        with open(path) as f:
+            state = json.load(f)
+    except OSError:
+        print(f"hvtpufleet: no state at {path} — is an arbiter "
+              f"serving this fleet dir?", file=sys.stderr)
+        return 1
+    pool = state.get("pool", {})
+    print(f"pool: {pool.get('slots_total', 0)} slots "
+          f"({pool.get('slots_free', 0)} free); "
+          f"as of t={state.get('t_wall', 0)}")
+    rows = [("JOB", "STATE", "NP", "STEP/S", "INCID", "RESTARTS",
+             "STALL_S")]
+    for j in state.get("jobs", []):
+        rate, incid, restarts, stall = _fmt_health(j)
+        rows.append((
+            j.get("name", "?"), j.get("state", "?"),
+            str(sum((j.get("allocation") or {}).values())),
+            rate, incid, restarts, stall,
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    print("(* = stale health summary; job stopped publishing)")
+    return 0
+
+
 def _cmd_cancel(args) -> int:
     d = _fleet_dir(args)
     spool = os.path.join(d, "cancel")
@@ -153,6 +200,11 @@ def main(argv=None) -> int:
     s.add_argument("--json", action="store_true",
                    help="Raw state.json instead of the table.")
     s.set_defaults(fn=_cmd_list)
+
+    s = sub.add_parser(
+        "top", help="Per-job health: step rate, incidents, restarts, "
+        "stall age.")
+    s.set_defaults(fn=_cmd_top)
 
     s = sub.add_parser("cancel", help="Request cancellation of a job.")
     s.add_argument("name", help="Job name to cancel.")

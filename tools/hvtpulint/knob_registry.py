@@ -181,8 +181,21 @@ def _cli_flags(project: Project, knobs: Dict[str, Knob]) -> None:
                 and isinstance(node.value, ast.Dict)):
             for key, val in zip(node.value.keys, node.value.values):
                 name = _knob_name(key) if key is not None else None
-                if name and isinstance(val, ast.Attribute):
-                    flag = dest_to_flag.get(val.attr)
+                if not name:
+                    continue
+                # args.attr, or the getattr(args, "attr", None) spelling
+                # launch.py uses for flags absent from older namespaces.
+                dest = None
+                if isinstance(val, ast.Attribute):
+                    dest = val.attr
+                elif (isinstance(val, ast.Call)
+                        and isinstance(val.func, ast.Name)
+                        and val.func.id == "getattr" and len(val.args) >= 2
+                        and isinstance(val.args[1], ast.Constant)
+                        and isinstance(val.args[1].value, str)):
+                    dest = val.args[1].value
+                if dest:
+                    flag = dest_to_flag.get(dest)
                     if flag and name in knobs:
                         knobs[name].cli_flag = flag
 

@@ -592,3 +592,124 @@ def render_report(rep: dict) -> str:
             f"{c['arrival_skew_us'] / 1e3:.2f} ms after "
             f"rank {c['first_rank']}")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# postmortem merge (obs/flight.py dumps)
+# ---------------------------------------------------------------------------
+
+_POSTMORTEM_FILE_RE = re.compile(r"postmortem-(.+)-(\d+)\.json$")
+
+
+def load_postmortems(dump_dir: str) -> List[dict]:
+    """Every parseable ``postmortem-<rank>-<gen>.json`` in the dir,
+    sorted by (generation, rank)."""
+    docs = []
+    for path in sorted(glob.glob(os.path.join(dump_dir,
+                                              "postmortem-*.json"))):
+        if not _POSTMORTEM_FILE_RE.search(os.path.basename(path)):
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(doc, dict):
+            doc["_path"] = path
+            docs.append(doc)
+    if not docs:
+        raise FileNotFoundError(
+            f"no postmortem-*.json files in {dump_dir!r} — postmortems "
+            "are written by obs/flight.py on fatal paths (or SIGUSR2) "
+            "into HVTPU_FLIGHT_DIR")
+    def _key(d):
+        r = d.get("rank")
+        return (d.get("generation", 0),
+                (0, r) if isinstance(r, int) else (1, str(r)))
+    docs.sort(key=_key)
+    return docs
+
+
+def postmortem_merge(dump_dir: str) -> dict:
+    """Fuse per-rank postmortems into one clock-corrected causal
+    timeline.
+
+    Each dump's events already carry wall-clock timestamps from its
+    own rank's clock; the tracing handshake offset (``clock.offset_us``,
+    rank0-relative) recorded in the dump corrects them onto rank 0's
+    clock.  Ranks without an offset merge uncorrected (flagged in
+    their summary row).
+    """
+    docs = load_postmortems(dump_dir)
+    timeline: List[dict] = []
+    per_rank: List[dict] = []
+    for doc in docs:
+        rank = doc.get("rank", "?")
+        clk = doc.get("clock") or {}
+        offset_us = clk.get("offset_us")
+        shift = float(offset_us) / 1e6 if offset_us is not None else 0.0
+        events = doc.get("events") or []
+        per_rank.append({
+            "rank": rank,
+            "generation": doc.get("generation", 0),
+            "reason": doc.get("reason"),
+            "reasons": doc.get("reasons") or [],
+            "t_wall": doc.get("t_wall"),
+            "events": len(events),
+            "clock_offset_us": offset_us,
+            "clock_corrected": offset_us is not None,
+            "path": doc.get("_path"),
+        })
+        for e in events:
+            if not isinstance(e, dict):
+                continue
+            e = dict(e)
+            t = e.pop("t_wall", None)
+            kind = e.pop("kind", "?")
+            timeline.append({
+                "t": (float(t) + shift) if t is not None else 0.0,
+                "rank": rank,
+                "kind": kind,
+                **e,
+            })
+    timeline.sort(key=lambda e: e["t"])
+    return {
+        "dump_dir": dump_dir,
+        "ranks": [p["rank"] for p in per_rank],
+        "per_rank": per_rank,
+        "timeline": timeline,
+    }
+
+
+def render_postmortem(rep: dict, *, tail: int = 0) -> str:
+    """Human-readable rendering of postmortem_merge()'s dict: the
+    per-rank dump summary, then the merged timeline (all of it, or the
+    last ``tail`` events)."""
+    lines = [f"hvtputrace postmortem — {rep['dump_dir']} "
+             f"(ranks: {rep['ranks']})", ""]
+    lines.append("dumps:")
+    for p in rep["per_rank"]:
+        off = p["clock_offset_us"]
+        corr = (f"offset {off:+.0f}us" if off is not None
+                else "UNCORRECTED clock")
+        lines.append(
+            f"  rank {p['rank']} gen {p['generation']}: "
+            f"reason={p['reason']} ({', '.join(p['reasons'])}), "
+            f"{p['events']} events, {corr}")
+    timeline = rep["timeline"]
+    shown = timeline[-tail:] if tail and tail > 0 else timeline
+    lines.append("")
+    lines.append(f"timeline ({len(shown)} of {len(timeline)} events, "
+                 "rank-0 clock):")
+    if not timeline:
+        lines.append("  (empty rings)")
+        return "\n".join(lines)
+    t0 = timeline[0]["t"]
+    for e in shown:
+        extras = " ".join(
+            f"{k}={e[k]}" for k in sorted(e)
+            if k not in ("t", "rank", "kind"))
+        lines.append(
+            f"  +{e['t'] - t0:10.6f}s  [rank {e['rank']}] "
+            f"{e['kind']}" + (f"  {extras}" if extras else ""))
+    return "\n".join(lines)

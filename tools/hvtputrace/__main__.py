@@ -7,7 +7,8 @@ import json
 import os
 import sys
 
-from . import merge, overlap, render_overlap, render_report, report
+from . import (merge, overlap, postmortem_merge, render_overlap,
+               render_postmortem, render_report, report)
 
 
 def main(argv=None) -> int:
@@ -50,7 +51,24 @@ def main(argv=None) -> int:
     po.add_argument("--json", action="store_true",
                     help="emit the raw overlap dict as JSON")
 
+    pp = sub.add_parser(
+        "postmortem", help="merge postmortem-<rank>-<gen>.json flight-"
+                           "recorder dumps into one clock-corrected "
+                           "causal timeline")
+    pp.add_argument("dump_dir",
+                    help="directory holding the dumps (HVTPU_FLIGHT_DIR)")
+    pp.add_argument("--tail", type=int, default=0,
+                    help="show only the last N timeline events "
+                         "(default: all)")
+    pp.add_argument("--json", action="store_true",
+                    help="emit the merged dict as JSON")
+
     args = p.parse_args(argv)
+    if args.cmd == "postmortem":
+        rep = postmortem_merge(args.dump_dir)
+        print(json.dumps(rep, indent=2, default=str) if args.json
+              else render_postmortem(rep, tail=args.tail))
+        return 0
     if args.cmd == "overlap":
         rep = overlap(args.trace_dir, xplane_dir=args.xplane,
                       top=args.top)
