@@ -160,6 +160,15 @@ REQUIRED_METRIC_KEYS = (
     "hvtpu_incidents_total",
     "hvtpu_fleet_job_step_rate",
     "hvtpu_fleet_job_incidents",
+    # coordination-plane fault tolerance (PR 17, core/retry.py,
+    # comm/stall.py): fencing-token rejections and fence exits are 0
+    # on a healthy run — nonzero names a round where a superseded or
+    # lease-expired writer was stopped; the suspect histogram counts
+    # seconds peers held stall blame for a silent-but-leased rank
+    # instead of declaring it dead.
+    "hvtpu_kv_fenced_writes_total",
+    "hvtpu_fence_exits_total",
+    "hvtpu_partition_suspect_seconds",
 )
 
 

@@ -63,6 +63,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
 import threading
 from collections import deque
@@ -88,6 +89,11 @@ _M_WARNINGS = obs_metrics.counter(
 _M_ABORTS = obs_metrics.counter(
     "hvtpu_stall_aborts_total",
     "Stall/mismatch failures latched or raised (job-fatal).")
+_M_SUSPECT_S = obs_metrics.histogram(
+    "hvtpu_partition_suspect_seconds",
+    "How long silent peers spent in the partitioned-suspect state "
+    "(stall blame held) before recovering or being declared dead; "
+    "observed at resolution.")
 
 _NS = "hvtstall"      # strict-mode per-op rendezvous marks
 _HB = "hvtstallhb"    # amortized-mode heartbeat snapshots
@@ -393,6 +399,7 @@ class AmortizedStallInspector:
     def __init__(self, client, rank: int, warn_s: float, abort_s: float,
                  heartbeat_s: float = 0.5, generation: int = 0,
                  stale_s: Optional[float] = None,
+                 suspect_s: Optional[float] = None,
                  start_heartbeat: bool = True):
         self._kv = client
         self.rank = rank
@@ -404,6 +411,19 @@ class AmortizedStallInspector:
         # caught up — it may have died MID-collective, after posting
         self.stale_s = (max(5 * self.heartbeat_s, 2.0)
                         if stale_s is None else stale_s)
+        # partitioned-vs-dead classification (HVTPU_PARTITION_SUSPECT_S,
+        # default 0 = off): a peer stale for (stale_s, stale_s +
+        # suspect_s] is a partition SUSPECT — silent because it may be
+        # cut off from the KV, not dead — and stall blame is held while
+        # it either recovers or self-fences on its own lease
+        # (core/retry.py FencedKV).  Past the suspect window it is
+        # classified dead and blamed normally.
+        self.suspect_s = (
+            float(os.environ.get("HVTPU_PARTITION_SUSPECT_S", "0") or 0)
+            if suspect_s is None else suspect_s)
+        # rank -> when it entered the suspect state; touched only from
+        # the heartbeat thread
+        self._suspected: Dict[int, float] = {}
         # rank -> (last beat number, when it last changed); touched
         # only from the heartbeat thread
         self._peer_seen: Dict[int, tuple] = {}
@@ -575,6 +595,8 @@ class AmortizedStallInspector:
             "generation": self.gen,
             "heartbeat_s": self.heartbeat_s,
             "stale_s": self.stale_s,
+            "suspect_s": self.suspect_s,
+            "partition_suspects": sorted(self._suspected),
             "peer_heartbeat_age_s": ages,
             "failure": self.failure,
         }
@@ -691,14 +713,46 @@ class AmortizedStallInspector:
                 peers[r] = snap
         stale = {r for r, (_b, t) in self._peer_seen.items()
                  if r not in bye and now - t > self.stale_s}
-        self._evaluate(peers, stale, bye, bye_fails)
+        suspect = set()
+        if self.suspect_s > 0:
+            # partitioned-vs-dead split by lease age: freshly-stale
+            # peers are SUSPECTS (blame held), peers silent past the
+            # suspect window are dead (blamed normally)
+            for r in list(stale):
+                if now - self._peer_seen[r][1] <= (self.stale_s
+                                                   + self.suspect_s):
+                    suspect.add(r)
+            stale -= suspect
+            for r in suspect:
+                if r not in self._suspected:
+                    self._suspected[r] = now
+                    logger.warning(
+                        "rank %d heartbeat silent %.1fs: partition "
+                        "suspect — holding stall blame for %.1fs",
+                        r, now - self._peer_seen[r][1], self.suspect_s)
+                    if flight.ACTIVE:
+                        flight.note("partition_suspect", rank=self.rank,
+                                    peer=r)
+            for r in list(self._suspected):
+                if r not in suspect:
+                    _M_SUSPECT_S.observe(now - self._suspected.pop(r))
+                    outcome = "dead" if r in stale else "recovered"
+                    logger.info("rank %d left the partition-suspect "
+                                "state: %s", r, outcome)
+                    if flight.ACTIVE:
+                        flight.note("partition_resolved",
+                                    rank=self.rank, peer=r,
+                                    outcome=outcome)
+        self._evaluate(peers, stale, bye, bye_fails, suspect=suspect)
 
     def _evaluate(self, peers: Dict[int, dict],
                   stale: Optional[set] = None,
                   bye: Optional[set] = None,
-                  bye_fails: Optional[list] = None) -> None:
+                  bye_fails: Optional[list] = None,
+                  suspect: Optional[set] = None) -> None:
         stale = stale or set()
         bye = bye or set()
+        suspect = suspect or set()
         now = clock.monotonic()
         fail: Optional[str] = None
         warns: List[tuple] = []
@@ -767,7 +821,7 @@ class AmortizedStallInspector:
                         # a stale peer counts as absent even when its
                         # last snapshot showed it caught up: it may
                         # have died mid-collective, after posting
-                        if pseq < tr.seq or r in stale:
+                        if pseq < tr.seq or r in stale or r in suspect:
                             if r in draining:
                                 # inside its drain grace window
                                 # (core/preempt.py): heading for the
@@ -775,6 +829,13 @@ class AmortizedStallInspector:
                                 # don't blame.  The exclusion expires
                                 # with the window, unlike bye.
                                 drain_behind.append(r)
+                            elif r in suspect:
+                                # partition suspect: silent because it
+                                # may be cut off from the KV, not dead
+                                # — hold the blame until it recovers
+                                # or ages into the dead set (the
+                                # transition was logged in _beat_once)
+                                pass
                             else:
                                 behind.append(r)
                     if not behind:
@@ -837,10 +898,13 @@ def _make_inspector(st, cfg):
     if client is not None:
         # Transient coordinator blips (or injected kv.* faults) retry
         # with backoff instead of surfacing through the watchdog as an
-        # instant failure; see core/retry.py.
-        from ..core.retry import resilient_kv
+        # instant failure, and heartbeats carry this incarnation's
+        # fencing token — a superseded (zombie) rank's beats are
+        # invisible to live readers and the zombie self-fences; see
+        # core/retry.py (FencedKV).
+        from ..core.retry import fenced_kv
 
-        client = resilient_kv(client, rank=st.rank)
+        client = fenced_kv(client, rank=st.rank)
     if client is None:
         st.sync_stall = False
         logger.warning(

@@ -384,6 +384,11 @@ def restore_quorum(kv, *, rank: int, size: int, local_best: Optional[int],
     pick, and the sim asserts the full-quorum path.
     """
     _M_QUORUM_ROUNDS.inc()
+    if hasattr(kv, "add_journal_prefix"):
+        # Quorum votes are the canonical "history a fresh coordinator
+        # cannot recompute" (core/journal.py): journal this rank's
+        # vote so a coordinator-loss relaunch can replay it.
+        kv.add_journal_prefix(f"{namespace}/")
     vote = -1 if local_best is None else int(local_best)
     kv.key_value_set(f"{namespace}/vote/{rank}", str(vote))
     timeout_ms = int((_quorum_timeout_s() if timeout_s is None

@@ -18,7 +18,7 @@ Grammar (full reference in docs/robustness.md)::
             | ckpt.write | ckpt.fsync | ckpt.rename
     ACTION := drop | delay(MS) | error | kill | preempt
             | corrupt | corrupt(nan) | corrupt(bitflip)
-            | torn | bitflip
+            | torn | bitflip | partition(MS)
     SEL    := rank=R[|R...] | pset=ID | count=N | prob=P | times=K
 
 Examples::
@@ -34,6 +34,12 @@ Examples::
                                          # writes truncated mid-file
     ckpt.rename:kill@rank=0,count=2      # rank 0 dies at its 2nd
                                          # commit-rename (torn commit)
+    kv.put:partition(3000)@rank=3,count=5   # from rank 3's 5th KV
+                                         # write, ALL of its kv.get/
+                                         # kv.put/heartbeat traffic is
+                                         # dropped for 3 seconds (a
+                                         # network partition, not a
+                                         # single lost op)
 
 Selector semantics:
 
@@ -44,8 +50,8 @@ Selector semantics:
   counted per process per clause).
 - ``prob=P`` — fire with probability P from a per-``(seed, rank,
   clause)`` RNG, so a given seed reproduces the same fault schedule.
-- ``times=K`` — at most K firings (default: 1 for ``kill`` and
-  ``preempt``, unlimited otherwise).  Finite ``times`` persist across elastic incarnations
+- ``times=K`` — at most K firings (default: 1 for ``kill``,
+  ``preempt`` and ``partition``, unlimited otherwise).  Finite ``times`` persist across elastic incarnations
   through a marker file under ``HVTPU_FAULT_STATE_DIR`` (defaulting to
   the driver-provided ``HVTPU_ELASTIC_STATE_DIR``), so a relaunched
   worker does not replay a one-shot kill forever.
@@ -90,8 +96,17 @@ SITES = ("kv.get", "kv.put", "heartbeat", "collective.pre",
 
 _STORAGE_SITES = ("ckpt.write", "ckpt.fsync", "ckpt.rename")
 
+#: Coordination-plane sites a ``partition(MS)`` clause silences as a
+#: unit.  Unlike ``drop`` (one lost operation), a fired partition opens
+#: a wall-clock window during which EVERY kv.get/kv.put/heartbeat on
+#: this rank is suppressed — the from-the-rank's-point-of-view shape of
+#: a real network partition, which is what the lease-based self-fencing
+#: in core/retry.py and the partitioned-vs-dead classification in
+#: comm/stall.py exist to survive.
+_PARTITION_SITES = ("kv.get", "kv.put", "heartbeat")
+
 ACTIONS = ("drop", "delay", "error", "kill", "preempt", "corrupt",
-           "torn", "bitflip")
+           "torn", "bitflip", "partition")
 
 #: Module-level fast path: False means ``inject`` is never entered.
 ACTIVE = False
@@ -131,23 +146,26 @@ class InjectedFault(RuntimeError):
 
 _DELAY_RE = re.compile(r"^delay\((\d+(?:\.\d+)?)\)$")
 _CORRUPT_RE = re.compile(r"^corrupt(?:\((nan|bitflip)\))?$")
+_PARTITION_RE = re.compile(r"^partition\((\d+(?:\.\d+)?)\)$")
 
 
 class FaultClause:
     """One parsed ``site:action[@selectors]`` clause."""
 
-    __slots__ = ("site", "action", "delay_ms", "corrupt_mode", "ranks",
-                 "pset", "count", "prob", "times", "index", "source",
-                 "_fired", "_seen", "_rng")
+    __slots__ = ("site", "action", "delay_ms", "corrupt_mode",
+                 "partition_ms", "ranks", "pset", "count", "prob",
+                 "times", "index", "source", "_fired", "_seen", "_rng")
 
     def __init__(self, site: str, action: str, delay_ms: float,
                  ranks: Optional[frozenset], pset: Optional[int],
                  count: int, prob: Optional[float], times: int,
-                 index: int, source: str, corrupt_mode: str = "nan"):
+                 index: int, source: str, corrupt_mode: str = "nan",
+                 partition_ms: float = 0.0):
         self.site = site
         self.action = action
         self.delay_ms = delay_ms
         self.corrupt_mode = corrupt_mode
+        self.partition_ms = partition_ms
         self.ranks = ranks          # None = all ranks
         self.pset = pset            # None = any process set
         self.count = count          # fire from the count-th match (1-based)
@@ -209,12 +227,16 @@ def parse_spec(spec: str) -> List[FaultClause]:
         action_s = action_s.strip()
         delay_ms = 0.0
         corrupt_mode = "nan"
+        partition_ms = 0.0
         m = _DELAY_RE.match(action_s)
         mc = _CORRUPT_RE.match(action_s)
+        mp = _PARTITION_RE.match(action_s)
         if m:
             action, delay_ms = "delay", float(m.group(1))
         elif mc:
             action, corrupt_mode = "corrupt", mc.group(1) or "nan"
+        elif mp:
+            action, partition_ms = "partition", float(mp.group(1))
         elif action_s in ("drop", "error", "kill", "preempt",
                           "torn", "bitflip"):
             action = action_s
@@ -222,16 +244,21 @@ def parse_spec(spec: str) -> List[FaultClause]:
             raise FaultSpecError(
                 f"fault clause {raw!r}: unknown action {action_s!r} "
                 "(known: drop, delay(MS), error, kill, preempt, "
-                "corrupt[(nan|bitflip)], torn, bitflip)")
+                "corrupt[(nan|bitflip)], torn, bitflip, partition(MS))")
         if action in ("torn", "bitflip") and site not in _STORAGE_SITES:
             raise FaultSpecError(
                 f"fault clause {raw!r}: action {action!r} only applies "
                 f"at storage sites ({', '.join(_STORAGE_SITES)})")
+        if action == "partition" and site not in _PARTITION_SITES:
+            raise FaultSpecError(
+                f"fault clause {raw!r}: action 'partition' only applies "
+                f"at coordination sites ({', '.join(_PARTITION_SITES)})")
         ranks = pset = prob = None
         count = 1
-        # one-shot by default: a rank dies (kill) or departs (preempt)
-        # at most once per job unless times= says otherwise
-        times = 1 if action in ("kill", "preempt") else 0
+        # one-shot by default: a rank dies (kill), departs (preempt),
+        # or loses the network (partition) at most once per job unless
+        # times= says otherwise
+        times = 1 if action in ("kill", "preempt", "partition") else 0
         for sel in filter(None, (s.strip() for s in sel_s.split(","))):
             if "=" not in sel:
                 raise FaultSpecError(
@@ -267,7 +294,8 @@ def parse_spec(spec: str) -> List[FaultClause]:
                     f"{sel!r}") from None
         clauses.append(FaultClause(
             site, action, delay_ms, ranks, pset, count, prob, times,
-            index=len(clauses), source=raw, corrupt_mode=corrupt_mode))
+            index=len(clauses), source=raw, corrupt_mode=corrupt_mode,
+            partition_ms=partition_ms))
     return clauses
 
 
@@ -291,6 +319,10 @@ class FaultRegistry:
         # virtual rank can die without taking the host process with it
         self._exit_fn = exit_fn
         self._lock = threading.Lock()
+        # a fired partition(MS) clause opens a window on the (possibly
+        # virtual) clock during which EVERY _PARTITION_SITES operation
+        # on this registry is dropped — one clause, full silence
+        self._partition_until = 0.0  # hvtpulint: guarded-by(_lock)
         self._by_site: Dict[str, List[FaultClause]] = {}
         for c in clauses:
             c.bind(rank, seed, self._load_fired(c))
@@ -361,6 +393,16 @@ class FaultRegistry:
             return False
         if fired.action == "drop":
             return True
+        if fired.action == "partition":
+            until = clock.monotonic() + fired.partition_ms / 1000.0
+            with self._lock:
+                self._partition_until = max(self._partition_until, until)
+            from ..obs import flight as _flight
+
+            if _flight.ACTIVE:
+                _flight.note("partition_start", rank=self.rank,
+                             window_ms=fired.partition_ms, site=site)
+            return True  # the triggering op is the window's first loss
         if fired.action == "error":
             raise InjectedFault(fired, site)
         if fired.action == "preempt":
@@ -390,8 +432,22 @@ class FaultRegistry:
             return False
         os._exit(1)
 
+    def partition_remaining(self) -> float:
+        """Seconds left in an open partition window (0.0 when none)."""
+        with self._lock:
+            until = self._partition_until
+        return max(0.0, until - clock.monotonic())
+
     def inject(self, site: str, pset=None, detail: Optional[str] = None
                ) -> bool:
+        # An open partition window silences every coordination site on
+        # this rank before any per-clause selection runs.
+        if site in _PARTITION_SITES:
+            with self._lock:
+                partitioned = (self._partition_until
+                               and clock.monotonic() < self._partition_until)
+            if partitioned:
+                return True
         fired = self._select(site, pset, tensor_site=False)
         if fired is None:
             return False
@@ -565,3 +621,12 @@ def inject_storage(site: str, detail: Optional[str] = None
     if reg is None:
         return None
     return reg.inject_storage(site, detail=detail)
+
+
+def partition_remaining() -> float:
+    """Seconds left in the calling thread's open ``partition(MS)``
+    window (0.0 when none is armed/open) — test and sim probe."""
+    reg = _current()
+    if reg is None:
+        return 0.0
+    return reg.partition_remaining()

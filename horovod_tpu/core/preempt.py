@@ -578,6 +578,11 @@ def install(cfg, rank: int, size: int, client=None) -> None:
         if _coord is not None:
             _uninstall_locked()
         gen = int(os.environ.get("HVTPU_ELASTIC_GENERATION", "0") or 0)
+        if hasattr(client, "add_journal_prefix"):
+            # Drain accounting is durable history a coordinator-loss
+            # relaunch must see: journal this rank's writes under the
+            # drain namespace for replay (core/journal.py).
+            client.add_journal_prefix(f"{_NS}/")
         _coord = _DrainCoordinator(
             rank=rank, size=size,
             grace_s=getattr(cfg, "drain_grace_seconds", 30.0),

@@ -78,6 +78,41 @@ def _job_debug_state() -> dict:
     }
 
 
+def _replay_journal(kv, rank: int) -> None:
+    """Relaunched incarnation: re-publish this rank's journaled durable
+    keys (restore-quorum votes, drain accounting — core/journal.py)
+    into the fresh coordination KV.  Every elastic relaunch starts an
+    EMPTY KV (new coordinator port, possibly a re-elected coordinator
+    host), so without replay a coordinator loss also loses the
+    accounting the recovery protocols need.  Best-effort: a failed
+    replay degrades to the protocols recomputing from scratch."""
+    import logging as _logging
+    import os as _os
+
+    if kv is None:
+        return
+    if int(_os.environ.get("HVTPU_ELASTIC_GENERATION", "0") or 0) <= 0:
+        return
+    try:
+        from .journal import default_journal
+
+        journal = default_journal(rank)
+        if journal is None or len(journal) == 0:
+            return
+        replayed = journal.replay(kv)
+        from ..obs import flight as _flight
+
+        _flight.note("journal_replayed", rank=rank, keys=replayed,
+                     journaled=len(journal))
+        _logging.getLogger("horovod_tpu").info(
+            "kv journal: rank %d replayed %d of %d durable key(s) "
+            "into the fresh coordinator", rank, replayed, len(journal))
+    except Exception:
+        _logging.getLogger("horovod_tpu").warning(
+            "kv journal: replay failed (protocols will recompute)",
+            exc_info=True)
+
+
 def _coordination_client_active() -> bool:
     """True if jax.distributed is already initialized, checked WITHOUT
     triggering XLA backend initialization (jax.process_count() would)."""
@@ -318,9 +353,9 @@ def init(config: Optional[Config] = None) -> GlobalState:
 
                         _client = _jd.global_state.client
                         if _client is not None:
-                            from .retry import resilient_kv
+                            from .retry import fenced_kv
 
-                            _client = resilient_kv(
+                            _client = fenced_kv(
                                 _client, rank=_state.rank)
                     except Exception:
                         _client = None
@@ -347,15 +382,22 @@ def init(config: Optional[Config] = None) -> GlobalState:
 
                         _pclient = _jd.global_state.client
                         if _pclient is not None:
-                            from .retry import resilient_kv
+                            from .journal import default_journal
+                            from .retry import fenced_kv
 
-                            _pclient = resilient_kv(
-                                _pclient, rank=_state.rank)
+                            # The drain coordinator authors DURABLE
+                            # keys (accounting a relaunch must see):
+                            # fence its writes and journal them for
+                            # replay into a fresh coordinator.
+                            _pclient = fenced_kv(
+                                _pclient, rank=_state.rank,
+                                journal=default_journal(_state.rank))
                     except Exception:
                         _pclient = None
                 _preempt.install(
                     cfg, rank=_state.rank, size=_state.size,
                     client=_pclient)
+                _replay_journal(_pclient, _state.rank)
             except Exception:
                 _logging.getLogger("horovod_tpu").warning(
                     "graceful preemption disabled: install failed",
@@ -415,9 +457,13 @@ def init(config: Optional[Config] = None) -> GlobalState:
 
                         _hclient = _jd.global_state.client
                         if _hclient is not None:
-                            from .retry import resilient_kv
+                            # Fenced so a superseded zombie can never
+                            # publish a stale health summary; the
+                            # arbiter-side reader is stamp-tolerant
+                            # (fleet/health.py uses core.retry.unstamp).
+                            from .retry import fenced_kv
 
-                            _hclient = resilient_kv(
+                            _hclient = fenced_kv(
                                 _hclient, rank=_state.rank)
                     except Exception:
                         _hclient = None

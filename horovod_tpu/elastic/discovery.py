@@ -18,12 +18,16 @@ the cap and contributes almost no churn.
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 import subprocess
 from typing import Dict, List, Optional
 
 from ..core import clock
 from ..runner import hosts as hosts_mod
+
+logger = logging.getLogger("horovod_tpu")
 
 
 class HostDiscoveryScript:
@@ -129,6 +133,60 @@ class HostManager:
         pending = [e.until - now for e in self._blacklist.values()
                    if e.until > now]
         return min(pending) if pending else None
+
+    # -- blacklist-hint persistence ------------------------------------
+    # The blacklist lives in driver memory; a driver restart (or a
+    # coordinator-loss relaunch that rebuilds the driver's world view)
+    # would otherwise forget which hosts were striking out and happily
+    # re-elect a bad host as coordinator.  Hints persist strikes plus
+    # REMAINING cooldown (``until`` is monotonic-clock relative, so the
+    # absolute deadline cannot cross processes) to the elastic state
+    # dir and merge conservatively on load (max of strikes/cooldowns).
+
+    def save_hints(self, path: str,
+                   now: Optional[float] = None) -> None:
+        """Atomically persist the blacklist as restart-survivable
+        hints; best-effort (a hint write failure must not fail the
+        incarnation bookkeeping that triggered it)."""
+        now = clock.monotonic() if now is None else now
+        doc = {h: {"strikes": e.strikes,
+                   "cooldown_remaining_s": max(0.0, e.until - now)}
+               for h, e in sorted(self._blacklist.items())}
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)
+        except OSError:
+            logger.warning("could not persist blacklist hints to %s",
+                           path, exc_info=True)
+
+    def load_hints(self, path: str,
+                   now: Optional[float] = None) -> int:
+        """Merge persisted hints into the live blacklist (strikes and
+        remaining cooldowns take the max of disk vs memory).  Returns
+        the number of hosts hinted; missing/corrupt files are zero."""
+        now = clock.monotonic() if now is None else now
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return 0
+        loaded = 0
+        for hostname, hint in doc.items():
+            try:
+                strikes = int(hint["strikes"])
+                remaining = float(hint.get("cooldown_remaining_s", 0.0))
+            except (TypeError, KeyError, ValueError):
+                continue
+            entry = self._blacklist.setdefault(hostname,
+                                               _BlacklistEntry())
+            entry.strikes = max(entry.strikes, strikes)
+            entry.until = max(entry.until, now + max(0.0, remaining))
+            loaded += 1
+        return loaded
 
     # -- discovery ------------------------------------------------------
     def refresh(self, now: Optional[float] = None) -> bool:

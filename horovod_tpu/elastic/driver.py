@@ -39,7 +39,7 @@ from ..runner.launch import (
 from ..core import clock
 from ..core.preempt import DRAIN_EXIT_CODE, configured_signal
 from .discovery import HostDiscoveryScript, HostManager
-from .worker import RESET_EXIT_CODE
+from .worker import FENCE_EXIT_CODE, RESET_EXIT_CODE
 
 # A host is blacklisted after this many consecutive crashed (not
 # reset-requested) workers (parity: registration.py blacklist policy).
@@ -145,6 +145,20 @@ class ElasticDriver:
         self._owns_state_dir = state_dir is None and env_dir is None
         self.verbose = verbose
         self._crash_counts: Dict[str, int] = {}
+        # blacklist hints survive a driver restart (and therefore a
+        # coordinator-loss relaunch cycle) via the elastic state dir —
+        # without them a relaunched driver would happily re-elect the
+        # host it just struck out as the new coordinator.
+        self._hints_path = os.path.join(self.state_dir,
+                                        "host_hints.json")
+        hinted = self.hosts.load_hints(self._hints_path)
+        if hinted and verbose:
+            print(f"hvtpu.elastic.driver: restored blacklist hints "
+                  f"for {hinted} host(s) from {self._hints_path}",
+                  file=sys.stderr, flush=True)
+        # coordinator address of the previous incarnation: a change
+        # across relaunches IS a coordinator re-election.
+        self._last_coordinator_addr: Optional[str] = None
         # world size of the last-launched incarnation; after a clean
         # run() this is the FINAL world (result collection filters
         # stale rank files from larger earlier incarnations with it)
@@ -211,6 +225,26 @@ class ElasticDriver:
             clock.sleep(self.interval)
         return False
 
+    def _elect_coordinator(self, slots: List[hosts_mod.SlotInfo]) -> str:
+        """One coordinator address for the whole world (rank 0's host),
+        exactly like the static launch path.  host_spec() already
+        excludes cooling (blacklisted) hosts, so when the previous
+        coordinator's host struck out, slots[0] — and therefore this
+        address — lands on a SURVIVING host: that is the re-election."""
+        coordinator_addr = _default_coordinator_addr(slots)
+        if (self._last_coordinator_addr is not None
+                and coordinator_addr != self._last_coordinator_addr):
+            self._log(
+                f"coordinator re-elected: {self._last_coordinator_addr}"
+                f" -> {coordinator_addr} (generation "
+                f"{self._generation - 1})")
+            flight.note("coordinator_reelected",
+                        old=self._last_coordinator_addr,
+                        new=coordinator_addr,
+                        generation=self._generation - 1)
+        self._last_coordinator_addr = coordinator_addr
+        return coordinator_addr
+
     def _spawn(self, slots: List[hosts_mod.SlotInfo], port: int
                ) -> List[safe_shell_exec.WorkerProcess]:
         base_env = dict(os.environ)
@@ -219,9 +253,7 @@ class ElasticDriver:
         base_env["HVTPU_ELASTIC_STATE_DIR"] = self.state_dir
         base_env["HVTPU_ELASTIC_GENERATION"] = str(self._generation)
         self._generation += 1
-        # One coordinator address for the whole world (rank 0's host),
-        # exactly like the static launch path.
-        coordinator_addr = _default_coordinator_addr(slots)
+        coordinator_addr = self._elect_coordinator(slots)
         workers = []
         import threading
 
@@ -453,6 +485,7 @@ class ElasticDriver:
             # 1. check worker exits
             running, done_ok, reset_req, crashed, drained = \
                 [], [], [], [], []
+            fenced = []
             for w in workers:
                 code = w.poll()
                 if code is None:
@@ -463,6 +496,12 @@ class ElasticDriver:
                     # graceful drain after a preemption notice: a
                     # PLANNED departure, never a crash
                     drained.append(w)
+                elif code == FENCE_EXIT_CODE:
+                    # self-fenced (generation superseded / KV lease
+                    # expired): the rank PROTECTED the job by dying —
+                    # rebuild the world, but never charge its host a
+                    # blacklist strike (core/retry.py FencedKV)
+                    fenced.append(w)
                 elif code == RESET_EXIT_CODE or code in _USR1_CODES:
                     reset_req.append(w)
                 elif code in _TERM_CODES and (notified
@@ -470,6 +509,15 @@ class ElasticDriver:
                     reset_req.append(w)
                 else:
                     crashed.append((w, code))
+            if fenced:
+                for w in fenced:
+                    self._log(f"rank {w.rank} self-fenced "
+                              f"(exit {FENCE_EXIT_CODE}); relaunching "
+                              "without a blacklist strike")
+                flight.note("worker_fenced",
+                            ranks=sorted(w.rank for w in fenced),
+                            generation=self._generation - 1)
+                reset_req.extend(fenced)
             _M_WORKERS.set(len(running))
             if self._drain_forwarded:
                 # whole-job preemption: wait out the drain, then stop
@@ -537,6 +585,7 @@ class ElasticDriver:
                 self._crash_counts[host] -= 1
             self.hosts.record_success(host)
         _M_BLACKLISTED.set(len(self.hosts.blacklisted_now()))
+        self.hosts.save_hints(self._hints_path)
         # grace period for the rest to exit at a commit boundary
         self._notify_hosts_updated(workers)
         deadline = clock.monotonic() + 30.0
@@ -555,6 +604,13 @@ class ElasticDriver:
         # rank's DRAIN_EXIT_CODE) often lands a poll tick after its
         # peers' reset exits, and a poll-time snapshot would misfile
         # the planned departure as a budget-charged restart.
+        fenced = [w for w in workers if w.poll() == FENCE_EXIT_CODE]
+        if fenced:
+            print(
+                f"hvtpu.elastic: rank(s) "
+                f"{sorted(w.rank for w in fenced)} self-fenced (exit "
+                f"{FENCE_EXIT_CODE}); relaunching without a blacklist "
+                "strike", file=sys.stderr, flush=True)
         drained = [w for w in workers if w.poll() == DRAIN_EXIT_CODE]
         if drained and not crashed:
             ranks = sorted(w.rank for w in drained)

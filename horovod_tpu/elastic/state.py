@@ -50,8 +50,11 @@ _quorum_round = itertools.count()
 
 def _quorum_kv(st):
     """The coordination KV for the restore quorum, wrapped in the
-    retry plane — None when no coordination service is up (single-
-    process runs, unit tests)."""
+    retry + fencing planes — None when no coordination service is up
+    (single-process runs, unit tests).  Fenced so a superseded zombie
+    cannot cast a stale vote, and journaled (core/journal.py via the
+    process-wide journal) so a coordinator-loss relaunch replays the
+    votes this rank already cast."""
     if not core_state._coordination_client_active():
         return None
     try:
@@ -62,9 +65,11 @@ def _quorum_kv(st):
         return None
     if client is None:
         return None
-    from ..core.retry import resilient_kv
+    from ..core.journal import default_journal
+    from ..core.retry import fenced_kv
 
-    return resilient_kv(client, rank=st.rank)
+    return fenced_kv(client, rank=st.rank,
+                     journal=default_journal(st.rank))
 
 
 def _flush_durable_writes() -> None:

@@ -28,6 +28,7 @@ import logging
 
 from ..core import faults
 from ..core import state as core_state
+from ..core.retry import FENCE_EXIT_CODE  # noqa: F401  (re-export)
 from ..core.exceptions import (DrainInterrupt, HorovodInternalError,
                                HostsUpdatedInterrupt)
 from ..obs import flight
@@ -51,6 +52,9 @@ _M_SIGUSR1_FAILED = obs_metrics.counter(
 
 # Exit code the driver interprets as "re-rendezvous requested" (worker
 # hit a recoverable elastic event); anything else non-zero is a crash.
+# FENCE_EXIT_CODE (re-exported above from core/retry.py) is the third
+# planned status: "this rank self-fenced" — superseded generation or
+# expired KV lease — which the driver also must NOT count as a crash.
 RESET_EXIT_CODE = 73
 
 

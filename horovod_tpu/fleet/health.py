@@ -39,6 +39,7 @@ import threading
 from typing import Any, Dict, Optional
 
 from ..core import clock
+from ..core.retry import unstamp
 from ..obs import metrics as obs_metrics
 from .job import prefixed_client
 
@@ -194,6 +195,16 @@ class HealthReporter:
 
 
 def _parse(raw, now_wall: Optional[float]) -> Optional[Dict[str, Any]]:
+    # The reporter side writes through a fenced client (core/retry.py
+    # FencedKV), so the summary may carry a generation-fencing stamp;
+    # the arbiter reads with its own raw client and must stay
+    # stamp-tolerant.  unstamp() is a no-op on unstamped payloads.
+    if isinstance(raw, bytes):
+        try:
+            raw = raw.decode("utf-8")
+        except UnicodeDecodeError:
+            return None
+    _tok, raw = unstamp(raw)
     try:
         summary = json.loads(raw)
     except (TypeError, ValueError):

@@ -104,7 +104,29 @@ class SimFabric:
         self._store: Dict[str, object] = {}
         self._waiters: Dict[str, List[WaitToken]] = {}
         self._links: Dict[int, LinkModel] = {}
+        self._down = False
         self.ops = collections.Counter()
+
+    # -- outage ---------------------------------------------------------
+    def set_down(self, down: bool = True) -> None:
+        """Coordinator outage switch: while down, every server-side
+        operation spends its request-leg delay and then raises an
+        ``UNAVAILABLE``-marked error (what ``core/retry.py`` classifies
+        as retryable) instead of touching the store.  Models the
+        coordinator HOST dying — clients keep timing out until the
+        driver relaunches against a fresh fabric."""
+        self._down = bool(down)
+
+    @property
+    def down(self) -> bool:
+        return self._down
+
+    def _check_up(self, key: str) -> None:
+        if self._down:
+            self.ops["unavailable"] += 1
+            raise ConnectionError(
+                f"UNAVAILABLE: coordination service unreachable "
+                f"({key!r})")
 
     # -- links ----------------------------------------------------------
     def link(self, rank: int) -> LinkModel:
@@ -150,6 +172,7 @@ class SimFabric:
     def _put(self, rank: int, key: str, value) -> None:
         link = self.link(rank)
         self.kernel.sleep(link.delay(self._nbytes(value)))
+        self._check_up(key)
         self.ops["put"] += 1
         self._store[key] = value
         for token in self._waiters.pop(key, []):
@@ -161,6 +184,7 @@ class SimFabric:
     def _delete(self, rank: int, key: str) -> None:
         link = self.link(rank)
         self.kernel.sleep(link.delay(len(key)))
+        self._check_up(key)
         self.ops["delete"] += 1
         if key.endswith("/"):
             for k in [k for k in self._store if k.startswith(key)]:
@@ -172,6 +196,7 @@ class SimFabric:
     def _try_get(self, rank: int, key: str):
         link = self.link(rank)
         self.kernel.sleep(link.delay(len(key)))
+        self._check_up(key)
         self.ops["get"] += 1
         if key not in self._store:
             self.kernel.sleep(link.delay(1))
@@ -183,6 +208,7 @@ class SimFabric:
     def _blocking_get(self, rank: int, key: str, timeout_ms: int):
         link = self.link(rank)
         self.kernel.sleep(link.delay(len(key)))
+        self._check_up(key)
         self.ops["get"] += 1
         if key in self._store:
             value = self._store[key]
@@ -212,6 +238,7 @@ class SimFabric:
     def _dir_get(self, rank: int, prefix: str) -> List[Tuple[str, object]]:
         link = self.link(rank)
         self.kernel.sleep(link.delay(len(prefix)))
+        self._check_up(prefix)
         self.ops["dir_get"] += 1
         items = [(k, self._store[k])
                  for k in sorted(self._store) if k.startswith(prefix)]

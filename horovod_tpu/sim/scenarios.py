@@ -68,6 +68,20 @@ anomaly-detection         one rank's link degraded mid-run via
                           per-cycle arrival skew, must raise a
                           straggler incident naming exactly that rank.
                           Measures detection latency (virtual s).
+coordinator-loss          the coordination service's host dies: every
+                          rank's KV lease expires (real FencedKV
+                          self-fencing, virtual exit 89), the virtual
+                          driver blacklists the host and re-elects the
+                          coordinator over surviving slots, and gen 1
+                          replays each rank's journaled durable keys
+                          into the fresh fabric.  Measures detect and
+                          fence-to-recover latency.
+partition-storm           a burst of ``partition(MS)`` fault windows
+                          silences several ranks' coordination
+                          traffic; peers classify them partition
+                          SUSPECTS (stall blame held), most recover,
+                          and the one leased victim self-fences.
+                          Asserts no false stall failure.
 compression-negotiation   mixed-precision negotiation through the
                           real controller: a dense fp32 allreduce
                           plus an int8-compressed sidecar per cycle.
@@ -1653,6 +1667,365 @@ def anomaly_detection(ranks: int, seed: int = 0, *, cycles: int = 32,
 
 
 # ---------------------------------------------------------------------------
+# coordinator-loss
+# ---------------------------------------------------------------------------
+
+def coordinator_loss(ranks: int, seed: int = 0, *, steps_before: int = 2,
+                     steps_after: int = 2, lease_s: float = 2.0,
+                     hb_s: float = 0.25, slots_per_host: int = 8,
+                     cooldown_s: float = 60.0) -> Dict:
+    """The coordination service's HOST dies mid-run: every rank's KV
+    lease expires (real FencedKV self-fencing over a downed fabric →
+    virtual exit 89), the virtual driver blacklists the coordinator
+    host in the REAL HostManager and re-elects the coordinator address
+    via the REAL ``_default_coordinator_addr`` over the surviving
+    slots, and the relaunched generation replays each rank's journaled
+    durable keys (real KeyJournal) into the fresh, EMPTY fabric.
+    Asserts: every rank fences (no zombies), the re-elected address
+    moves off the dead host, every journaled key is visible to every
+    gen-1 rank, and per-rank commit accounting is exactly-once across
+    the restart."""
+    import shutil
+    import tempfile
+
+    from ..core.journal import KeyJournal
+    from ..core.retry import FENCE_EXIT_CODE, FencedKV
+    from ..elastic.discovery import HostManager
+    from ..obs import metrics as obs_metrics
+    from ..runner.hosts import HostSlots, get_host_assignments
+    from ..runner.launch import _default_coordinator_addr
+
+    kernel, fabric0 = _fresh(ranks, seed)
+    # one SPARE host beyond what the ranks need: losing the coordinator
+    # host must leave enough slots to re-place the world
+    n_hosts = (ranks + slots_per_host - 1) // slots_per_host + 1
+    hosts = {f"host{h}": slots_per_host for h in range(n_hosts)}
+    down_at_s = steps_before * hb_s + 0.2
+    jdir = tempfile.mkdtemp(prefix="hvtsim-kvjournal-")
+    fabrics: Dict[str, SimFabric] = {"gen0": fabric0}
+    down_t: List[float] = []
+    fence_t: Dict[int, float] = {}
+    recover_t: Dict[int, float] = {}
+    committed0: Dict[int, int] = {}
+    committed1: Dict[int, int] = {}
+    replayed: Dict[int, int] = {}
+    votes_seen: Dict[int, int] = {}
+    gen1_tasks: Dict[int, object] = {}
+    election: Dict[str, str] = {}
+    fence_exits_before = obs_metrics.counter(
+        "hvtpu_fence_exits_total").value()
+
+    def make_gen0(rank: int):
+        def body():
+            ctx = RankContext(kernel, rank, ranks, generation=0)
+            client = fabric0.client(rank, caps="dir")
+
+            def exit_fn(code):
+                fence_t[rank] = kernel.now
+                ctx.request_exit(code)
+
+            with ctx.activate():
+                kv = FencedKV(client, rank=rank, job_epoch=0,
+                              generation=0, lease_s=lease_s,
+                              check_every=10_000, exit_fn=exit_fn,
+                              journal=KeyJournal(jdir, rank=rank))
+                kv.add_journal_prefix("hvtdur/")
+                # one durable key per rank (a restore-quorum-style
+                # vote) — the history the fresh coordinator cannot
+                # recompute
+                kv.key_value_set(f"hvtdur/vote/{rank}", str(100 + rank))
+                committed0[rank] = 0
+                for step in range(steps_before):
+                    kernel.sleep(hb_s)
+                    kv.key_value_set(f"hb/{rank}", str(step))
+                    committed0[rank] += 1
+                # the outage begins: keep heartbeating until the lease
+                # fences us (retry exhaustion raises; the lease check
+                # in FencedKV._guarded eventually calls exit_fn)
+                while True:
+                    kernel.sleep(hb_s)
+                    try:
+                        kv.key_value_set(f"hb/{rank}", "outage")
+                        committed0[rank] += 1
+                    except Exception:
+                        pass
+        return body
+
+    def chaos():
+        kernel.sleep(down_at_s)
+        fabric0.set_down(True)
+        down_t.append(kernel.now)
+        kernel.log("coordinator_down", host="host0",
+                   t=round(kernel.now, 9))
+
+    def make_gen1(rank: int):
+        def body():
+            ctx = RankContext(kernel, rank, ranks, generation=1)
+            client = fabrics["gen1"].client(rank, caps="dir")
+            with ctx.activate():
+                journal = KeyJournal(jdir, rank=rank)
+                kv = FencedKV(client, rank=rank, job_epoch=0,
+                              generation=1, lease_s=lease_s,
+                              exit_fn=ctx.request_exit,
+                              journal=journal)
+                kv.add_journal_prefix("hvtdur/")
+                replayed[rank] = journal.replay(kv)
+                committed1[rank] = 0
+                for step in range(steps_after):
+                    kernel.sleep(hb_s)
+                    kv.key_value_set(f"hb/{rank}", str(step))
+                    committed1[rank] += 1
+                # every rank's journaled vote must be visible again
+                while len(kv.key_value_dir_get("hvtdur/vote/")) < ranks:
+                    kernel.sleep(0.1)
+                votes_seen[rank] = len(
+                    kv.key_value_dir_get("hvtdur/vote/"))
+                recover_t[rank] = kernel.now
+        return body
+
+    def driver():
+        hm = HostManager(_StaticDiscovery(hosts),
+                         cooldown_base_s=cooldown_s,
+                         cooldown_max_s=8 * cooldown_s)
+        hm.refresh()
+        all_slots = [HostSlots(h, s) for h, s in sorted(hosts.items())]
+        election["old"] = _default_coordinator_addr(
+            get_host_assignments(all_slots, ranks))
+        # wait for every gen-0 rank to fence itself
+        while not all(t.done for t in gen0_tasks.values()):
+            kernel.sleep(0.2)
+        hm.blacklist_host("host0")
+        hm.refresh()
+        surviving = [HostSlots(h, s)
+                     for h, s in sorted(hm.current.items())]
+        election["new"] = _default_coordinator_addr(
+            get_host_assignments(surviving, ranks))
+        kernel.log("coordinator_reelected", old=election["old"],
+                   new=election["new"], t=round(kernel.now, 9))
+        # relaunch everyone against a FRESH fabric (the relaunched
+        # coordination service starts empty — the split this scenario
+        # measures journal replay against)
+        fabrics["gen1"] = SimFabric(kernel)
+        for r in range(ranks):
+            gen1_tasks[r] = kernel.spawn(f"gen1-rank{r}", make_gen1(r))
+        kernel.log("relaunched", generation=1, ranks=ranks)
+
+    try:
+        with _env(HVTPU_AUDIT_EVERY="0", HVTPU_ELASTIC_STATE_DIR=None,
+                  HVTPU_KV_FENCE_DISABLE=None, HVTPU_JOB_EPOCH=None):
+            gen0_tasks = {r: kernel.spawn(f"rank{r}", make_gen0(r))
+                          for r in range(ranks)}
+            kernel.spawn("chaos", chaos)
+            kernel.spawn("driver", driver)
+            kernel.run(max_virtual_s=_DEF_BUDGET_S)
+    finally:
+        shutil.rmtree(jdir, ignore_errors=True)
+
+    # every gen-0 rank self-fenced (closed split brain: no zombies)
+    for r, t in gen0_tasks.items():
+        assert t.exit_code == FENCE_EXIT_CODE, (
+            f"gen-0 rank {r} exited {t.exit_code}, expected "
+            f"{FENCE_EXIT_CODE}")
+    fence_exits = (obs_metrics.counter("hvtpu_fence_exits_total").value()
+                   - fence_exits_before)
+    assert fence_exits >= ranks
+    assert election["new"] != election["old"], election
+    assert election["new"] != "host0"
+    detect = sorted(fence_t[r] - down_t[0] for r in range(ranks))
+    assert detect[0] >= 0.0
+    assert detect[-1] <= lease_s + 10.0, (
+        f"slowest fence took {detect[-1]}s past the outage")
+    for r in range(ranks):
+        assert committed0[r] == steps_before, (
+            f"rank {r} gen-0 committed {committed0[r]} (outage writes "
+            f"must not count)")
+        assert committed1[r] == steps_after
+        assert replayed[r] == 1, (
+            f"rank {r} replayed {replayed[r]} keys, expected its vote")
+        assert votes_seen[r] == ranks
+    fence_to_recover = max(recover_t.values()) - max(fence_t.values())
+    stats = {"phases": {"coordinator_loss": {
+        "hosts": n_hosts,
+        "down_t_s": round(down_t[0], 6),
+        "detect_p50_s": round(_pct(detect, 0.50), 6),
+        "detect_max_s": round(detect[-1], 6),
+        "fence_exits": ranks,
+        "old_coordinator": election["old"],
+        "new_coordinator": election["new"],
+        "replayed_keys": sum(replayed.values()),
+        "fence_to_recover_s": round(fence_to_recover, 6),
+        "virtual_s": round(kernel.now, 6),
+    }}, "kv_ops": {"gen0": dict(fabric0.ops),
+                   "gen1": dict(fabrics["gen1"].ops)}}
+    return _result("coordinator-loss", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
+# partition-storm
+# ---------------------------------------------------------------------------
+
+def partition_storm(ranks: int, seed: int = 0, *,
+                    window_ms: float = 3000.0, hb_s: float = 0.5,
+                    stale_s: float = 2.0, suspect_s: float = 3.0,
+                    lease_s: float = 1.5, n_victims: int = 3,
+                    total_s: float = 10.0) -> Dict:
+    """A burst of network partitions under live heartbeat evaluation:
+    ``n_victims`` ranks each get a first-class ``partition(MS)`` fault
+    clause (core/faults.py) that silently drops their kv.get/kv.put/
+    heartbeat traffic for a seeded window.  Peers running the REAL
+    AmortizedStallInspector classify them as partition SUSPECTS (blame
+    held) and then see them either recover (window < stale+suspect) or
+    — for the one victim carrying a KV lease — self-fence via the real
+    FencedKV lease check (virtual exit 89).  Asserts: suspects are
+    detected and resolved, no surviving rank latches a false stall
+    failure, the leased victim fences, and the suspect-seconds
+    histogram observed the episode."""
+    from ..comm.stall import AmortizedStallInspector
+    from ..core import faults as core_faults
+    from ..core.retry import FENCE_EXIT_CODE, FencedKV
+    from ..obs import metrics as obs_metrics
+
+    n_victims = max(1, min(n_victims, max(1, ranks // 2)))
+    # victims spread across the world; the LAST one carries the lease
+    victims = [1 + i * max(1, (ranks - 1) // (n_victims + 1))
+               for i in range(n_victims)]
+    victims = sorted(set(min(v, ranks - 1) for v in victims))
+    fence_victim = victims[-1]
+    # the leased victim's window outlasts stale+suspect (it would be
+    # classified dead) — but its lease fences it first
+    fence_window_ms = (stale_s + suspect_s + 4.0) * 1000.0
+    kernel, fabric = _fresh(ranks, seed)
+    observer_rank = 0
+    assert observer_rank not in victims
+    window_open_t: Dict[int, float] = {}
+    fence_t: List[float] = []
+    suspect_seen_t: Dict[int, float] = {}
+    suspect_gone_t: Dict[int, float] = {}
+    inspectors: Dict[int, AmortizedStallInspector] = {}
+    steps_done: Dict[int, int] = {}
+    hist = obs_metrics.histogram("hvtpu_partition_suspect_seconds")
+
+    def _hist_count() -> int:
+        return sum(cell[2] for cell in hist._values.values())
+
+    hist_before = _hist_count()
+    fence_exits_before = obs_metrics.counter(
+        "hvtpu_fence_exits_total").value()
+
+    def make(rank: int):
+        # window opens at this victim's 4th beat (count=4): peers have
+        # a healthy baseline before the silence starts
+        if rank == fence_victim:
+            spec = (f"heartbeat:partition({fence_window_ms:g})"
+                    f"@rank={rank},count=4,times=1")
+        elif rank in victims:
+            spec = (f"heartbeat:partition({window_ms:g})"
+                    f"@rank={rank},count=4,times=1")
+        else:
+            spec = ""
+
+        def body():
+            ctx = RankContext(kernel, rank, ranks, fault_spec=spec,
+                              generation=0)
+            client = fabric.client(rank, caps="dir")
+
+            def exit_fn(code):
+                fence_t.append(kernel.now)
+                ctx.request_exit(code)
+
+            with ctx.activate():
+                kv = FencedKV(
+                    client, rank=rank, job_epoch=0, generation=0,
+                    lease_s=(lease_s if rank == fence_victim else 0.0),
+                    check_every=10_000, exit_fn=exit_fn)
+                insp = AmortizedStallInspector(
+                    kv, rank, warn_s=60.0, abort_s=600.0,
+                    heartbeat_s=hb_s, generation=0, stale_s=stale_s,
+                    suspect_s=suspect_s, start_heartbeat=False)
+                inspectors[rank] = insp
+                steps_done[rank] = 0
+                beats = int(total_s / hb_s)
+                for step in range(beats):
+                    ctx.check_exit()
+                    kernel.sleep(hb_s)
+                    insp._beat_once()
+                    # work-plane KV op: dropped inside the victim's
+                    # partition window — what starves the lease
+                    kv.key_value_set(f"work/{rank}", str(step))
+                    steps_done[rank] += 1
+                    if (rank in victims and rank not in window_open_t
+                            and core_faults.partition_remaining() > 0):
+                        window_open_t[rank] = kernel.now
+                        kernel.log("partition_window_open", rank=rank)
+                insp.stop()
+        return body
+
+    def observer():
+        # watch the observer rank's inspector classify the silence
+        while observer_rank not in inspectors:
+            kernel.sleep(0.05)
+        insp = inspectors[observer_rank]
+        end = total_s + 5.0
+        while kernel.now < end:
+            suspects = set(insp.debug_state()["partition_suspects"])
+            for v in victims:
+                if v in suspects and v not in suspect_seen_t:
+                    suspect_seen_t[v] = kernel.now
+                if (v in suspect_seen_t and v not in suspects
+                        and v not in suspect_gone_t):
+                    suspect_gone_t[v] = kernel.now
+            kernel.sleep(0.1)
+
+    with _env(HVTPU_AUDIT_EVERY="0", HVTPU_PARTITION_SUSPECT_S=None,
+              HVTPU_KV_FENCE_DISABLE=None, HVTPU_JOB_EPOCH=None):
+        tasks = {r: kernel.spawn(f"rank{r}", make(r))
+                 for r in range(ranks)}
+        kernel.spawn("observer", observer)
+        kernel.run(max_virtual_s=_DEF_BUDGET_S)
+
+    assert tasks[fence_victim].exit_code == FENCE_EXIT_CODE, (
+        f"leased victim exited {tasks[fence_victim].exit_code}, "
+        f"expected {FENCE_EXIT_CODE}")
+    fence_exits = (obs_metrics.counter("hvtpu_fence_exits_total").value()
+                   - fence_exits_before)
+    assert fence_exits >= 1
+    for r, insp in inspectors.items():
+        if r == fence_victim:
+            continue
+        assert insp.failure is None, (
+            f"rank {r} latched a false stall failure during the "
+            f"partition storm: {insp.failure}")
+    recovered = [v for v in victims if v != fence_victim]
+    for v in recovered:
+        assert steps_done[v] == int(total_s / hb_s), (
+            f"recovered victim {v} finished {steps_done[v]} steps")
+        assert v in suspect_seen_t, (
+            f"victim {v} was never classified a partition suspect")
+        assert v in suspect_gone_t, (
+            f"victim {v} never left the suspect state")
+    assert _hist_count() - hist_before >= 1, (
+        "the suspect-seconds histogram observed nothing")
+    detect = sorted(suspect_seen_t[v] - window_open_t[v]
+                    for v in victims if v in suspect_seen_t
+                    and v in window_open_t)
+    fence_latency = (fence_t[0] - window_open_t[fence_victim]
+                     if fence_t and fence_victim in window_open_t
+                     else 0.0)
+    stats = {"phases": {"partition_storm": {
+        "victims": victims,
+        "fence_victim": fence_victim,
+        "window_ms": window_ms,
+        "detect_p50_s": round(_pct(detect, 0.50), 6),
+        "detect_max_s": round(detect[-1], 6) if detect else 0.0,
+        "fence_latency_s": round(fence_latency, 6),
+        "recovered": len(recovered),
+        "suspect_observations": _hist_count() - hist_before,
+        "virtual_s": round(kernel.now, 6),
+    }}, "kv_ops": dict(fabric.ops)}
+    return _result("partition-storm", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1668,6 +2041,8 @@ SCENARIOS = {
     "checkpoint-storm": checkpoint_storm,
     "compression-negotiation": compression_negotiation,
     "anomaly-detection": anomaly_detection,
+    "coordinator-loss": coordinator_loss,
+    "partition-storm": partition_storm,
 }
 
 

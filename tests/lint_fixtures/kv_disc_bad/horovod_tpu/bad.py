@@ -1,0 +1,28 @@
+"""kv-discipline bad fixture: every raw-client leak shape."""
+
+from jax._src import distributed as _jd
+
+
+def leak_direct_calls():
+    client = _jd.global_state.client
+    client.key_value_set("hvt/k", "v")
+    client.key_value_set("hvt/k2", "v2")  # occurrence-indexed keys
+    client.blocking_key_value_get("hvt/k", 1000)
+    return client
+
+
+def leak_chained_call():
+    # no binding at all: the call rides the singleton chain directly
+    return _jd.global_state.client.key_value_dir_get("hvt/ns/")
+
+
+def leak_via_alias():
+    client = _jd.global_state.client
+    kv = client  # alias keeps the raw taint
+    kv.key_value_delete("hvt/k")
+
+
+class Transport:
+    def __init__(self):
+        client = _jd.global_state.client
+        self._kv = client  # escape: raw client stored on self

@@ -1,0 +1,48 @@
+"""kv-discipline clean fixture: the disciplined wrapper patterns."""
+
+from jax._src import distributed as _jd
+
+from horovod_tpu.core import retry as core_retry
+from horovod_tpu.core.retry import fenced_kv, resilient_kv
+
+
+def wrapped_resilient():
+    client = _jd.global_state.client
+    if client is None:
+        return None
+    kv = resilient_kv(client, rank=0)
+    kv.key_value_set("hvt/k", "v")
+    return kv.blocking_key_value_get("hvt/k", 1000)
+
+
+def wrapped_fenced_rebind():
+    # the common rebind idiom: same name, now the wrapper
+    client = _jd.global_state.client
+    client = core_retry.fenced_kv(client, rank=0)
+    client.key_value_set("hvt/k", "v")
+
+
+def rebound_to_none():
+    try:
+        client = _jd.global_state.client
+    except Exception:
+        client = None
+    client = fenced_kv(client, rank=0)
+    return client
+
+
+def calls_on_parameter(kv):
+    # callers hand in an already-wrapped KV; not a raw client
+    kv.key_value_set("hvt/k", "v")
+    return kv.key_value_dir_get("hvt/")
+
+
+class Holder:
+    def __init__(self, client=None):
+        if client is None:
+            client = _jd.global_state.client
+        # stored only after wrapping: no escape
+        self._kv = fenced_kv(client, rank=0)
+
+    def put(self):
+        self._kv.key_value_set("hvt/k", "v")

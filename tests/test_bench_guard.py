@@ -445,3 +445,92 @@ class TestAnomalyDetectionSimSchema:
         assert {"hvtpu_flight_events_total", "hvtpu_incidents_total",
                 "hvtpu_fleet_job_step_rate",
                 "hvtpu_fleet_job_incidents"} <= required
+
+
+class TestCoordinatorLossSimSchema:
+    """BENCH_SCALING.json carries MEASURED coordinator-loss recovery
+    rows from the fabric simulator: coordinator death -> every
+    survivor's lease-expiry self-fence (detect), then re-election +
+    durable-key journal replay into the fresh KV (recover).  These
+    back the docs/robustness.md coordination-plane claims."""
+
+    REQUIRED_ROW_KEYS = {
+        "ranks", "detect_p50_s", "detect_max_s", "fence_exits",
+        "replayed_keys", "fence_to_recover_s", "measured", "method",
+    }
+
+    @pytest.fixture
+    def doc(self):
+        with open(os.path.join(_ROOT, "BENCH_SCALING.json")) as f:
+            return json.load(f)
+
+    def test_measured_rows_present_and_complete(self, doc):
+        sim = doc["coordinator_loss_sim"]
+        assert "journal" in sim["note"].lower()
+        rows = sim["rows"]
+        assert {r["ranks"] for r in rows} >= {64, 256, 1024}
+        for row in rows:
+            assert self.REQUIRED_ROW_KEYS <= set(row), row.get("ranks")
+            assert row["measured"] is True
+            assert "fabric-sim" in row["method"]
+
+    def test_timings_are_finite_positive_virtual_seconds(self, doc):
+        for row in doc["coordinator_loss_sim"]["rows"]:
+            for key in ("detect_p50_s", "detect_max_s",
+                        "fence_to_recover_s"):
+                v = row[key]
+                assert isinstance(v, (int, float)) and 0 < v < 3600, (
+                    f"ranks={row['ranks']} {key}={v!r}")
+            assert row["detect_p50_s"] <= row["detect_max_s"]
+            # every rank fenced (split-brain window fully closed) and
+            # every rank's journaled vote landed in the fresh KV
+            assert row["fence_exits"] == row["ranks"]
+            assert row["replayed_keys"] == row["ranks"]
+
+    def test_required_keys_cover_fencing(self):
+        import bench
+
+        required = set(bench.REQUIRED_METRIC_KEYS)
+        assert {"hvtpu_kv_fenced_writes_total",
+                "hvtpu_fence_exits_total",
+                "hvtpu_partition_suspect_seconds"} <= required
+
+
+class TestPartitionStormSimSchema:
+    """BENCH_SCALING.json carries MEASURED partition-storm rows from
+    the fabric simulator: partition(MS) windows on three victims,
+    peers classifying the silent ranks as partitioned-vs-dead by lease
+    age, two thaw-and-recover, one lease-starved self-fence."""
+
+    REQUIRED_ROW_KEYS = {
+        "ranks", "detect_p50_s", "detect_max_s", "victims",
+        "recovered", "fence_latency_s", "suspect_observations",
+        "measured", "method",
+    }
+
+    @pytest.fixture
+    def doc(self):
+        with open(os.path.join(_ROOT, "BENCH_SCALING.json")) as f:
+            return json.load(f)
+
+    def test_measured_rows_present_and_complete(self, doc):
+        sim = doc["partition_storm_sim"]
+        assert "suspect" in sim["note"].lower()
+        rows = sim["rows"]
+        assert {r["ranks"] for r in rows} >= {64, 256, 1024}
+        for row in rows:
+            assert self.REQUIRED_ROW_KEYS <= set(row), row.get("ranks")
+            assert row["measured"] is True
+            assert "fabric-sim" in row["method"]
+
+    def test_timings_are_finite_positive_virtual_seconds(self, doc):
+        for row in doc["partition_storm_sim"]["rows"]:
+            for key in ("detect_p50_s", "detect_max_s",
+                        "fence_latency_s"):
+                v = row[key]
+                assert isinstance(v, (int, float)) and 0 < v < 3600, (
+                    f"ranks={row['ranks']} {key}={v!r}")
+            assert row["detect_p50_s"] <= row["detect_max_s"]
+            # exactly one victim fences; the thawed rest recover
+            assert row["recovered"] == row["victims"] - 1
+            assert row["suspect_observations"] > 0

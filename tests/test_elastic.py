@@ -399,6 +399,70 @@ class TestRestartBudget:
         assert d._restart_budget_ok() is False
 
 
+class TestCoordinatorReelection:
+    """Regression: when rank 0's HOST is struck out mid-job, the next
+    incarnation's coordinator address must land on a SURVIVING host
+    (driver._elect_coordinator — the seam _spawn routes through) and
+    the hand-off must land in the flight ring as a
+    ``coordinator_reelected`` event."""
+
+    def _driver(self, tmp_path):
+        from horovod_tpu.elastic.driver import ElasticDriver
+
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho hosta:2\necho hostb:2\n")
+        script.chmod(0o755)
+        return ElasticDriver(
+            command=["true"],
+            discovery=HostDiscoveryScript(str(script)),
+            min_np=2, state_dir=str(tmp_path))
+
+    def _slots(self, d, np):
+        from horovod_tpu.runner import hosts as hosts_mod
+
+        return hosts_mod.get_host_assignments(
+            hosts_mod.parse_host_spec(d.hosts.host_spec()), np)
+
+    def test_blacklisted_rank0_host_moves_coordinator(self, tmp_path):
+        from horovod_tpu.obs import flight
+
+        d = self._driver(tmp_path)
+        d.hosts.refresh()
+        d._generation += 1  # _spawn increments before electing
+        assert d._elect_coordinator(self._slots(d, 4)) == "hosta"
+        d._generation += 1
+        flight.install(rank="driver", out_dir=str(tmp_path))
+        try:
+            # rank 0's host strikes out: host_spec() now excludes it,
+            # so slots[0] — and the coordinator — moves to the survivor
+            d.hosts.blacklist_host("hosta")
+            assert d.hosts.refresh() is True
+            assert d._elect_coordinator(self._slots(d, 2)) == "hostb"
+            evs = [e for e in flight.get_recorder().events()
+                   if e["kind"] == "coordinator_reelected"]
+            assert len(evs) == 1
+            assert evs[0]["old"] == "hosta"
+            assert evs[0]["new"] == "hostb"
+            assert evs[0]["generation"] == 1
+        finally:
+            flight.uninstall()
+
+    def test_stable_coordinator_emits_no_event(self, tmp_path):
+        from horovod_tpu.obs import flight
+
+        d = self._driver(tmp_path)
+        d.hosts.refresh()
+        flight.install(rank="driver", out_dir=str(tmp_path))
+        try:
+            # same surviving slots[0] across a relaunch: no hand-off
+            assert d._elect_coordinator(self._slots(d, 4)) == "hosta"
+            assert d._elect_coordinator(self._slots(d, 4)) == "hosta"
+            assert not [e for e in flight.get_recorder().events()
+                        if e["kind"] == "coordinator_reelected"]
+        finally:
+            flight.uninstall()
+
+
 class TestClockSeam:
     """Every blacklist/budget timing decision must route through the
     core/clock seam (not time.monotonic directly) so the fabric

@@ -79,6 +79,30 @@ class TestParse:
         with pytest.raises(faults.FaultSpecError):
             faults.parse_spec(bad)
 
+    def test_partition_grammar(self):
+        cs = faults.parse_spec(
+            "kv.put:partition(3000)@rank=3; "
+            "heartbeat:partition(250.5)@count=4,times=2; "
+            "kv.get:partition(10)")
+        assert [c.action for c in cs] == ["partition"] * 3
+        assert cs[0].partition_ms == 3000.0
+        assert cs[0].times == 1   # partition: 1-shot by default
+        assert cs[1].partition_ms == 250.5
+        assert cs[1].count == 4 and cs[1].times == 2
+        assert cs[2].site == "kv.get" and cs[2].partition_ms == 10.0
+
+    @pytest.mark.parametrize("bad", [
+        "worker.step:partition(3000)",     # not a coordination site
+        "collective.pre:partition(100)",
+        "ckpt.write:partition(100)",
+        "kv.put:partition()",              # missing window
+        "kv.put:partition(abc)",
+        "kv.put:partition(-5)",
+    ])
+    def test_partition_limited_to_coordination_sites(self, bad):
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_spec(bad)
+
     def test_empty_spec_yields_nothing(self):
         assert faults.parse_spec("") == []
         assert faults.parse_spec(" ; ; ") == []
@@ -173,6 +197,80 @@ class TestRegistry:
         faults.install("kv.put:drop", rank=0, state_dir=str(tmp_path))
         assert faults.inject("kv.put") is True
         assert not (tmp_path / "faults_fired").exists()
+
+
+class TestPartitionWindow:
+    """A fired ``partition(MS)`` clause opens a WINDOW: unlike ``drop``
+    (one lost operation), every coordination site — kv.get, kv.put,
+    heartbeat — is silenced as a unit until the window expires, which
+    is what a real network partition looks like to one rank."""
+
+    @pytest.fixture()
+    def tick(self):
+        from horovod_tpu.core import clock as core_clock
+
+        class _TickClock(core_clock.Clock):
+            def __init__(self):
+                self.t = 100.0
+
+            def monotonic(self):
+                return self.t
+
+            def wall(self):
+                return self.t
+
+            def sleep(self, seconds):
+                self.t += max(0.0, seconds)
+
+            def call_later(self, seconds, fn):
+                fn()
+
+        fake = _TickClock()
+        core_clock.install(fake)
+        yield fake
+        core_clock.install(None)
+
+    def test_window_silences_all_coordination_sites(self, tick):
+        faults.install("kv.put:partition(3000)", rank=0)
+        assert faults.partition_remaining() == 0.0
+        assert faults.inject("kv.get") is False  # window not yet open
+        assert faults.inject("kv.put") is True   # trigger: opens window
+        # every coordination site now drops, not just the trigger site
+        assert faults.inject("kv.get") is True
+        assert faults.inject("heartbeat") is True
+        assert faults.inject("kv.put") is True
+        assert 0.0 < faults.partition_remaining() <= 3.0
+
+    def test_window_expires_on_clock(self, tick):
+        faults.install("heartbeat:partition(500)", rank=0)
+        assert faults.inject("heartbeat") is True
+        tick.t += 0.4
+        assert faults.inject("kv.put") is True   # still inside window
+        tick.t += 0.2                            # past 500ms total
+        assert faults.partition_remaining() == 0.0
+        assert faults.inject("kv.put") is False
+        assert faults.inject("heartbeat") is False  # times=1: spent
+
+    def test_window_spares_non_coordination_sites(self, tick):
+        faults.install("kv.put:partition(3000)", rank=0)
+        assert faults.inject("kv.put") is True
+        # compute/storage planes keep flowing during the partition
+        assert faults.inject("worker.step") is False
+        assert faults.inject("collective.pre") is False
+        assert faults.inject_storage("ckpt.write") is None
+
+    def test_count_delays_window_open(self, tick):
+        faults.install("kv.get:partition(1000)@count=3", rank=0)
+        assert faults.inject("kv.get") is False
+        assert faults.inject("kv.get") is False
+        assert faults.partition_remaining() == 0.0
+        assert faults.inject("kv.get") is True   # 3rd hit opens it
+        assert faults.inject("heartbeat") is True
+
+    def test_rank_selector_scopes_window(self, tick):
+        faults.install("kv.put:partition(1000)@rank=1", rank=0)
+        assert faults.inject("kv.put") is False
+        assert faults.partition_remaining() == 0.0
 
 
 def test_inactive_guard_is_zero_overhead():
@@ -338,6 +436,81 @@ def test_injected_kill_with_zero_budget_fails_fast(tmp_path):
     assert "restart budget exhausted" in out, out[-3000:]
     assert "DONE" not in out, out[-3000:]
     assert out.count("launching 2 workers") == 1, out[-3000:]
+
+
+@pytest.mark.multiprocess
+def test_coordinator_rank_kill_replays_journal(tmp_path):
+    """ISSUE-17 acceptance: rank 0 — the rank on the coordinator host
+    — is killed mid-run.  The startup restore quorum's votes rode the
+    durable key journal (core/journal.py via the fenced quorum KV), so
+    the relaunched incarnation must REPLAY them into its fresh
+    coordination KV and still finish with exactly-once accounting."""
+    from conftest import make_discovery_script
+
+    _hosts, disc = make_discovery_script(tmp_path, "localhost:2")
+    state_dir = tmp_path / "state"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_EPOCHS"] = "5"
+    env["EPOCH_SLEEP"] = "0.2"
+    env["HVTPU_ELASTIC_DISCOVERY_INTERVAL"] = "0.2"
+    env["HVTPU_ELASTIC_STATE_DIR"] = str(state_dir)
+    env["HVTPU_LOG_LEVEL"] = "info"  # surfaces the replay line
+    cmd = [
+        sys.executable, "-m", "horovod_tpu.runner",
+        "--host-discovery-script", disc,
+        "--min-np", "2", "--cpu-devices", "1", "--verbose",
+        "--max-restarts", "3",
+        "--fault-spec", "worker.step:kill@rank=0,count=3",
+        "--", sys.executable, _SCRIPT,
+    ]
+    res = subprocess.run(cmd, env=env, cwd=_REPO, timeout=240,
+                         capture_output=True, text=True)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-4000:]
+    assert "fault injection: killing rank 0" in out, out[-4000:]
+    assert "DONE size=2 epoch=5" in out, out[-4000:]
+    assert out.count("launching 2 workers") == 2, out[-4000:]
+    # gen 0's restore-quorum votes rode the journal; the relaunch
+    # replayed them into the fresh coordinator
+    assert "kv journal: rank 0 replayed" in out, out[-4000:]
+    journal = state_dir / "kvjournal" / "rank0.jsonl"
+    assert journal.exists() and journal.read_text().strip(), (
+        "quorum votes never reached the durable key journal")
+
+
+@pytest.mark.multiprocess
+def test_partition_lease_expiry_self_fences_no_strike(tmp_path):
+    """ISSUE-17 acceptance: a partition(MS) window starves rank 1's KV
+    lease mid-run; the rank must SELF-FENCE (exit FENCE_EXIT_CODE)
+    rather than zombie on, and the driver must relaunch WITHOUT
+    charging its host a blacklist strike."""
+    from conftest import make_discovery_script
+
+    _hosts, disc = make_discovery_script(tmp_path, "localhost:2")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_EPOCHS"] = "8"
+    env["EPOCH_SLEEP"] = "0.5"  # long enough for the lease to starve
+    env["HVTPU_ELASTIC_DISCOVERY_INTERVAL"] = "0.2"
+    env["HVTPU_KV_LEASE_S"] = "1"
+    cmd = [
+        sys.executable, "-m", "horovod_tpu.runner",
+        "--host-discovery-script", disc,
+        "--min-np", "2", "--cpu-devices", "1", "--verbose",
+        "--max-restarts", "3",
+        "--fault-spec", "kv.put:partition(8000)@rank=1,count=2",
+        "--", sys.executable, _SCRIPT,
+    ]
+    res = subprocess.run(cmd, env=env, cwd=_REPO, timeout=240,
+                         capture_output=True, text=True)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-4000:]
+    assert "self-fenced (exit 89)" in out, out[-4000:]
+    assert "without a blacklist strike" in out, out[-4000:]
+    assert "blacklisting host" not in out, out[-4000:]
+    assert "DONE size=2 epoch=8" in out, out[-4000:]
+    assert out.count("launching 2 workers") == 2, out[-4000:]
 
 
 @pytest.mark.multiprocess

@@ -16,7 +16,7 @@ from pathlib import Path
 import pytest
 
 from tools.hvtpulint import (Project, load_suppressions, run_passes)
-from tools.hvtpulint import (knob_registry, metrics_catalog,
+from tools.hvtpulint import (knob_registry, kv_discipline, metrics_catalog,
                              rank_divergence, sim_purity, thread_safety,
                              wire_twin)
 
@@ -254,7 +254,8 @@ class TestCli:
         assert proc.returncode == 0
         listed = set(proc.stdout.split())
         assert {"wire-twin", "rank-divergence", "thread-safety",
-                "knob-registry", "metrics-catalog", "sim-purity"} <= listed
+                "knob-registry", "metrics-catalog", "sim-purity",
+                "kv-discipline"} <= listed
 
 
 # --------------------------------------------------------------------------
@@ -285,6 +286,40 @@ class TestSimPurity:
         findings = sim_purity.run(Project(REPO_ROOT))
         assert findings == [], "\n".join(
             f.format_text() for f in findings)
+
+
+# --------------------------------------------------------------------------
+# kv-discipline
+# --------------------------------------------------------------------------
+
+class TestKvDiscipline:
+    def test_clean_wrapper_patterns_are_silent(self):
+        assert run_pass(kv_discipline, "kv_disc_clean") == []
+
+    def test_bad_tree_flags_every_leak(self):
+        findings = run_pass(kv_discipline, "kv_disc_bad")
+        assert keys(findings) == {
+            "call:key_value_set:bad.py:1",
+            "call:key_value_set:bad.py:2",        # occurrence-indexed
+            "call:blocking_key_value_get:bad.py:1",
+            "call:key_value_dir_get:bad.py:1",    # chained, no binding
+            "call:key_value_delete:bad.py:1",     # taint through alias
+            "escape:_kv:bad.py:1",                # raw client on self
+        }
+        by_key = {f.key: f for f in findings}
+        esc = by_key["escape:_kv:bad.py:1"]
+        assert esc.pass_name == "kv-discipline"
+        assert esc.path == "horovod_tpu/bad.py"
+        assert "self._kv" in esc.message
+        assert "FencedKV/ResilientKV" in esc.message
+
+    def test_real_tree_has_only_the_transport_escape(self):
+        # The eager KVTransport deliberately holds the raw client (see
+        # the justified entry in .hvtpulint.suppress); everything else
+        # in the shipped tree must go through core/retry.py wrappers.
+        findings = kv_discipline.run(Project(REPO_ROOT))
+        assert keys(findings) == {"escape:_kv:controller.py:1"}, \
+            "\n".join(f.format_text() for f in findings)
 
 
 def test_repo_is_clean():

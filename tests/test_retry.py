@@ -249,3 +249,220 @@ class TestResilientKV:
             assert fake.d == {"a": "1"}
         finally:
             faults.uninstall()
+
+
+class TestFencedKV:
+    """Generation-fenced wrapper: stamping, stale-write rejection,
+    beacon supersession, and fake-clock lease expiry (no real
+    sleeps)."""
+
+    def _fenced(self, fake=None, rank=0, generation=0, **kw):
+        fake = FlakyKV() if fake is None else fake
+        exits = []
+        kv = retry.FencedKV(fake, rank=rank, job_epoch=0,
+                            generation=generation,
+                            exit_fn=exits.append, **kw)
+        return fake, kv, exits
+
+    def test_stamp_roundtrip(self):
+        fake, kv, _ = self._fenced(generation=3)
+        kv.key_value_set("k", "payload")
+        raw = fake.d["k"]
+        assert raw.startswith("\x1fF0.3\x1f")
+        token, payload = retry.unstamp(raw)
+        assert token == (0, 3) and payload == "payload"
+        assert kv.key_value_try_get("k") == "payload"
+
+    def test_unstamp_tolerates_unstamped_and_malformed(self):
+        assert retry.unstamp("plain") == (None, "plain")
+        assert retry.unstamp(None) == (None, None)
+        assert retry.unstamp(b"bytes") == (None, b"bytes")
+        assert retry.unstamp("\x1fFnope\x1fv") == (None, "\x1fFnope\x1fv")
+        assert retry.unstamp("\x1fF1.2") == (None, "\x1fF1.2")
+
+    def test_reader_rejects_stale_generation(self):
+        fake, kv, _ = self._fenced(generation=2)
+        before = obs_metrics.REGISTRY.counter(
+            "hvtpu_kv_fenced_writes_total").value()
+        fake.d["z"] = "\x1fF0.1\x1fstale"   # older writer's value
+        with pytest.raises(KeyError, match="fenced stale write"):
+            kv.key_value_try_get("z")
+        after = obs_metrics.REGISTRY.counter(
+            "hvtpu_kv_fenced_writes_total").value()
+        assert after - before == 1
+
+    def test_dir_get_skips_stale_entries(self):
+        fake, kv, _ = self._fenced(generation=2)
+        kv.key_value_set("p/live", "good")
+        fake.d["p/old"] = "\x1fF0.0\x1fstale"
+        fake.d["p/plain"] = "legacy"        # unstamped: passes through
+        entries = dict(kv.key_value_dir_get("p/"))
+        assert entries == {"p/live": "good", "p/plain": "legacy"}
+
+    def test_beacon_supersession_fences_old_writer(self):
+        fake = FlakyKV()
+        _, old, old_exits = self._fenced(fake, rank=0, generation=0)
+        old.key_value_set("k", "v0")
+        _, new, new_exits = self._fenced(fake, rank=1, generation=1)
+        assert fake.d[retry.FENCE_BEACON_KEY] == "0.1"
+        # force the old writer to re-check the beacon on its next op
+        old._recheck = True
+        with pytest.raises(retry.FencedError, match="superseded"):
+            old.key_value_set("k", "stale")
+        assert old_exits == [retry.FENCE_EXIT_CODE]
+        assert new_exits == []
+        # the stale write never reached the store
+        assert retry.unstamp(fake.d["k"])[1] == "v0"
+        # a fenced client refuses every further op
+        with pytest.raises(retry.FencedError):
+            old.key_value_try_get("k")
+
+    def test_lease_expiry_fences_on_fake_clock(self):
+        from horovod_tpu.core import clock as core_clock
+
+        class FakeClock(core_clock.Clock):
+            def __init__(self):
+                self.t = 100.0
+
+            def monotonic(self):
+                return self.t
+
+            def wall(self):
+                return self.t
+
+            def sleep(self, seconds):
+                self.t += max(0.0, seconds)
+
+            def call_later(self, delay_s, fn):
+                return core_clock.Timer()
+
+        class DownKV(FlakyKV):
+            def key_value_set(self, k, v):
+                raise RuntimeError("UNAVAILABLE: host gone")
+
+        fc = FakeClock()
+        core_clock.install(fc)
+        try:
+            fake = DownKV()
+            fake.d[retry.FENCE_BEACON_KEY] = "0.0"
+            policy = retry.RetryPolicy(
+                name="kv-test", max_attempts=2, base_delay_s=0.0,
+                retryable=retry.kv_retryable)
+            exits = []
+            kv = retry.FencedKV(fake, rank=0, job_epoch=0,
+                                generation=0, lease_s=5.0,
+                                policy=policy, exit_fn=exits.append)
+            assert kv.lease_remaining() == pytest.approx(5.0)
+            # unreachable but inside the lease: op fails, no fence
+            with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+                kv.key_value_set("a", "1")
+            assert exits == []
+            fc.t += 6.0   # lease expires with zero server contact
+            before = obs_metrics.REGISTRY.counter(
+                "hvtpu_fence_exits_total").value()
+            with pytest.raises(retry.FencedError, match="lease expired"):
+                kv.key_value_set("a", "2")
+            assert exits == [retry.FENCE_EXIT_CODE]
+            after = obs_metrics.REGISTRY.counter(
+                "hvtpu_fence_exits_total").value()
+            assert after - before == 1
+        finally:
+            core_clock.install(None)
+
+    def test_lease_survives_not_found_answers(self):
+        """NOT_FOUND proves the server answered: the lease refreshes
+        even though the op 'failed'."""
+        from horovod_tpu.core import clock as core_clock
+
+        class FakeClock(core_clock.Clock):
+            def __init__(self):
+                self.t = 0.0
+
+            def monotonic(self):
+                return self.t
+
+            def wall(self):
+                return self.t
+
+            def sleep(self, seconds):
+                self.t += max(0.0, seconds)
+
+            def call_later(self, delay_s, fn):
+                return core_clock.Timer()
+
+        fc = FakeClock()
+        core_clock.install(fc)
+        try:
+            fake = FlakyKV()
+            fake.d[retry.FENCE_BEACON_KEY] = "0.0"
+            exits = []
+            kv = retry.FencedKV(fake, rank=0, job_epoch=0,
+                                generation=0, lease_s=5.0,
+                                exit_fn=exits.append)
+            for _ in range(4):
+                fc.t += 3.0   # cumulative silence would breach 5s
+                with pytest.raises(KeyError):
+                    kv.key_value_try_get("missing")
+            assert exits == []
+            assert kv.lease_remaining() > 0.0
+        finally:
+            core_clock.install(None)
+
+    def test_journal_rides_write_path(self, tmp_path):
+        from horovod_tpu.core.journal import KeyJournal
+
+        fake = FlakyKV()
+        journal = KeyJournal(str(tmp_path), rank=0)
+        _, kv, _ = self._fenced(fake, journal=journal)
+        kv.add_journal_prefix("dur/")
+        kv.key_value_set("dur/vote/0", "7")
+        kv.key_value_set("ephemeral/x", "1")   # not a durable prefix
+        assert journal.entries() == {"dur/vote/0": "7"}
+        kv.key_value_delete("dur/vote/0")
+        assert journal.entries() == {}
+        # replay into a fresh store: only live entries come back
+        kv.key_value_set("dur/vote/0", "9")
+        fresh = FlakyKV()
+        reloaded = KeyJournal(str(tmp_path), rank=0)
+        assert reloaded.replay(fresh) == 1
+        assert fresh.d["dur/vote/0"] == "9"
+
+    def test_fenced_kv_factory_idempotent_and_gated(self, monkeypatch):
+        fake = FlakyKV()
+        kv = retry.fenced_kv(fake, rank=0)
+        assert isinstance(kv, retry.FencedKV)
+        assert retry.fenced_kv(kv) is kv
+        assert retry.fenced_kv(None) is None
+        # a plain ResilientKV is re-wrapped around its inner client
+        plain = retry.resilient_kv(FlakyKV(), rank=0)
+        rewrapped = retry.fenced_kv(plain, rank=0)
+        assert isinstance(rewrapped, retry.FencedKV)
+        assert rewrapped._kv is plain._kv
+        # escape hatch: fencing disabled falls back to ResilientKV
+        monkeypatch.setenv("HVTPU_KV_FENCE_DISABLE", "1")
+        fallback = retry.fenced_kv(FlakyKV(), rank=0)
+        assert isinstance(fallback, retry.ResilientKV)
+        assert not isinstance(fallback, retry.FencedKV)
+
+    def test_zombie_rejection_exactly_once(self):
+        """A writer frozen across a restart (generation bump) has every
+        post-thaw write rejected by readers and fences on its first
+        beacon re-check — DELIVER accounting stays exactly-once."""
+        fake = FlakyKV()
+        _, zombie, z_exits = self._fenced(fake, rank=0, generation=0)
+        delivered = []
+        zombie.key_value_set("q/1", "sample-1")
+        # restart happens while the zombie is frozen
+        _, live, _ = self._fenced(fake, rank=0, generation=1)
+        live.key_value_set("q/1", "sample-1")    # re-delivered by gen 1
+        live.key_value_set("q/2", "sample-2")
+        # the zombie thaws mid-write burst: a dropped/failed op forces
+        # the beacon re-check, so it fences BEFORE the write lands
+        zombie._recheck = True
+        with pytest.raises(retry.FencedError):
+            zombie.key_value_set("q/3", "stale-sample")
+        assert z_exits == [retry.FENCE_EXIT_CODE]
+        assert "q/3" not in fake.d
+        for k, v in live.key_value_dir_get("q/"):
+            delivered.append(v)
+        assert sorted(delivered) == ["sample-1", "sample-2"]

@@ -173,6 +173,32 @@ class TestFastChaosMatrix:
         assert neg["sidecar_responses"] == 4
         assert 0 < neg["cycle_p50_s"] <= neg["cycle_max_s"]
 
+    def test_coordinator_loss_256(self):
+        # the scenario itself asserts the recovery contract (every
+        # gen-0 rank exits FENCE_EXIT_CODE once the coordinator host
+        # dies, a DIFFERENT host wins the re-election, and journal
+        # replay republishes every durable vote into the fresh KV);
+        # here we pin the measured detect/recover rows the bench embeds
+        r = run_scenario("coordinator-loss", 256, seed=7)
+        loss = r["stats"]["phases"]["coordinator_loss"]
+        assert loss["fence_exits"] == 256
+        assert loss["new_coordinator"] != loss["old_coordinator"]
+        assert loss["replayed_keys"] == 256
+        assert 0 < loss["detect_p50_s"] <= loss["detect_max_s"]
+        assert loss["fence_to_recover_s"] > 0
+
+    def test_partition_storm_256(self):
+        # scenario asserts: partitioned-but-thawed victims are held as
+        # SUSPECT (not blamed dead) and recover, while the victim whose
+        # lease expires self-fences with zero post-thaw writes accepted
+        r = run_scenario("partition-storm", 256, seed=7)
+        storm = r["stats"]["phases"]["partition_storm"]
+        assert len(storm["victims"]) == 3
+        assert storm["recovered"] == 2
+        assert storm["suspect_observations"] >= 1
+        assert 0 < storm["detect_p50_s"] <= storm["detect_max_s"]
+        assert storm["fence_latency_s"] > 0
+
     def test_stream_matrix_64(self):
         # split-burst + forced mispredict + membership-change-free
         # shutdown interleavings on the streamed plane; 256-rank and
@@ -196,7 +222,8 @@ def _dump(result):
 class TestDeterminism:
     @pytest.mark.parametrize(
         "name", ["steady-drain", "kill-blacklist", "multi-job-arbiter",
-                 "checkpoint-storm", "compression-negotiation"])
+                 "checkpoint-storm", "compression-negotiation",
+                 "coordinator-loss", "partition-storm"])
     def test_same_seed_byte_identical(self, name):
         a = _dump(run_scenario(name, 64, seed=7))
         b = _dump(run_scenario(name, 64, seed=7))
@@ -213,7 +240,8 @@ class TestDeterminism:
             "thundering-rendezvous", "steady-drain", "rolling-preemption",
             "kill-blacklist", "kv-brownout", "straggler-tail",
             "stream-matrix", "multi-job-arbiter", "checkpoint-storm",
-            "compression-negotiation", "anomaly-detection"}
+            "compression-negotiation", "anomaly-detection",
+            "coordinator-loss", "partition-storm"}
         with pytest.raises(KeyError, match="steady-drain"):
             run_scenario("no-such-scenario", 8)
 
@@ -254,6 +282,19 @@ class TestScale:
         quorum = r["stats"]["phases"]["restore_quorum"]
         assert quorum["agreed_seq"] == 3
         assert quorum["quorum_max_s"] > 0
+
+    def test_coordinator_loss_1024(self):
+        r = run_scenario("coordinator-loss", 1024, seed=7)
+        loss = r["stats"]["phases"]["coordinator_loss"]
+        assert loss["fence_exits"] == 1024
+        assert loss["replayed_keys"] == 1024
+        assert loss["fence_to_recover_s"] > 0
+
+    def test_partition_storm_1024(self):
+        r = run_scenario("partition-storm", 1024, seed=7)
+        storm = r["stats"]["phases"]["partition_storm"]
+        assert storm["recovered"] == len(storm["victims"]) - 1
+        assert storm["detect_max_s"] > 0
 
     def test_thundering_rendezvous_4096(self):
         r = run_scenario("thundering-rendezvous", 4096, seed=7)

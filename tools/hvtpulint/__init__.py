@@ -1,6 +1,6 @@
 """hvtpulint — zero-dependency static analysis for the hvtpu tree.
 
-Six passes guard invariants that are otherwise only enforced at
+Seven passes guard invariants that are otherwise only enforced at
 runtime (see docs/static-analysis.md):
 
   wire-twin        C++ wire format (native/src) vs the Python twin
@@ -9,6 +9,8 @@ runtime (see docs/static-analysis.md):
   knob-registry    HVTPU_* env knobs vs the generated docs/knobs.md
   metrics-catalog  registered metrics vs docs/observability.md vs bench
   sim-purity       no host time / ambient RNG in horovod_tpu/sim
+  kv-discipline    raw coordination-client KV calls outside the
+                   FencedKV/ResilientKV wrappers (core/retry.py)
 
 Everything here is stdlib-only (ast + re); the C++ side is scanned
 lexically, never compiled.
@@ -59,7 +61,7 @@ class Project:
 
     Passes receive a Project rather than raw paths so the tier-1
     clean-tree run parses each Python file at most once across all
-    five passes.
+    passes.
     """
 
     def __init__(self, root: Path):
@@ -215,8 +217,8 @@ def apply_suppressions(findings: Iterable[Finding],
 def _registry() -> Dict[str, Callable[[Project], List[Finding]]]:
     # Imported lazily so `import tools.hvtpulint` stays cheap and the
     # passes can import this module for Finding/Project.
-    from . import (knob_registry, metrics_catalog, rank_divergence,
-                   sim_purity, thread_safety, wire_twin)
+    from . import (knob_registry, kv_discipline, metrics_catalog,
+                   rank_divergence, sim_purity, thread_safety, wire_twin)
     return {
         "wire-twin": wire_twin.run,
         "rank-divergence": rank_divergence.run,
@@ -224,6 +226,7 @@ def _registry() -> Dict[str, Callable[[Project], List[Finding]]]:
         "knob-registry": knob_registry.run,
         "metrics-catalog": metrics_catalog.run,
         "sim-purity": sim_purity.run,
+        "kv-discipline": kv_discipline.run,
     }
 
 

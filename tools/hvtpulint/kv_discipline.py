@@ -1,0 +1,144 @@
+"""kv-discipline pass: no raw coordination-client KV traffic.
+
+Every KV operation against the jax coordination service must go
+through the ``core/retry.py`` wrappers — ``resilient_kv`` (retry with
+backoff, metrics) or ``fenced_kv`` (generation fencing, liveness
+lease, durable-key journal).  A raw ``key_value_*`` call on the bare
+client bypasses all three coordination-plane fault-tolerance layers:
+a superseded zombie can publish stale state, transient coordinator
+blips surface as instant failures, and durable writes are invisible
+to the coordinator-loss replay journal (docs/robustness.md,
+"Coordination-plane fault tolerance").
+
+The pass tracks, per function scope, names bound from the raw client
+singleton (``…global_state.client``) and flags:
+
+  * a ``key_value_*`` / ``blocking_key_value_*`` call on such a name
+    (or directly on the ``global_state.client`` chain) — the classic
+    raw get/put;
+  * storing a raw name on ``self`` (``self._kv = client``) — the
+    client escapes into instance state unwrapped, so every later call
+    through that attribute is raw.  The escape is flagged once, at
+    the assignment, rather than at each downstream call site.
+
+A raw name is discharged when it is passed to ``fenced_kv``/
+``resilient_kv`` (including the common rebind
+``client = fenced_kv(client, …)``) or re-assigned any non-raw value.
+Legitimate bootstrap-before-init paths that truly need the bare
+client carry a justified entry in ``.hvtpulint.suppress``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from . import Finding, Project
+
+PASS = "kv-discipline"
+
+SCAN_DIR = "horovod_tpu"
+
+#: factory names that wrap a raw client (core/retry.py); passing a raw
+#: name into one of these discharges it.
+WRAPPERS = {"fenced_kv", "resilient_kv"}
+
+
+def _is_raw_chain(node: ast.AST) -> bool:
+    """``<anything>.global_state.client`` attribute chain."""
+    return (isinstance(node, ast.Attribute) and node.attr == "client"
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "global_state")
+
+
+def _is_kv_method(attr: str) -> bool:
+    return attr.startswith(("key_value_", "blocking_key_value_"))
+
+
+def _call_name(fn: ast.AST) -> str:
+    """Terminal name of a call target: ``fenced_kv`` for both
+    ``fenced_kv(...)`` and ``core_retry.fenced_kv(...)``."""
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self):
+        # names currently bound to the raw client in this scope
+        self.raw: Dict[str, int] = {}
+        self.hits: List[tuple] = []  # (line, canonical)
+
+    # -- scoping: raw bindings don't leak across function boundaries --
+    def _scoped(self, node: ast.AST) -> None:
+        saved, self.raw = self.raw, {}
+        self.generic_visit(node)
+        self.raw = saved
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._scoped(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._scoped(node)
+
+    # -- bindings ------------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        raw_value = (_is_raw_chain(node.value)
+                     or (isinstance(node.value, ast.Name)
+                         and node.value.id in self.raw))
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                if raw_value:
+                    self.raw[tgt.id] = node.lineno
+                else:
+                    self.raw.pop(tgt.id, None)
+            elif (isinstance(tgt, ast.Attribute) and raw_value
+                  and isinstance(tgt.value, ast.Name)
+                  and tgt.value.id == "self"):
+                self.hits.append((node.lineno, f"escape:{tgt.attr}"))
+        self.generic_visit(node)
+
+    # -- uses ----------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if _call_name(fn) in WRAPPERS:
+            # client handed to a core/retry wrapper: discharged
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    self.raw.pop(arg.id, None)
+        elif isinstance(fn, ast.Attribute) and _is_kv_method(fn.attr):
+            base = fn.value
+            if ((isinstance(base, ast.Name) and base.id in self.raw)
+                    or _is_raw_chain(base)):
+                self.hits.append((node.lineno, f"call:{fn.attr}"))
+        self.generic_visit(node)
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in project.py_files(SCAN_DIR):
+        tree = project.parse(path)
+        if tree is None:
+            continue
+        visitor = _Visitor()
+        visitor.visit(tree)
+        rel = project.rel(path)
+        counts: Dict[str, int] = {}
+        for line, canonical in visitor.hits:
+            n = counts[canonical] = counts.get(canonical, 0) + 1
+            if canonical.startswith("escape:"):
+                msg = ("raw coordination client stored on "
+                       f"self.{canonical.split(':', 1)[1]} without a "
+                       "FencedKV/ResilientKV wrapper — every KV call "
+                       "through it skips fencing, retry, and the "
+                       "durable-key journal (core/retry.py)")
+            else:
+                msg = (f"raw coordination-client {canonical.split(':', 1)[1]}"
+                       "() outside FencedKV/ResilientKV — wrap the client "
+                       "with core.retry.fenced_kv/resilient_kv so fencing, "
+                       "retry, and journaling apply")
+            findings.append(Finding(
+                PASS, rel, line, f"{canonical}:{path.name}:{n}", msg))
+    return findings
