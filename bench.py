@@ -169,6 +169,13 @@ REQUIRED_METRIC_KEYS = (
     "hvtpu_kv_fenced_writes_total",
     "hvtpu_fence_exits_total",
     "hvtpu_partition_suspect_seconds",
+    # zero-copy fusion buffers (PR 18, comm/packing.py,
+    # eager/controller.py): which fused-allreduce path ran.  A steady
+    # run shows zero_copy climbing and staged flat after warmup;
+    # staged rising mid-run means the pack plan kept falling back
+    # (mispredicts, shape churn, compression).
+    "hvtpu_fusion_zero_copy_ops_total",
+    "hvtpu_fusion_staged_copies_total",
 )
 
 

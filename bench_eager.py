@@ -30,14 +30,17 @@ TORCH_STEP_KEYS = (
 # of cycles in the timed window that skipped the KV round trip, and
 # the mispredict count/rate — a steady-state row with prediction
 # healthy shows predicted_fraction near 1 and zero mispredicts.
+# Round 8 adds zero_copy_fraction: the share of fused-allreduce ops in
+# the window that rode the enqueue-time-packed exchange buffer instead
+# of the drain-time staged copy (None when the window fused nothing).
 PREDICT_ROW_KEYS = ("predicted_fraction", "mispredicts",
-                    "mispredict_rate")
+                    "mispredict_rate", "zero_copy_fraction")
 
 
 def snapshot_predict_counters():
-    """Controller cycle/prediction counter values for THIS process
-    (rank 0 when run under the runner: per_rank[0] is what lands in
-    the report)."""
+    """Controller cycle/prediction/fusion-path counter values for THIS
+    process (rank 0 when run under the runner: per_rank[0] is what
+    lands in the report)."""
     from horovod_tpu.obs import metrics as obs_metrics
 
     return {
@@ -47,6 +50,10 @@ def snapshot_predict_counters():
             "hvtpu_controller_predicted_cycles_total").value(),
         "mispredicts": obs_metrics.counter(
             "hvtpu_controller_mispredicts_total").value(),
+        "zero_copy": obs_metrics.counter(
+            "hvtpu_fusion_zero_copy_ops_total").value(),
+        "staged": obs_metrics.counter(
+            "hvtpu_fusion_staged_copies_total").value(),
     }
 
 
@@ -54,16 +61,22 @@ def build_predict_stats(before, after):
     """The PREDICT_ROW_KEYS columns from two snapshot_predict_counters
     readings bracketing a timed window.  Fractions are None when the
     window ran no controller cycles (e.g. a 1-proc dispatch bench
-    short-circuiting the wire)."""
+    short-circuiting the wire).  The fusion-path keys default to 0 so
+    older 3-key snapshots (and the schema test's fixtures) still
+    build."""
     cycles = after["cycles"] - before["cycles"]
     predicted = after["predicted"] - before["predicted"]
     mis = after["mispredicts"] - before["mispredicts"]
+    zc = after.get("zero_copy", 0) - before.get("zero_copy", 0)
+    staged = after.get("staged", 0) - before.get("staged", 0)
     return {
         "predicted_fraction": (round(predicted / cycles, 3)
                                if cycles else None),
         "mispredicts": int(mis),
         "mispredict_rate": (round(mis / cycles, 4)
                             if cycles else None),
+        "zero_copy_fraction": (round(zc / (zc + staged), 3)
+                               if (zc + staged) else None),
     }
 
 

@@ -58,6 +58,7 @@ from ..core.topology import DCN_AXIS, ICI_AXIS, LDEV_AXIS, PROC_AXIS
 from ..obs import metrics as obs_metrics
 from ..obs import stepprof
 from ..obs import tracing
+from . import packing
 from . import spmd
 from . import stall
 from .compression import NoneCompressor
@@ -735,9 +736,12 @@ def invalidate_routing_plans() -> int:
     functions of their keys, but dropping them forces the first
     post-resync collective of each signature through the full routing
     derivation (and a fresh ``_jitted`` entry), so no dispatch reuses
-    an artifact jitted for the mispredicted grouping.  Returns the
-    number of plans dropped (0 before init — protocol-level tests run
-    controllers without a world)."""
+    an artifact jitted for the mispredicted grouping.  The memoized
+    group-unpack programs of the zero-copy fusion plane are keyed by
+    the same now-suspect groupings, so they drop together.  Returns
+    the number of plans dropped (0 before init — protocol-level tests
+    run controllers without a world)."""
+    packing.clear_unpack_cache()
     st = core_state.global_state()
     if not getattr(st, "initialized", False):
         return 0

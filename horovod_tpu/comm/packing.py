@@ -2,11 +2,28 @@
 (the memcpy-in/out of the reference's fusion buffer,
 horovod/common/ops/collective_operations.cc MemcpyInFusionBuffer /
 MemcpyOutFusionBuffer — here expressed as XLA concat/slice that fuse
-into the surrounding program)."""
+into the surrounding program).
+
+Zero-copy fusion-buffer plane (see docs/design.md "Zero-copy fusion
+buffers"): :class:`ExchangeBuffer` is the persistent host exchange
+buffer of the reference's FusionBufferManager, pooled per
+(process-set, fused-spec) by :class:`FusionBufferPool` and filled at
+*enqueue* time by the eager controller once a steady predicted
+schedule fixes each op's offset before the burst drains.  Offsets are
+dtype-aligned (:func:`assign_offsets`) so every unpack is a view —
+never the silent ``tobytes()`` copy of :func:`unpack_bytes`'s
+unaligned fallback — and the drain-time unpack is one cached jitted
+program (:func:`group_unpack_program`) whose slice/reshape/cast fuse
+into the consumer's own XLA program instead of running as an eager
+per-tensor copy loop."""
 
 from __future__ import annotations
 
-from typing import Any, List, Sequence, Tuple
+import functools
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
@@ -69,14 +86,19 @@ def pack_bytes(raws, parallel: bool = True):
     return buf, specs
 
 
-def unpack_bytes(buf, specs):
+def unpack_bytes(buf, specs, offsets: Optional[Sequence[int]] = None):
     """Inverse of :func:`pack_bytes` → list of numpy arrays (views
-    where alignment allows, copies otherwise)."""
+    where alignment allows, copies otherwise).  ``offsets`` overrides
+    the contiguous layout with explicit byte offsets (the aligned
+    layout of :func:`assign_offsets`, under which the view path
+    always applies)."""
     import numpy as np
 
     out = []
     off = 0
-    for shape, dtype, nbytes in specs:
+    for i, (shape, dtype, nbytes) in enumerate(specs):
+        if offsets is not None:
+            off = offsets[i]
         chunk = buf[off:off + nbytes]
         try:
             piece = chunk.view(dtype).reshape(shape)
@@ -87,3 +109,194 @@ def unpack_bytes(buf, specs):
         out.append(piece)
         off += nbytes
     return out
+
+
+# ---------------------------------------------------------------------------
+# zero-copy fusion-buffer plane
+# ---------------------------------------------------------------------------
+
+#: Pool-capacity knob: how many idle exchange buffers FusionBufferPool
+#: keeps across all layouts before evicting the least recently used.
+POOL_KNOB = "HVTPU_FUSION_BUFFER_POOL"
+
+
+def _byte_specs(specs):
+    import numpy as np
+
+    return [(tuple(shape), np.dtype(dtype), int(nbytes))
+            for shape, dtype, nbytes in specs]
+
+
+def assign_offsets(specs, align: Optional[int] = None
+                   ) -> Tuple[List[int], int]:
+    """Byte offsets for packing ``specs`` = [(shape, dtype, nbytes),
+    ...] into one buffer, each offset padded up to the group's max
+    itemsize (or ``align``) so ``unpack_bytes``'s view path always
+    applies — the aligned-offset contract of the zero-copy plane.
+    Returns ``(offsets, total_bytes)``; for a uniform-dtype group the
+    padding is zero and the layout is exactly the contiguous one."""
+    import numpy as np
+
+    specs = _byte_specs(specs)
+    if align is None:
+        align = max((np.dtype(d).itemsize for _s, d, _n in specs),
+                    default=1)
+    align = max(1, int(align))
+    offsets, off = [], 0
+    for _shape, _dtype, nbytes in specs:
+        off = -(-off // align) * align
+        offsets.append(off)
+        off += nbytes
+    return offsets, -(-off // align) * align
+
+
+class ExchangeBuffer:
+    """One persistent host exchange buffer for a fused group (parity:
+    the reference's FusionBufferManager buffer).  ``write(i, arr)`` is
+    the group's entire MemcpyInFusionBuffer for op ``i`` — a single
+    byte copy to a dtype-aligned offset assigned at construction, so
+    the eager controller can pack payloads at *enqueue* time, before
+    the burst drains.  ``typed_view()`` exposes the filled payload as
+    one wire-dtype array for the fused collective (uniform-dtype
+    groups, the only kind the controller fuses)."""
+
+    __slots__ = ("specs", "offsets", "nbytes", "buf", "_filled")
+
+    def __init__(self, specs):
+        import numpy as np
+
+        self.specs = _byte_specs(specs)
+        self.offsets, self.nbytes = assign_offsets(self.specs)
+        self.buf = np.empty(self.nbytes, np.uint8)
+        self._filled: set = set()
+
+    def layout_key(self):
+        return tuple(self.specs)
+
+    def reset(self):
+        self._filled.clear()
+
+    def write(self, i: int, arr) -> bool:
+        """Pack op ``i``'s bytes at its assigned offset; False when the
+        slot was already filled (a stale plan — caller falls back)."""
+        import numpy as np
+
+        if i in self._filled:
+            return False
+        shape, dtype, nbytes = self.specs[i]
+        a = np.ascontiguousarray(arr)
+        if a.dtype != dtype or a.nbytes != nbytes:
+            return False
+        off = self.offsets[i]
+        self.buf[off:off + nbytes] = a.reshape(-1).view(np.uint8)
+        self._filled.add(i)
+        return True
+
+    def complete(self) -> bool:
+        return len(self._filled) == len(self.specs)
+
+    def typed_view(self):
+        """The whole payload as one 1-D wire-dtype array (requires the
+        uniform-dtype layout the controller's fuser guarantees)."""
+        dtype = self.specs[0][1]
+        if any(d != dtype for _s, d, _n in self.specs):
+            raise ValueError("typed_view requires a uniform-dtype group")
+        return self.buf.view(dtype)
+
+    def element_specs(self):
+        """(shape, dtype, element-count) triples in pack_flat's spec
+        form, for :func:`group_unpack_program`."""
+        return [(shape, dtype, nbytes // dtype.itemsize)
+                for shape, dtype, nbytes in self.specs]
+
+    def views(self):
+        """Host-side unpack: per-op numpy VIEWS of the buffer (the
+        aligned offsets make the view path unconditional)."""
+        return unpack_bytes(self.buf, self.specs, offsets=self.offsets)
+
+
+class FusionBufferPool:
+    """LRU pool of :class:`ExchangeBuffer`\\ s keyed per
+    (process-set id, fused-spec layout) — the same keying as the
+    memoized allreduce routing plans in comm/eager.py — bounded by the
+    ``HVTPU_FUSION_BUFFER_POOL`` knob.  Thread-safe: the controller's
+    enqueue thread acquires while the executor thread releases."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = int(os.environ.get(POOL_KNOB, "16"))
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        # (psid, layout) -> stack of idle buffers; OrderedDict order is
+        # the LRU order across keys.
+        self._idle: "OrderedDict[tuple, list]" = OrderedDict()
+        self._pooled = 0
+
+    def acquire(self, psid: int, specs) -> ExchangeBuffer:
+        key = (psid, tuple(_byte_specs(specs)))
+        with self._lock:
+            stack = self._idle.get(key)
+            if stack:
+                self._idle.move_to_end(key)
+                self._pooled -= 1
+                buf = stack.pop()
+                if not stack:
+                    del self._idle[key]
+                buf.reset()
+                return buf
+        return ExchangeBuffer(specs)
+
+    def release(self, psid: int, xb: ExchangeBuffer):
+        key = (psid, xb.layout_key())
+        xb.reset()
+        with self._lock:
+            self._idle.setdefault(key, []).append(xb)
+            self._idle.move_to_end(key)
+            self._pooled += 1
+            while self._pooled > self.capacity:
+                _k, stack = next(iter(self._idle.items()))
+                stack.pop(0)
+                self._pooled -= 1
+                if not stack:
+                    del self._idle[_k]
+
+    def clear(self):
+        with self._lock:
+            self._idle.clear()
+            self._pooled = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"pooled": self._pooled, "capacity": self.capacity,
+                    "layouts": len(self._idle)}
+
+
+@functools.lru_cache(maxsize=128)
+def _unpack_program(specs_key):
+    import jax
+
+    def run(flat):
+        outs, off = [], 0
+        for shape, dtype, size in specs_key:
+            outs.append(flat[off:off + size].reshape(shape).astype(dtype))
+            off += size
+        return tuple(outs)
+
+    return jax.jit(run)
+
+
+def group_unpack_program(specs):
+    """ONE cached jitted program slicing/reshaping/casting every piece
+    of a fused wire result — the deferred MemcpyOutFusionBuffer of the
+    zero-copy plane.  Keyed by the (shape, dtype, size) spec tuple, so
+    steady-state drains reuse the compiled artifact; the cache is
+    dropped with the routing plans on mispredict
+    (comm/eager.invalidate_routing_plans)."""
+    key = tuple((tuple(s), jnp.dtype(d), int(n)) for s, d, n in specs)
+    return _unpack_program(key)
+
+
+def clear_unpack_cache() -> None:
+    """Drop the memoized group-unpack programs (mispredict/membership
+    invalidation rides comm/eager.invalidate_routing_plans)."""
+    _unpack_program.cache_clear()

@@ -36,10 +36,12 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import native
 from ..native import wire
 from ..comm import eager as eager_comm
+from ..comm import packing as comm_packing
 from ..comm.compression import NoneCompressor
 from ..comm.packing import pack_flat, unpack_flat
 from ..comm.reduce_ops import ReduceOp
@@ -109,6 +111,18 @@ _M_LAST_ARRIVER = obs_metrics.counter(
     "hvtpu_collective_last_arriver_total",
     "Times each rank was the LAST member to announce a collective "
     "(rank 0 only; labeled by the straggling rank).")
+_M_FUSION_ZC = obs_metrics.counter(
+    "hvtpu_fusion_zero_copy_ops_total",
+    "Fused allreduce ops that rode the zero-copy fusion-buffer plane: "
+    "payload bytes packed into the pooled exchange buffer at enqueue "
+    "time (offsets fixed by the steady predicted schedule) and "
+    "unpacked as lazy views — no drain-time staging copies.")
+_M_FUSION_STAGED = obs_metrics.counter(
+    "hvtpu_fusion_staged_copies_total",
+    "Fused allreduce ops that took the drain-time staged-copy path "
+    "(pack_flat concatenate + eager per-tensor unpack) because the "
+    "drain was unpredicted, mispredicted, or the group was not "
+    "prepack-eligible — fail back to correct, never to fast.")
 
 #: Error-text marker the controllers (C++ and Python twin, byte-
 #: identical) emit for cross-rank metadata disagreement; used to raise
@@ -136,6 +150,61 @@ def _apply_scale(t, factor: float):
 
         return fused_scale_cast(t.reshape(-1), factor).reshape(t.shape)
     return t * jnp.asarray(factor, t.dtype)
+
+
+class _GroupUnpack:
+    """Shared deferred MemcpyOutFusionBuffer for one zero-copy fused
+    group: the first consumer materializes EVERY piece through ONE
+    cached jitted slice/reshape/cast program
+    (comm/packing.group_unpack_program) — no eager per-tensor copy
+    loop — then returns the pooled exchange buffer, which must stay
+    untouched until the device consumed the wire result (CPU
+    device_put may alias host memory)."""
+
+    __slots__ = ("_lock", "_red", "_specs", "_pack", "_pool", "_psid",
+                 "_pieces")
+
+    def __init__(self, red, specs, pack, pool, psid):
+        self._lock = threading.Lock()
+        self._red = red
+        self._specs = specs
+        self._pack = pack
+        self._pool = pool
+        self._psid = psid
+        self._pieces = None
+
+    def piece(self, i: int):
+        with self._lock:
+            if self._pieces is None:
+                fn = comm_packing.group_unpack_program(self._specs)
+                pieces = fn(self._red)
+                jax.block_until_ready(pieces)
+                self._pieces = list(pieces)
+                self._red = None
+                pack, self._pack = self._pack, None
+                if pack is not None:
+                    self._pool.release(self._psid, pack)
+            return self._pieces[i]
+
+
+class _LazyPiece:
+    """Lazy unpack view a zero-copy fused op's future resolves with;
+    :meth:`OpFuture.result` materializes (and caches) the real array
+    on first access, so the slice/reshape/cast runs in the consumer's
+    program instead of the executor's drain path."""
+
+    __slots__ = ("_group", "_index", "_postscale")
+
+    def __init__(self, group: _GroupUnpack, index: int, postscale: float):
+        self._group = group
+        self._index = index
+        self._postscale = postscale
+
+    def materialize(self):
+        out = self._group.piece(self._index)
+        if self._postscale != 1.0:
+            out = _apply_scale(out, self._postscale)
+        return out
 
 
 class OpFuture:
@@ -166,7 +235,11 @@ class OpFuture:
             )
         if self._error is not None:
             raise self._error
-        return self._result
+        r = self._result
+        if type(r) is _LazyPiece:
+            r = r.materialize()
+            self._result = r
+        return r
 
 
 # --------------------------------------------------------------------------
@@ -478,10 +551,28 @@ class KVTransport:
 # controller
 # --------------------------------------------------------------------------
 
+class _PackSlot:
+    """One op's learned place in a fused group: pack payload bytes for
+    ``name`` at index ``index`` of the exchange buffer for ``gkey`` =
+    (psid, agreed tensor-name order).  Learned by
+    ``_maybe_learn_pack_plan`` from an executed fused group, consulted
+    by ``_maybe_prepack`` on the enqueue path."""
+
+    __slots__ = ("gkey", "index", "spec", "rop", "psid")
+
+    def __init__(self, gkey, index, spec, rop, psid):
+        self.gkey = gkey
+        self.index = index
+        self.spec = spec
+        self.rop = rop
+        self.psid = psid
+
+
 class _Payload:
     __slots__ = ("seq", "name", "future", "tensor", "rop", "prescale",
                  "postscale", "compressor", "splits", "kind",
-                 "process_set", "psid", "root_rank", "t_enqueue")
+                 "process_set", "psid", "root_rank", "t_enqueue",
+                 "prepacked")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -634,6 +725,22 @@ class EagerController:
         # hold a drain for a burst whose boundary it could not learn
         # yet, consumed by the drain that covers it.
         self._burst_hint = 0  # hvtpulint: guarded-by(_lock)
+        # Zero-copy fusion-buffer plane (docs/design.md "Zero-copy
+        # fusion buffers"): once a steady predicted schedule has shown
+        # the fused groupings, _maybe_learn_pack_plan records each
+        # op's (group, index, spec) slot so enqueue packs payload bytes
+        # straight into a pooled exchange buffer — no drain-time
+        # concatenate.  "0" disables enqueue-time packing (the staged
+        # copy path still runs, it is the always-correct fallback).
+        self._zero_copy_on = (
+            os.environ.get("HVTPU_FUSION_ZERO_COPY", "1") != "0")
+        self._fusion_pool = comm_packing.FusionBufferPool()
+        # name -> _PackSlot learned from executed fused groups.
+        self._pack_plan: Optional[Dict[str, "_PackSlot"]] = None  # hvtpulint: guarded-by(_lock)
+        # gkey -> byte-spec list for pool acquisition.
+        self._pack_group_specs: Dict[tuple, list] = {}  # hvtpulint: guarded-by(_lock)
+        # gkey -> partially/fully filled ExchangeBuffer awaiting drain.
+        self._open_packs: Dict[tuple, "comm_packing.ExchangeBuffer"] = {}  # hvtpulint: guarded-by(_lock)
 
     # ---- lifecycle ----
     def start(self):
@@ -689,6 +796,12 @@ class EagerController:
             with self._lock:
                 busy = bool(self._payloads) or self._undrained != 0
                 unconfirmed = bool(self._predicted)
+                if not busy and not unconfirmed:
+                    # Idle: return enqueue-time-packed exchange buffers
+                    # to the pool so the emergency commit never
+                    # snapshots around half-filled packs (the pack plan
+                    # itself survives — it is re-filled next burst).
+                    self._release_open_packs()
             if not busy and not unconfirmed:
                 return True
             if clock.monotonic() >= deadline:
@@ -881,6 +994,12 @@ class EagerController:
             self._undrained += 1
             self._pending_buf.append(name)
             self._last_enqueue_t = clock.monotonic()
+            # Zero-copy plane: when a learned pack plan covers this op,
+            # copy its bytes into the pooled exchange buffer NOW — the
+            # group's entire MemcpyInFusionBuffer happens at enqueue
+            # time.  Plan-less (non-steady) calls return immediately
+            # (the < 5µs guard in tests/test_eager_controller.py).
+            self._maybe_prepack(payload)
             if self._timeline is not None:
                 # Parity: timeline.cc NEGOTIATE_<OP> span from enqueue
                 # until the agreed response arrives (execution phases
@@ -1053,6 +1172,9 @@ class EagerController:
             self._observe.clear()
             self._verified_bits.clear()
             self._mispredict_names.clear()
+            self._release_open_packs()
+            self._pack_plan = None
+            self._pack_group_specs.clear()
         for p in payloads:
             p.future.set_error(HorovodInternalError(str(e)))
         self._stop.set()
@@ -1077,6 +1199,81 @@ class EagerController:
         for rec in self._predicted:
             self._mispredict_names.update(rec["names"])
         self._predicted.clear()
+        # The pack plan was learned FROM the schedule being forgotten:
+        # drop it (and return any half-filled exchange buffers) so
+        # enqueue-time packing stops until a steady schedule re-proves
+        # the groupings.  Already-prepacked payloads simply mismatch
+        # their (cleared) open pack at drain and take the staged path.
+        self._release_open_packs()
+        self._pack_plan = None
+        self._pack_group_specs.clear()
+
+    def _release_open_packs(self):  # hvtpulint: requires(_lock)
+        """Return every open (partially filled) exchange buffer to the
+        pool — quiesce/reset/teardown hygiene for the zero-copy
+        plane."""
+        for (psid, _names), xb in self._open_packs.items():
+            self._fusion_pool.release(psid, xb)
+        self._open_packs.clear()
+
+    def _maybe_prepack(self, p: _Payload):  # hvtpulint: requires(_lock)
+        """Enqueue-time MemcpyInFusionBuffer: when the learned pack
+        plan has a slot for this op, copy its bytes straight into the
+        group's pooled exchange buffer.  EVERY check degrades to a
+        silent no-op — the drain-time staged path remains the source
+        of truth (fail back to correct, never to fast).  First line is
+        the whole cost on the non-steady path (plan None)."""
+        plan = self._pack_plan
+        if plan is None:
+            return
+        slot = plan.get(p.name)
+        if slot is None:
+            return
+        if (p.kind != "allreduce" or p.compressor is not NoneCompressor
+                or p.prescale != 1.0 or p.rop != slot.rop
+                or p.psid != slot.psid):
+            return
+        arr = np.asarray(p.tensor)
+        shape, dtype, _nbytes = slot.spec
+        if tuple(arr.shape) != shape or arr.dtype != dtype:
+            return
+        pack = self._open_packs.get(slot.gkey)
+        if pack is None:
+            specs = self._pack_group_specs.get(slot.gkey)
+            if specs is None:
+                return
+            pack = self._fusion_pool.acquire(slot.psid, specs)
+            self._open_packs[slot.gkey] = pack
+        if pack.write(slot.index, arr):
+            p.prepacked = slot.gkey
+
+    def _maybe_learn_pack_plan(self, rs, payloads):
+        """Record the fused grouping an executed (staged-path) group
+        proves, so the NEXT burst's enqueues can pack at enqueue time.
+        Only steady predicted schedules qualify (``_burst_stable`` —
+        the same stability bar as ``_try_predict``), and only plain
+        groups (no compression, no prescale, uniform wire dtype):
+        everything else keeps the staged path forever."""
+        if not self._zero_copy_on or self._burst_stable < 2:
+            return
+        if any(p.compressor is not NoneCompressor or p.prescale != 1.0
+               or p.seq == -1 for p in payloads):
+            return
+        dtype = payloads[0].tensor.dtype
+        if any(p.tensor.dtype != dtype for p in payloads):
+            return
+        psid = payloads[0].psid
+        gkey = (psid, tuple(rs.tensor_names))
+        specs = [(tuple(p.tensor.shape), np.dtype(p.tensor.dtype),
+                  int(p.tensor.nbytes)) for p in payloads]
+        with self._lock:
+            if self._pack_plan is None:
+                self._pack_plan = {}
+            for i, p in enumerate(payloads):
+                self._pack_plan[p.name] = _PackSlot(
+                    gkey=gkey, index=i, spec=specs[i], rop=p.rop,
+                    psid=psid)
+            self._pack_group_specs[gkey] = specs
 
     def _on_mispredict(self, why: str):  # hvtpulint: requires(_lock)
         """A predicted-and-executed schedule the coordinator did NOT
@@ -1964,10 +2161,14 @@ class EagerController:
                 continue
             payloads = self._take_payloads(rs)
             now = clock.monotonic()
-            for p in payloads:
-                if p.seq != -1:  # not a synthetic zero payload
-                    _M_NEGOTIATION_S.observe(now - p.t_enqueue)
-                    if self._timeline is not None:
+            # Batched bookkeeping: ONE histogram lock acquisition for
+            # the whole fused group instead of a per-op round trip.
+            waits = [now - p.t_enqueue for p in payloads if p.seq != -1]
+            if waits:
+                _M_NEGOTIATION_S.observe_many(waits)
+            if self._timeline is not None:
+                for p in payloads:
+                    if p.seq != -1:  # not a synthetic zero payload
                         self._timeline.end(p.name)
             try:
                 self._execute_one(rs, payloads)
@@ -2064,26 +2265,59 @@ class EagerController:
                 )
                 p.future.set_result(out)
                 if tracing.ACTIVE:
-                    tracing.op_done(p.name, bytes=int(p.tensor.nbytes))
+                    # Wire bytes — what the collective actually moved
+                    # (post-compression), not the host tensor's size.
+                    wdt = jnp.dtype(
+                        p.compressor.wire_dtype(p.tensor.dtype))
+                    tracing.op_done(
+                        p.name,
+                        bytes=int(p.tensor.size * wdt.itemsize))
             return
-        # Fused execution: per-tensor prescale & wire-compression commute
-        # with elementwise reduction, so apply them per tensor around ONE
-        # flat collective (parity: MemcpyInFusionBuffer -> single
+        # Fused execution.  Zero-copy fast path first: when EVERY
+        # payload of this group was packed at enqueue time into one
+        # complete exchange buffer (the learned plan matched the agreed
+        # grouping), the wire tensor is a typed view of that buffer —
+        # no drain-time concatenate, and futures resolve with lazy
+        # unpack views.  Anything less falls back to the staged copy
+        # path below, counted by the metric pair so profiles prove
+        # which path ran.
+        gkey = (payloads[0].psid, tuple(rs.tensor_names))
+        with self._lock:
+            pack = self._open_packs.pop(gkey, None)
+        zero_copy = (
+            pack is not None
+            and pack.complete()
+            and len(payloads) == len(pack.specs)
+            and all(getattr(p, "prepacked", None) == gkey
+                    for p in payloads)
+        )
+        if pack is not None and not zero_copy:
+            # Stale/partial pack (mispredicted grouping, a payload that
+            # failed its slot checks): return it and stage.
+            self._fusion_pool.release(payloads[0].psid, pack)
+            pack = None
+        if zero_copy:
+            self._execute_allreduce_zero_copy(rs, payloads, pack, rop)
+            return
+        # Staged path: per-tensor prescale & wire-compression commute
+        # with elementwise reduction, so apply them per tensor around
+        # ONE flat collective (parity: MemcpyInFusionBuffer -> single
         # ncclAllReduce -> MemcpyOutFusionBuffer).
+        if tracing.ACTIVE:
+            tracing.op_phase_many([p.name for p in payloads],
+                                  tracing.FUSE)
         wires, ctxs = [], []
         for p in payloads:
-            if tracing.ACTIVE:
-                tracing.op_phase(p.name, tracing.FUSE)
             t = p.tensor
             if p.prescale != 1.0:
                 t = _apply_scale(t, p.prescale)
             t, ctx = p.compressor.compress(t)
             wires.append(t)
             ctxs.append(ctx)
-        flat, _ = pack_flat(wires)
+        flat, specs = pack_flat(wires)
         if tracing.ACTIVE:
-            for p in payloads:
-                tracing.op_phase(p.name, tracing.EXEC)
+            tracing.op_phase_many([p.name for p in payloads],
+                                  tracing.EXEC)
         # The fuser only merges responses with equal process_set_id
         # (fallback._fuse / Controller::FuseResponses), so the group's
         # shared set is payloads[0]'s.
@@ -2091,12 +2325,48 @@ class EagerController:
             flat, op=rop, name=f"fused.{rs.tensor_names[0]}.{len(payloads)}",
             process_set=payloads[0].process_set,
         )
-        specs = [(tuple(w.shape), w.dtype, int(w.size)) for w in wires]
-        for p, ctx, piece in zip(payloads, ctxs, unpack_flat(red, specs)):
+        _M_FUSION_STAGED.inc(len(payloads))
+        done_items = []
+        for p, ctx, spec, piece in zip(payloads, ctxs, specs,
+                                       unpack_flat(red, specs)):
             out = p.compressor.decompress(piece, ctx)
             if p.postscale != 1.0:
                 out = _apply_scale(out, p.postscale)
             p.future.set_result(out)
-            if tracing.ACTIVE:
-                tracing.op_done(p.name, bytes=int(p.tensor.nbytes),
-                                fused=len(payloads))
+            # Wire bytes: this op's share of the flat buffer in the
+            # promoted wire dtype (post-compression).
+            done_items.append(
+                (p.name,
+                 {"bytes": int(spec[2] * flat.dtype.itemsize)}))
+        if tracing.ACTIVE:
+            tracing.op_done_many(done_items, fused=len(payloads),
+                                 zero_copy=False)
+        self._maybe_learn_pack_plan(rs, payloads)
+
+    def _execute_allreduce_zero_copy(self, rs, payloads, pack, rop):
+        """Fused allreduce over an enqueue-time-packed exchange buffer:
+        the wire tensor is a typed view of the pooled host buffer (no
+        concatenate), futures resolve with :class:`_LazyPiece` views
+        (unpack deferred into the consumer), and all per-op
+        bookkeeping is batched — one tracing flush, one metrics
+        update."""
+        names = [p.name for p in payloads]
+        if tracing.ACTIVE:
+            tracing.op_phase_many(names, tracing.EXEC)
+        flat = jnp.asarray(pack.typed_view())
+        red = eager_comm.allreduce(
+            flat, op=rop,
+            name=f"fused.{rs.tensor_names[0]}.{len(payloads)}",
+            process_set=payloads[0].process_set,
+        )
+        group = _GroupUnpack(red, pack.element_specs(), pack,
+                             self._fusion_pool, payloads[0].psid)
+        done_items = []
+        for i, p in enumerate(payloads):
+            p.future.set_result(_LazyPiece(group, i, p.postscale))
+            done_items.append(
+                (p.name, {"bytes": int(pack.specs[i][2])}))
+        _M_FUSION_ZC.inc(len(payloads))
+        if tracing.ACTIVE:
+            tracing.op_done_many(done_items, fused=len(payloads),
+                                 zero_copy=True)

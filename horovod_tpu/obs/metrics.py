@@ -172,6 +172,25 @@ class Histogram(_Family):
             cell[1] += float(value)
             cell[2] += 1
 
+    def observe_many(self, values, **labels):
+        """Record a batch of observations under ONE lock acquisition —
+        the per-fused-group bookkeeping path of the eager controller
+        (one metrics update per group instead of per op)."""
+        values = [float(v) for v in values]
+        if not values:
+            return
+        key = _labelstr(labels)
+        idxs = [bisect.bisect_left(self.buckets, v) for v in values]
+        with self._lock:
+            cell = self._values.get(key)
+            if cell is None:
+                cell = self._values[key] = [
+                    [0] * (len(self.buckets) + 1), 0.0, 0]
+            for i in idxs:
+                cell[0][i] += 1
+            cell[1] += sum(values)
+            cell[2] += len(values)
+
     def value(self, **labels):
         with self._lock:
             cell = self._values.get(_labelstr(labels))

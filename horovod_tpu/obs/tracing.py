@@ -139,6 +139,30 @@ class Tracer:
             self._tl.end(name)
             self._tl.instant(DONE, tensor=name, trace_id=trace_id, **args)
 
+    # Batched variants: one lock acquisition (and one Timeline flush
+    # window) for a whole fused group instead of per-op round trips —
+    # the bookkeeping half of the zero-copy fusion-buffer plane.
+
+    def op_phase_many(self, names, phase: str, **args):
+        with self._lock:
+            for name in names:
+                trace_id = self._live.get(name)
+                if trace_id is None:
+                    continue
+                self._tl.begin(name, phase, trace_id=trace_id, **args)
+
+    def op_done_many(self, items, **shared):
+        """``items``: iterable of ``(name, per-op-args dict)``;
+        ``shared`` kwargs ride on every DONE instant."""
+        with self._lock:
+            for name, args in items:
+                trace_id = self._live.pop(name, None)
+                if trace_id is None:
+                    continue
+                self._tl.end(name)
+                self._tl.instant(DONE, tensor=name, trace_id=trace_id,
+                                 **shared, **args)
+
     def instant(self, name: str, **args):
         self._tl.instant(name, **args)
 
@@ -240,6 +264,18 @@ def op_done(name: str, **args):
     t = _tracer
     if t is not None:
         t.op_done(name, **args)
+
+
+def op_phase_many(names, phase: str, **args):
+    t = _tracer
+    if t is not None:
+        t.op_phase_many(names, phase, **args)
+
+
+def op_done_many(items, **shared):
+    t = _tracer
+    if t is not None:
+        t.op_done_many(items, **shared)
 
 
 def instant(name: str, **args):

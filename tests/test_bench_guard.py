@@ -224,7 +224,9 @@ class TestPredictSchema:
     schedule-prediction columns (predicted_fraction, mispredicts,
     mispredict_rate), and the recorded steady-state rows prove the
     default-on fast path actually engaged — predicted_fraction above
-    0.8 with zero unrecovered mispredicts."""
+    0.8 with zero unrecovered mispredicts.  Round 8 adds
+    zero_copy_fraction (fused ops riding the enqueue-time-packed
+    exchange buffer) and requires it to be 1.0 on steady np=4 rows."""
 
     @pytest.fixture
     def bench_eager(self):
@@ -235,22 +237,36 @@ class TestPredictSchema:
         return importlib.reload(mod)
 
     def test_stats_builder_schema(self, bench_eager):
-        before = {"cycles": 10, "predicted": 2, "mispredicts": 0}
-        after = {"cycles": 74, "predicted": 58, "mispredicts": 1}
+        before = {"cycles": 10, "predicted": 2, "mispredicts": 0,
+                  "zero_copy": 4, "staged": 8}
+        after = {"cycles": 74, "predicted": 58, "mispredicts": 1,
+                 "zero_copy": 52, "staged": 24}
         stats = bench_eager.build_predict_stats(before, after)
         assert set(stats) == set(bench_eager.PREDICT_ROW_KEYS)
         assert stats["predicted_fraction"] == pytest.approx(56 / 64)
         assert stats["mispredicts"] == 1
         assert stats["mispredict_rate"] == pytest.approx(
             1 / 64, abs=1e-4)
+        assert stats["zero_copy_fraction"] == pytest.approx(48 / 64)
         json.dumps(stats)
 
+    def test_stats_builder_accepts_round7_snapshots(self, bench_eager):
+        """Three-key snapshots (pre-round-8 recordings) still build:
+        the fusion-path keys default to 0 -> null fraction."""
+        before = {"cycles": 10, "predicted": 2, "mispredicts": 0}
+        after = {"cycles": 74, "predicted": 58, "mispredicts": 1}
+        stats = bench_eager.build_predict_stats(before, after)
+        assert set(stats) == set(bench_eager.PREDICT_ROW_KEYS)
+        assert stats["zero_copy_fraction"] is None
+
     def test_zero_cycle_window_is_null_not_crash(self, bench_eager):
-        snap = {"cycles": 5, "predicted": 1, "mispredicts": 0}
+        snap = {"cycles": 5, "predicted": 1, "mispredicts": 0,
+                "zero_copy": 0, "staged": 0}
         stats = bench_eager.build_predict_stats(snap, dict(snap))
         assert stats["predicted_fraction"] is None
         assert stats["mispredict_rate"] is None
         assert stats["mispredicts"] == 0
+        assert stats["zero_copy_fraction"] is None
 
     def test_recorded_steady_rows_predicted_without_mispredicts(
             self, bench_eager):
@@ -265,6 +281,8 @@ class TestPredictSchema:
                 assert key in row, (row["mode"], row["nbytes"], key)
             assert row["predicted_fraction"] > 0.8, row
             assert row["mispredicts"] == 0, row
+            # round 8: the whole timed window rode the zero-copy path
+            assert row["zero_copy_fraction"] == 1.0, row
         # the torch e2e step row rides the same schema
         for key in bench_eager.PREDICT_ROW_KEYS:
             assert key in data["torch_step"], key

@@ -646,3 +646,199 @@ class TestPredictedSchedules:
             assert ctrl._burst_cap_on is True
         finally:
             ctrl.stop()
+
+
+# --------------------------------------------------------------------------
+# zero-copy fusion buffers: the fallback lattice
+# ({predicted, mispredicted} x {lockstep, streamed}), pool hygiene on
+# quiesce, and the non-steady enqueue overhead guard.  Packing-level
+# contracts live in tests/test_fusion_buffers.py.
+# --------------------------------------------------------------------------
+
+def _fusion_counters():
+    from horovod_tpu.obs import metrics as obs_metrics
+
+    return (obs_metrics.counter("hvtpu_fusion_zero_copy_ops_total"),
+            obs_metrics.counter("hvtpu_fusion_staged_copies_total"))
+
+
+class TestZeroCopyFusion:
+    def _steady_manual(self, ctrl, steps, start=0, names=2):
+        """Lockstep analog of TestPredictedSchedules._run_steady: the
+        same 2-op burst each cycle, driven by run_cycle_once."""
+        for step in range(start, start + steps):
+            futs = [ctrl.enqueue("allreduce",
+                                 jnp.full((4,), float(step)),
+                                 name=f"zc/{i}")
+                    for i in range(names)]
+            ctrl.run_cycle_once()
+            for f in futs:
+                np.testing.assert_allclose(
+                    np.asarray(f.result(timeout=10)), float(step))
+
+    def test_predicted_lockstep_packs_at_enqueue(self, hvt):
+        """Cell 1: steady lockstep bursts learn a pack plan from the
+        staged path, then every later burst rides the zero-copy path
+        (enqueue-time pack, typed-view wire tensor, lazy unpack)."""
+        zc, st = _fusion_counters()
+        ctrl = EagerController(0, 1, manual=True)
+        try:
+            b_zc, b_st = zc.value(), st.value()
+            self._steady_manual(ctrl, steps=4)
+            # warmup bursts staged (stability bar + plan learning)...
+            assert st.value() - b_st >= 2
+            assert ctrl._pack_plan is not None
+            assert set(ctrl._pack_plan) == {"zc/0", "zc/1"}
+            mid = zc.value()
+            self._steady_manual(ctrl, steps=3, start=4)
+            # ...then EVERY op of every burst is zero-copy
+            assert zc.value() - mid == 3 * 2
+            # drained packs went back to the pool, none left open
+            assert not ctrl._open_packs
+            assert ctrl._fusion_pool.stats()["pooled"] >= 1
+        finally:
+            ctrl.stop()
+
+    def test_mispredicted_lockstep_falls_back_staged(self, hvt):
+        """Cell 2: a mispredict between enqueue (payloads already
+        packed) and drain releases the open packs, drops the plan, and
+        the drain takes the staged path — correct results, staged
+        counter increment, resync forced."""
+        zc, st = _fusion_counters()
+        ctrl = EagerController(0, 1, manual=True)
+        try:
+            self._steady_manual(ctrl, steps=4)
+            assert ctrl._pack_plan is not None
+            futs = [ctrl.enqueue("allreduce", jnp.full((4,), 9.0),
+                                 name=f"zc/{i}") for i in range(2)]
+            assert ctrl._open_packs  # enqueue-time pack happened
+            b_zc, b_st = zc.value(), st.value()
+            with ctrl._lock:
+                ctrl._on_mispredict("test-injected disagreement")
+            # rollback released the packed-but-undrained buffers and
+            # forgot the plan: fail back to correct, never to fast
+            assert not ctrl._open_packs
+            assert ctrl._pack_plan is None
+            ctrl.run_cycle_once()
+            for f in futs:
+                np.testing.assert_allclose(
+                    np.asarray(f.result(timeout=10)), 9.0)
+            assert st.value() - b_st == 2
+            assert zc.value() == b_zc
+        finally:
+            ctrl.stop()
+
+    def test_stale_grouping_releases_pack_and_stages(self, hvt):
+        """A burst whose agreed grouping no longer matches the learned
+        plan (extra op joins the fusion group) must not ride a
+        partial pack: staged path, correct results."""
+        zc, st = _fusion_counters()
+        ctrl = EagerController(0, 1, manual=True)
+        try:
+            self._steady_manual(ctrl, steps=4)
+            assert ctrl._pack_plan is not None
+            b_zc, b_st = zc.value(), st.value()
+            futs = [ctrl.enqueue("allreduce", jnp.full((4,), 5.0),
+                                 name=f"zc/{i}") for i in range(2)]
+            futs.append(ctrl.enqueue("allreduce", jnp.full((4,), 5.0),
+                                     name="zc/extra"))
+            ctrl.run_cycle_once()
+            for f in futs:
+                np.testing.assert_allclose(
+                    np.asarray(f.result(timeout=10)), 5.0)
+            assert st.value() - b_st == 3  # whole group staged
+            assert zc.value() == b_zc
+            # the stranded 2-name pack is reclaimed by quiesce
+            assert ctrl.quiesce(timeout=5) is True
+            assert not ctrl._open_packs
+        finally:
+            ctrl.stop()
+
+    def test_predicted_streamed_goes_zero_copy(self, hvt):
+        """Cell 3: the streamed plane's steady predicted schedule
+        drives the same enqueue-time pack — zero-copy ops accumulate,
+        zero mispredicts, results exact."""
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        zc, st = _fusion_counters()
+        misp = obs_metrics.counter("hvtpu_controller_mispredicts_total")
+        ctrls = make_world(2)
+        try:
+            b_zc, b_m = zc.value(), misp.value()
+            TestPredictedSchedules._run_steady(self, ctrls, steps=30)
+            assert zc.value() - b_zc > 0
+            assert misp.value() == b_m
+            for c in ctrls:
+                assert c._pack_plan is not None
+                assert c.quiesce(timeout=10) is True
+                assert not c._open_packs
+        finally:
+            stop_world(ctrls)
+
+    def test_mispredicted_streamed_re_anchors_and_recovers(self, hvt):
+        """Cell 4: a streamed mispredict re-anchors through resync —
+        the plan drops, later bursts stage (counter increment), results
+        stay exact, and a re-proven schedule resumes zero-copy."""
+        zc, st = _fusion_counters()
+        ctrls = make_world(2)
+        try:
+            TestPredictedSchedules._run_steady(self, ctrls, steps=30)
+            assert zc.value() > 0
+            b_st = st.value()
+            with ctrls[0]._lock:
+                ctrls[0]._on_mispredict("test-injected disagreement")
+            assert ctrls[0]._pack_plan is None
+            TestPredictedSchedules._run_steady(self, ctrls, steps=10,
+                                               start=30)
+            assert st.value() - b_st > 0  # post-mispredict bursts staged
+            mid_zc = zc.value()
+            TestPredictedSchedules._run_steady(self, ctrls, steps=25,
+                                               start=40)
+            assert zc.value() > mid_zc  # schedule re-proven, fast again
+            for c in ctrls:
+                assert c._thread_error is None
+                assert c.quiesce(timeout=10) is True
+        finally:
+            stop_world(ctrls)
+
+    def test_quiesce_returns_pooled_buffers_before_commit(self, hvt):
+        """Preempt-drain hygiene: quiesce() returns open exchange
+        buffers to the pool before reporting idle, so the emergency
+        commit never snapshots around a dangling pack."""
+        ctrl = EagerController(0, 1, manual=True)
+        try:
+            specs = [((4,), np.dtype(np.float32), 16)]
+            with ctrl._lock:
+                ctrl._open_packs[(0, ("qa", "qb"))] = (
+                    ctrl._fusion_pool.acquire(0, specs))
+            assert ctrl._fusion_pool.stats()["pooled"] == 0
+            assert ctrl.quiesce(timeout=5) is True
+            assert not ctrl._open_packs
+            assert ctrl._fusion_pool.stats()["pooled"] == 1
+        finally:
+            ctrl.stop()
+
+    def test_nonsteady_enqueue_prepack_is_under_5us(self, hvt):
+        """Acceptance: with no pack plan (the non-steady state every
+        rank starts in), the enqueue-path hook is one None check —
+        same budget discipline as the flight recorder's disabled-path
+        guard."""
+        import timeit
+
+        from horovod_tpu.comm.compression import NoneCompressor
+        from horovod_tpu.eager.controller import _Payload
+
+        ctrl = EagerController(0, 1, manual=True)
+        try:
+            assert ctrl._pack_plan is None
+            p = _Payload(
+                seq=1, name="t/0", future=None, tensor=jnp.ones(4),
+                rop=ReduceOp.SUM, prescale=1.0, postscale=1.0,
+                compressor=NoneCompressor, splits=None,
+                kind="allreduce", process_set=None, psid=0,
+                root_rank=-1, t_enqueue=0.0)
+            n = 100_000
+            t = timeit.timeit(lambda: ctrl._maybe_prepack(p), number=n)
+            assert t / n < 5e-6, f"prepack hook: {t / n * 1e9:.0f} ns/op"
+        finally:
+            ctrl.stop()

@@ -1,0 +1,188 @@
+"""Zero-copy fusion-buffer plane: packing-level contracts.
+
+The controller-integration side (enqueue-time packing, the
+{predicted, mispredicted} x {lockstep, streamed} fallback matrix,
+quiesce hygiene, the non-steady enqueue overhead guard) lives in
+tests/test_eager_controller.py; this file pins the pure
+comm/packing.py pieces those paths are built from:
+
+- aligned offset assignment (the satellite fixing unpack_bytes'
+  silent unaligned-fallback copy),
+- ExchangeBuffer write/complete/view semantics,
+- FusionBufferPool reuse + LRU eviction bounds,
+- the cached group-unpack program and its mispredict invalidation.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.comm import packing
+
+
+MIXED = [((3,), np.dtype(np.float64), 24),
+         ((5,), np.dtype(np.float32), 20),
+         ((7,), np.dtype(np.int16), 14),
+         ((2, 2), np.dtype(np.float64), 32)]
+
+
+class TestAssignOffsets:
+    def test_uniform_dtype_layout_is_contiguous(self):
+        specs = [((4,), np.dtype(np.float32), 16),
+                 ((2, 3), np.dtype(np.float32), 24)]
+        offsets, total = packing.assign_offsets(specs)
+        assert offsets == [0, 16]
+        assert total == 40
+
+    def test_mixed_dtype_offsets_are_dtype_aligned(self):
+        offsets, total = packing.assign_offsets(MIXED)
+        align = 8  # group max itemsize (float64)
+        for off, (_s, dt, _n) in zip(offsets, MIXED):
+            assert off % align == 0
+            assert off % dt.itemsize == 0
+        # padding only where needed: int16 block (14 bytes) pads the
+        # following float64 up to the 8-byte boundary
+        assert offsets == [0, 24, 48, 64]
+        assert total == 96
+
+    def test_explicit_align_override(self):
+        offsets, total = packing.assign_offsets(
+            [((3,), np.dtype(np.int8), 3), ((3,), np.dtype(np.int8), 3)],
+            align=64)
+        assert offsets == [0, 64]
+        assert total == 128
+
+
+class TestExchangeBuffer:
+    def test_mixed_dtype_unpack_is_views_not_copies(self):
+        """Satellite regression: the aligned layout keeps EVERY piece
+        on unpack_bytes' view path — np.shares_memory with the backing
+        buffer, no silent tobytes() copy for any dtype in the mix."""
+        xb = packing.ExchangeBuffer(MIXED)
+        for i, (shape, dt, _n) in enumerate(xb.specs):
+            assert xb.write(i, np.arange(int(np.prod(shape)),
+                                         dtype=dt).reshape(shape))
+        assert xb.complete()
+        views = xb.views()
+        for i, (v, (shape, dt, _n)) in enumerate(zip(views, xb.specs)):
+            assert v.shape == shape and v.dtype == dt
+            assert np.shares_memory(v, xb.buf), f"piece {i} was copied"
+            assert v.flags["ALIGNED"], f"piece {i} view is unaligned"
+            np.testing.assert_array_equal(
+                v, np.arange(int(np.prod(shape)), dtype=dt).reshape(shape))
+
+    def test_contiguous_layout_of_same_specs_is_unaligned(self):
+        """Contrast case proving what the aligned layout buys: the
+        CONTIGUOUS layout of the same mix leaves the float64 after the
+        int16 run on an odd offset — numpy hands back an ALIGNED=False
+        view, which every downstream consumer (jnp.asarray, BLAS)
+        silently copies before use."""
+        specs = [((7,), np.dtype(np.int16), 14),
+                 ((2,), np.dtype(np.float64), 16)]
+        buf = np.zeros(30, np.uint8)
+        pieces = packing.unpack_bytes(buf, specs)
+        assert not pieces[1].flags["ALIGNED"]
+
+    def test_write_rejects_mismatch_and_double_fill(self):
+        xb = packing.ExchangeBuffer([((4,), np.dtype(np.float32), 16)])
+        assert not xb.write(0, np.zeros(4, np.float64))  # dtype
+        assert not xb.write(0, np.zeros(8, np.float32))  # nbytes
+        assert not xb.complete()
+        assert xb.write(0, np.ones(4, np.float32))
+        assert not xb.write(0, np.ones(4, np.float32))   # stale plan
+        assert xb.complete()
+        xb.reset()
+        assert not xb.complete()
+        assert xb.write(0, np.ones(4, np.float32))
+
+    def test_typed_view_requires_uniform_dtype(self):
+        xb = packing.ExchangeBuffer(MIXED)
+        with pytest.raises(ValueError):
+            xb.typed_view()
+        uni = packing.ExchangeBuffer(
+            [((2,), np.dtype(np.float32), 8),
+             ((3,), np.dtype(np.float32), 12)])
+        uni.write(0, np.array([1, 2], np.float32))
+        uni.write(1, np.array([3, 4, 5], np.float32))
+        flat = uni.typed_view()
+        assert flat.dtype == np.float32
+        assert np.shares_memory(flat, uni.buf)
+        np.testing.assert_array_equal(flat, [1, 2, 3, 4, 5])
+
+
+class TestFusionBufferPool:
+    SPECS = [((4,), np.dtype(np.float32), 16)]
+
+    def test_release_then_acquire_reuses_the_buffer(self):
+        pool = packing.FusionBufferPool(capacity=4)
+        xb = pool.acquire(0, self.SPECS)
+        xb.write(0, np.ones(4, np.float32))
+        pool.release(0, xb)
+        assert pool.stats()["pooled"] == 1
+        again = pool.acquire(0, self.SPECS)
+        assert again is xb
+        assert not again.complete()  # release reset the fill set
+        assert pool.stats()["pooled"] == 0
+
+    def test_keying_isolates_process_sets_and_layouts(self):
+        pool = packing.FusionBufferPool(capacity=4)
+        xb = pool.acquire(0, self.SPECS)
+        pool.release(0, xb)
+        assert pool.acquire(1, self.SPECS) is not xb  # other set
+        other = [((8,), np.dtype(np.float32), 32)]
+        assert pool.acquire(0, other) is not xb       # other layout
+        assert pool.acquire(0, self.SPECS) is xb
+
+    def test_lru_eviction_bounds_the_pool(self):
+        pool = packing.FusionBufferPool(capacity=2)
+        layouts = [[((n,), np.dtype(np.float32), 4 * n)]
+                   for n in (2, 3, 4)]
+        bufs = [pool.acquire(0, sp) for sp in layouts]
+        for sp, xb in zip(layouts, bufs):
+            pool.release(0, xb)
+        st = pool.stats()
+        assert st["pooled"] == 2 and st["capacity"] == 2
+        # the oldest layout was evicted; the two youngest survive
+        assert pool.acquire(0, layouts[0]) is not bufs[0]
+        assert pool.acquire(0, layouts[1]) is bufs[1]
+        assert pool.acquire(0, layouts[2]) is bufs[2]
+
+    def test_env_knob_and_clear(self, monkeypatch):
+        monkeypatch.setenv(packing.POOL_KNOB, "3")
+        pool = packing.FusionBufferPool()
+        assert pool.capacity == 3
+        pool.release(0, packing.ExchangeBuffer(self.SPECS))
+        pool.clear()
+        assert pool.stats() == {"pooled": 0, "capacity": 3, "layouts": 0}
+
+
+class TestGroupUnpackProgram:
+    def test_unpacks_like_unpack_flat(self):
+        specs = [((2, 2), jnp.float32, 4), ((3,), jnp.float32, 3)]
+        flat = jnp.arange(7.0, dtype=jnp.float32)
+        fn = packing.group_unpack_program(specs)
+        got = fn(flat)
+        want = packing.unpack_flat(flat, specs)
+        assert len(got) == 2
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    def test_program_is_cached_per_spec_key(self):
+        specs = [((5,), jnp.float32, 5)]
+        assert (packing.group_unpack_program(specs)
+                is packing.group_unpack_program(list(specs)))
+        packing.clear_unpack_cache()
+        # cache dropped: a fresh jitted program is built
+        info = packing._unpack_program.cache_info()
+        assert info.currsize == 0
+
+    def test_invalidate_routing_plans_drops_unpack_cache(self):
+        """Mispredict invalidation rides the comm layer's plan drop:
+        the memoized unpack programs are keyed by now-suspect
+        groupings and must go with them."""
+        from horovod_tpu.comm import eager as eager_comm
+
+        packing.group_unpack_program([((2,), jnp.float32, 2)])
+        assert packing._unpack_program.cache_info().currsize > 0
+        eager_comm.invalidate_routing_plans()
+        assert packing._unpack_program.cache_info().currsize == 0
