@@ -176,6 +176,16 @@ REQUIRED_METRIC_KEYS = (
     # (mispredicts, shape churn, compression).
     "hvtpu_fusion_zero_copy_ops_total",
     "hvtpu_fusion_staged_copies_total",
+    # fleet front door (PR 19, fleet/{intake,admission,placement}.py):
+    # queue depth by tier and journal intake lag show the backlog a
+    # submission storm builds and how fast the bounded-budget intake
+    # drains it; admission rejections are 0 unless a tenant blew a
+    # quota (or a spec was malformed); fragmentation is the measured
+    # contiguity of the pool's free capacity on the host torus.
+    "hvtpu_fleet_queue_depth",
+    "hvtpu_fleet_intake_lag",
+    "hvtpu_fleet_admission_rejections_total",
+    "hvtpu_fleet_fragmentation",
 )
 
 

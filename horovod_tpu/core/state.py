@@ -427,7 +427,10 @@ def init(config: Optional[Config] = None) -> GlobalState:
                     generation=int(_os.environ.get(
                         "HVTPU_ELASTIC_GENERATION", "0") or 0),
                     out_dir=(_os.environ.get("HVTPU_FLIGHT_DIR")
-                             or cfg.trace_dir or "."),
+                             or cfg.trace_dir
+                             or _os.environ.get(
+                                 "HVTPU_ELASTIC_STATE_DIR")
+                             or "."),
                     window=_flight.env_window())
         except Exception:
             _logging.getLogger("horovod_tpu").warning(

@@ -2,18 +2,32 @@
 
 Gang scheduling (full min-world allocations only), priority preemption
 through the graceful-drain channel (planned resizes, zero lost steps,
-no restart-budget strikes), and traffic-driven autoscaling hooks.
-See docs/fleet.md.
+no restart-budget strikes), traffic-driven autoscaling hooks, and a
+production front door: indexed journal intake with backpressure
+(:mod:`.intake`), per-tenant quotas + weighted fair share + the
+starvation guard (:mod:`.admission`), and topology-aware placement on
+a virtual host torus (:mod:`.placement`).  See docs/fleet.md.
 """
 
+from .admission import (AdmissionController, TenantConfigError,
+                        TenantPolicy)
 from .arbiter import FleetArbiter
 from .autoscale import Autoscaler, FileSignal
+from .intake import QueueFullError, SubmitJournal
 from .job import (DONE, DRAINING, FAILED, FleetSpecError, Job, JobSpec,
                   PENDING, RESIZING, RUNNING, STATES, prefixed_client)
+from .placement import PlacementPolicy, TorusGrid
 from .runner import AllocationDiscovery, ElasticJobRunner
 
 __all__ = [
     "FleetArbiter",
+    "AdmissionController",
+    "TenantConfigError",
+    "TenantPolicy",
+    "SubmitJournal",
+    "QueueFullError",
+    "PlacementPolicy",
+    "TorusGrid",
     "Autoscaler",
     "FileSignal",
     "FleetSpecError",

@@ -54,10 +54,13 @@ from typing import Callable, Dict, List, Optional
 from ..core import clock
 from ..elastic.discovery import HostManager
 from ..obs import metrics as obs_metrics
+from . import admission as admission_mod
+from . import intake as intake_mod
 from . import job as job_mod
 from .autoscale import Autoscaler
 from .job import (DONE, DRAINING, FAILED, FleetSpecError, Job, JobSpec,
                   PENDING, RESIZING, RUNNING, STATES)
+from .placement import PlacementPolicy
 
 __all__ = ["FleetArbiter"]
 
@@ -95,6 +98,9 @@ _M_JOB_INCIDENTS = obs_metrics.gauge(
     "hvtpu_fleet_job_incidents",
     "Per-job total anomaly incidents from the latest fleet health "
     "summary (label: job).")
+_M_QUEUE_DEPTH = obs_metrics.gauge(
+    "hvtpu_fleet_queue_depth",
+    "PENDING jobs per priority tier (label: tier).")
 _M_JOB_STALL_AGE = obs_metrics.gauge(
     "hvtpu_fleet_job_stall_age_seconds",
     "Per-job stall age from the latest fleet health summary: seconds "
@@ -150,6 +156,14 @@ class FleetArbiter:
         self._autoscalers: Dict[str, Autoscaler] = {}  # hvtpulint: guarded-by(_lock)
         self._submit_seq = 0  # hvtpulint: guarded-by(_lock)
         self._pool_seen = False  # hvtpulint: guarded-by(_lock)
+        # front door: indexed intake + admission + placement (all
+        # touched only under _lock — see their module docstrings)
+        self._journal = (intake_mod.SubmitJournal(fleet_dir)
+                         if fleet_dir else None)
+        self._intake_budget = intake_mod.intake_budget()
+        self._admission = admission_mod.AdmissionController(fleet_dir)
+        self._placement = PlacementPolicy()
+        self._depth_tiers: set = set()  # hvtpulint: guarded-by(_lock)
         self._stop = threading.Event()
         self._registered_debug = register_debug
         if register_debug:
@@ -214,9 +228,15 @@ class FleetArbiter:
 
     # -- the scheduling pass ---------------------------------------------
     def tick(self) -> None:
-        """One full arbiter pass: spool intake → pool refresh → reap →
-        fail-fast → gang schedule (+preempt) → autoscale → publish."""
+        """One full arbiter pass: journal+spool intake → pool refresh
+        → reap → fail-fast → gang schedule (+preempt) → autoscale →
+        publish."""
         with self._lock:
+            # reload tenants BEFORE intake: queued-quota checks on the
+            # first post-(re)start tick must see the current table, or
+            # a journal backlog slips past admission un-quota'd
+            self._reload_tenants()
+            self._intake_journal()
             self._intake_spool()
             self._refresh_pool()
             self._reap()
@@ -248,20 +268,14 @@ class FleetArbiter:
                     free[h] -= n
         return {h: n for h, n in free.items() if n > 0}
 
-    @staticmethod
-    def _take(free: Dict[str, int], n: int) -> Dict[str, int]:
-        """Deterministically carve ``n`` slots out of ``free`` (hosts
-        in sorted name order)."""
-        out: Dict[str, int] = {}
-        for h in sorted(free):
-            if n <= 0:
-                break
-            got = min(free[h], n)
-            if got > 0:
-                out[h] = got
-                free[h] -= got
-                n -= got
-        return out
+    def _tenant_used(self) -> Dict[str, int]:  # hvtpulint: requires(_lock)
+        """tenant → currently allocated ranks across its live jobs
+        (PENDING jobs contribute their tenant key at 0 use)."""
+        used: Dict[str, int] = {}
+        for j in self._live_jobs():
+            t = j.spec.tenant_key
+            used[t] = used.get(t, 0) + sum(j.allocation.values())
+        return used
 
     def _reap(self) -> None:  # hvtpulint: requires(_lock)
         """Adopt every handle's view: exits, phase changes, live
@@ -334,28 +348,86 @@ class FleetArbiter:
                 self._event("job_unschedulable_fatal", job=j.name,
                             min_np=j.spec.min_np, capacity=capacity)
 
+    def _reload_tenants(self) -> None:  # hvtpulint: requires(_lock)
+        note = self._admission.maybe_reload()
+        if note == "reloaded":
+            self._event("tenants_reload")
+        elif note:
+            self._event("tenants_rejected", error=note[:300])
+
     def _schedule(self) -> None:  # hvtpulint: requires(_lock)
-        pending = sorted(
-            (j for j in self.jobs.values() if j.state == PENDING),
-            key=lambda j: (-j.spec.priority, j.submit_seq))
+        """Gang schedule the pending queue in admission order: aged
+        (starvation-guarded) jobs first, then priority tiers, same-tier
+        ties broken by the tenant FURTHEST BELOW its weighted fair
+        share, then submit order.  Quota-deferred jobs (tenant at its
+        max_ranks cap) park without blocking anyone else."""
+        now = clock.monotonic()
+        pending = [j for j in self.jobs.values() if j.state == PENDING]
+        if not pending:
+            return
+        used_by_tenant = self._tenant_used()
+        slots_total = sum(self.hosts.current.values())
+        deficits = self._admission.deficits(used_by_tenant, slots_total)
+        age_s = admission_mod.starvation_s()
+        aged = set()
+        for j in pending:
+            if age_s > 0 and now - j.submit_t >= age_s:
+                aged.add(j.name)
+                if not j.aged_reported:
+                    j.aged_reported = True
+                    self._event("job_aged", job=j.name,
+                                priority=j.spec.priority,
+                                waited_s=round(now - j.submit_t, 3))
+        order = sorted(pending, key=lambda j: (
+            j.name not in aged, -j.spec.priority,
+            -deficits.get(j.spec.tenant_key, 0.0), j.submit_seq))
+        free = self._free_map()
+        min_running_pri = min(
+            (v.spec.priority for v in self.jobs.values()
+             if v.state == RUNNING and v.handle is not None),
+            default=None)
         started: List[Job] = []
         all_placed = True
-        for j in pending:
-            free = self._free_map()
+        for j in order:
+            t = j.spec.tenant_key
+            quota_msg = self._admission.check_start(
+                t, used_by_tenant.get(t, 0), j.spec.min_np)
+            if quota_msg is not None:
+                if not j.quota_reported:
+                    j.quota_reported = True
+                    self._event("quota_wait", job=j.name, tenant=t,
+                                detail=quota_msg)
+                continue  # deferred by policy, not by capacity
             total = sum(free.values())
             if total >= j.spec.min_np:
-                alloc = self._take(free, j.spec.min_np)
+                alloc = self._placement.carve(
+                    free, j.spec.min_np, self.hosts.current)
                 self._start_job(j, alloc)
+                j.quota_reported = False
+                used_by_tenant[t] = (used_by_tenant.get(t, 0)
+                                     + sum(alloc.values()))
                 started.append(j)
             else:
                 all_placed = False
-                self._maybe_preempt(j, total)
+                boosted = j.name in aged
+                # preemption can only help when SOME running job sits
+                # below this job's (effective) tier — cheap filter so
+                # a deep queue never pays O(running) per waiter
+                if min_running_pri is not None and (
+                        boosted
+                        or min_running_pri < j.spec.priority):
+                    self._maybe_preempt(j, total, boosted=boosted)
+                elif not j.unschedulable_reported:
+                    j.unschedulable_reported = True
+                    self._event("job_waiting", job=j.name,
+                                min_np=j.spec.min_np, free=total,
+                                missing=j.spec.min_np - total)
         # start-time expansion: only when nothing is left waiting
         if all_placed:
             for j in sorted(started,
                             key=lambda j: (-j.spec.priority,
                                            j.submit_seq)):
-                self._expand_at_start(j)
+                self._expand_at_start(j, free, used_by_tenant)
         # launch AFTER expansion so each gang starts once, full-width
         for j in started:
             j.handle.start(j.allocation)
@@ -370,28 +442,43 @@ class FleetArbiter:
         if j.queue_wait_s is not None:
             _M_QUEUE_WAIT.observe(j.queue_wait_s)
 
-    def _expand_at_start(self, j: Job) -> None:  # hvtpulint: requires(_lock)
-        free = self._free_map()
+    def _expand_at_start(self, j: Job, free: Dict[str, int],
+                         used_by_tenant: Dict[str, int]
+                         ) -> None:  # hvtpulint: requires(_lock)
         total = sum(free.values())
         cur = sum(j.allocation.values())
         cap = j.spec.max_np if j.spec.max_np is not None else cur + total
+        t = j.spec.tenant_key
+        p = self._admission.policy(t)
+        if p.max_ranks is not None:
+            # the tenant's quota caps growth too (its current use
+            # already includes this job's gang)
+            cap = min(cap, cur + max(
+                0, p.max_ranks - used_by_tenant.get(t, 0)))
         extra = min(cap - cur, total)
         if extra <= 0:
             return
-        more = self._take(free, extra)
+        more = self._placement.carve(free, extra, self.hosts.current,
+                                     near=j.allocation)
         for h, n in more.items():
             j.allocation[h] = j.allocation.get(h, 0) + n
+        used_by_tenant[t] = (used_by_tenant.get(t, 0)
+                             + sum(more.values()))
 
-    def _maybe_preempt(self, j: Job, free_total: int) -> None:  # hvtpulint: requires(_lock)
+    def _maybe_preempt(self, j: Job, free_total: int, *,
+                       boosted: bool = False
+                       ) -> None:  # hvtpulint: requires(_lock)
         """Reclaim ``min_np - free`` slots from strictly-lower-priority
         RUNNING jobs, shrinking each toward its min.  Victim order:
         priority asc, then YOUNGEST first (submit_seq desc) — a unique
-        total order."""
+        total order.  A ``boosted`` (starvation-aged) job outranks
+        every tier, so its wait is bounded by the aging threshold plus
+        one drain cycle."""
         need = j.spec.min_np - free_total
         victims = sorted(
             (v for v in self.jobs.values()
              if v.state == RUNNING and v.handle is not None
-             and v.spec.priority < j.spec.priority),
+             and (boosted or v.spec.priority < j.spec.priority)),
             key=lambda v: (v.spec.priority, -v.submit_seq))
         plan = []
         for v in victims:
@@ -443,10 +530,17 @@ class FleetArbiter:
                 free = self._free_map()
                 cap = (j.spec.max_np if j.spec.max_np is not None
                        else cur + sum(free.values()))
+                pol = self._admission.policy(j.spec.tenant_key)
+                if pol.max_ranks is not None:
+                    used = self._tenant_used().get(
+                        j.spec.tenant_key, 0)
+                    cap = min(cap, cur + max(0, pol.max_ranks - used))
                 extra = min(step, cap - cur, sum(free.values()))
                 if extra <= 0:
                     continue
-                more = self._take(free, extra)
+                more = self._placement.carve(
+                    free, extra, self.hosts.current,
+                    near=j.allocation)
                 alloc = dict(j.allocation)
                 for h, n in more.items():
                     alloc[h] = alloc.get(h, 0) + n
@@ -521,11 +615,95 @@ class FleetArbiter:
                             prior_state=row.get("state"))
         return recovered
 
-    # -- spool protocol (CLI ↔ arbiter) ----------------------------------
+    # -- indexed intake (journal ↔ arbiter) ------------------------------
+    def _intake_journal(self) -> None:  # hvtpulint: requires(_lock)
+        """Apply at most ``intake_budget`` journal records in seq
+        order, then commit the cursor (crash between apply and commit
+        replays one batch; replayed submits dedupe against their live
+        job).  Cancels ordered after their submit in the journal can
+        also tombstone a record still sitting in the LEGACY spool dir,
+        so a cancelled job never surfaces as PENDING."""
+        jr = self._journal
+        if jr is None:
+            return
+        batch = jr.read_batch(self._intake_budget)
+        for rec in batch:
+            op = rec.get("op")
+            if op == "submit":
+                self._apply_journal_submit(rec)
+            elif op == "cancel":
+                name = str(rec.get("name") or "")
+                if not self._cancel_locked(name):
+                    self._tombstone_spooled(name)
+            else:
+                admission_mod.M_REJECTS.inc(reason="corrupt_record")
+                self._event("journal_corrupt",
+                            seq=int(rec.get("seq") or 0))
+        jr.commit(budget=self._intake_budget, tick_s=self.tick_s)
+
+    def _apply_journal_submit(self, rec: dict) -> None:  # hvtpulint: requires(_lock)
+        seq = int(rec.get("seq") or 0)
+        try:
+            spec = JobSpec.from_dict(rec.get("spec"))
+        except FleetSpecError as e:
+            admission_mod.M_REJECTS.inc(reason="spec_invalid")
+            self._reject(f"journal-{seq}", str(e))
+            return
+        existing = self.jobs.get(spec.name)
+        if existing is not None and not existing.terminal:
+            if existing.spec.to_dict() == spec.to_dict():
+                # replay of an already-applied record (crash between
+                # apply and cursor commit, or recover() raced it):
+                # consume silently — exactly-once at the job level
+                self._event("journal_duplicate", job=spec.name,
+                            seq=seq)
+            else:
+                admission_mod.M_REJECTS.inc(reason="duplicate_name")
+                self._reject(
+                    f"journal-{seq}",
+                    f"field 'name': job {spec.name!r} already exists "
+                    f"(state {existing.state})")
+            return
+        t = spec.tenant_key
+        queued = sum(1 for j in self.jobs.values()
+                     if j.state == PENDING
+                     and j.spec.tenant_key == t)
+        msg = self._admission.check_queued(t, queued)
+        if msg is not None:
+            admission_mod.M_REJECTS.inc(reason="tenant_queued_quota")
+            self._reject(f"journal-{seq}", msg)
+            return
+        self._submit_locked(spec)
+
+    def _tombstone_spooled(self, name: str) -> None:  # hvtpulint: requires(_lock)
+        """A cancel for a job the arbiter has never seen: consume any
+        matching legacy spool file so the job never goes PENDING."""
+        if not self.fleet_dir or not name:
+            return
+        path = os.path.join(self.fleet_dir, "submit", f"{name}.json")
+        try:
+            os.unlink(path)
+        except OSError:
+            self._event("cancel_unknown", job=name)
+            return
+        self._event("cancel_spooled", job=name)
+
+    # -- legacy spool protocol (file-per-submit CLI ↔ arbiter) -----------
     def _intake_spool(self) -> None:  # hvtpulint: requires(_lock)
         d = self.fleet_dir
         if not d:
             return
+        # cancel markers FIRST: a marker must be able to tombstone a
+        # same-tick spool file before that file becomes a PENDING job
+        can = os.path.join(d, "cancel")
+        if os.path.isdir(can):
+            for fn in sorted(os.listdir(can)):
+                if not self._cancel_locked(fn):
+                    self._tombstone_spooled(fn)
+                try:
+                    os.unlink(os.path.join(can, fn))
+                except OSError:
+                    pass
         sub = os.path.join(d, "submit")
         if os.path.isdir(sub):
             for fn in sorted(os.listdir(sub)):
@@ -555,14 +733,6 @@ class FleetArbiter:
                             self._reject(fn, str(e))
                 try:
                     os.unlink(path)
-                except OSError:
-                    pass
-        can = os.path.join(d, "cancel")
-        if os.path.isdir(can):
-            for fn in sorted(os.listdir(can)):
-                self._cancel_locked(fn)
-                try:
-                    os.unlink(os.path.join(can, fn))
                 except OSError:
                     pass
 
@@ -606,6 +776,16 @@ class FleetArbiter:
                    for n in j.allocation.values())
         _M_SLOTS_TOTAL.set(total)
         _M_SLOTS_USED.set(min(used, total) if total else used)
+        depth: Dict[int, int] = {}
+        for j in self.jobs.values():
+            if j.state == PENDING:
+                depth[j.spec.priority] = depth.get(
+                    j.spec.priority, 0) + 1
+        self._depth_tiers |= set(depth)
+        for tier in self._depth_tiers:  # zero emptied tiers, not stale
+            _M_QUEUE_DEPTH.set(depth.get(tier, 0), tier=str(tier))
+        self._placement.fragmentation(self._free_map(),
+                                      self.hosts.current)
         for j in self._live_jobs():
             h = j.health
             if h:
@@ -650,7 +830,10 @@ class FleetArbiter:
             "autoscalers": {n: a.debug_state()
                             for n, a in sorted(
                                 self._autoscalers.items())},
+            "admission": self._admission.debug_state(),
         }
+        if self._journal is not None:
+            out["intake"] = {"backlog": self._journal.depth()}
         return out
 
     def all_terminal(self) -> bool:

@@ -94,6 +94,7 @@ _NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 _SPEC_FIELDS = (
     "name", "command", "priority", "min_np", "max_np", "env",
     "max_restarts", "restart_window", "drain_grace", "autoscale",
+    "tenant",
 )
 _AUTOSCALE_FIELDS = (
     "signal_file", "high", "low", "step", "debounce_s", "cooldown_s",
@@ -129,7 +130,8 @@ class JobSpec:
                  env: Optional[Dict[str, str]] = None,
                  max_restarts: int = -1, restart_window: float = 0.0,
                  drain_grace: Optional[float] = None,
-                 autoscale: Optional[Dict[str, Any]] = None):
+                 autoscale: Optional[Dict[str, Any]] = None,
+                 tenant: Optional[str] = None):
         self.name = name
         # a bare string must reach validate() intact (list("cmd")
         # would explode into single-char "arguments" that pass)
@@ -145,6 +147,7 @@ class JobSpec:
         self.restart_window = restart_window
         self.drain_grace = drain_grace
         self.autoscale = dict(autoscale) if autoscale else None
+        self.tenant = tenant
         self.validate()
 
     # -- validation -----------------------------------------------------
@@ -185,6 +188,14 @@ class JobSpec:
         if self.drain_grace is not None:
             self.drain_grace = _require_num(
                 "drain_grace", self.drain_grace, 0.5)
+        if self.tenant is not None and (
+                not isinstance(self.tenant, str)
+                or not _NAME_RE.match(self.tenant)):
+            raise FleetSpecError(
+                "tenant",
+                "must match [A-Za-z0-9][A-Za-z0-9._-]{0,63} — it keys "
+                f"the admission-control quota table (got "
+                f"{self.tenant!r})")
         if self.autoscale is not None:
             self._validate_autoscale()
 
@@ -273,7 +284,15 @@ class JobSpec:
             out["drain_grace"] = self.drain_grace
         if self.autoscale is not None:
             out["autoscale"] = dict(self.autoscale)
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
         return out
+
+    @property
+    def tenant_key(self) -> str:
+        """Admission-control key: the declared tenant, else the shared
+        ``default`` bucket."""
+        return self.tenant if self.tenant is not None else "default"
 
 
 class Job:
@@ -308,6 +327,8 @@ class Job:
         self.shrink_escalated = False
         self.cancelled = False
         self.unschedulable_reported = False
+        self.aged_reported = False   # starvation-guard boost announced
+        self.quota_reported = False  # parked at the tenant ranks cap
         # latest fleet health summary (fleet/health.py), pulled by the
         # arbiter each tick; None until the job publishes one
         self.health: Optional[Dict[str, Any]] = None

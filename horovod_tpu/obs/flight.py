@@ -83,8 +83,12 @@ def env_window() -> int:
 
 
 def _env_dir() -> str:
+    # explicit flight dir > trace dir > the job's durable state dir >
+    # CWD as the last resort — a fleet/elastic job must never litter
+    # the operator's working directory with postmortems
     return (os.environ.get("HVTPU_FLIGHT_DIR")
             or os.environ.get("HVTPU_TRACE")
+            or os.environ.get("HVTPU_ELASTIC_STATE_DIR")
             or ".")
 
 

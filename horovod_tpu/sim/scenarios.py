@@ -82,6 +82,16 @@ partition-storm           a burst of ``partition(MS)`` fault windows
                           SUSPECTS (stall blame held), most recover,
                           and the one leased victim self-fences.
                           Asserts no false stall failure.
+fleet-service             the production front door: a seeded
+                          multi-tenant submission storm through the
+                          REAL indexed journal into the REAL arbiter
+                          (quotas, fair share, starvation guard,
+                          torus placement, truthful backpressure)
+                          with an injected arbiter crash that rolls
+                          the intake cursor back mid-storm.  Asserts
+                          exactly-once intake, budget-bounded per-tick
+                          cost, named quota rejections, and a bounded
+                          post-aging wait for the starved probe gang.
 compression-negotiation   mixed-precision negotiation through the
                           real controller: a dense fp32 allreduce
                           plus an int8-compressed sidecar per cycle.
@@ -95,6 +105,7 @@ compression-negotiation   mixed-precision negotiation through the
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 from typing import Dict, Iterator, List, Optional
 
@@ -2026,6 +2037,504 @@ def partition_storm(ranks: int, seed: int = 0, *,
 
 
 # ---------------------------------------------------------------------------
+# fleet-service: the production front door under a submission storm
+# ---------------------------------------------------------------------------
+
+class _ServiceJobRunner:
+    """Fleet-service job handle in pure virtual time: no per-job
+    kernel task, so a 5000-job storm costs O(jobs) small objects, not
+    O(jobs) threads.  The REAL arbiter drives it entirely through the
+    runner protocol (start/poll/phase/request_shrink/escalate/stop);
+    progress, drain landings and whole-job stops are lazy functions of
+    ``kernel.now`` evaluated at each reap."""
+
+    def __init__(self, job, kernel: SimKernel, duration_s: float,
+                 drain_s: float, on_start=None):
+        self.name = job.spec.name
+        self.kernel = kernel
+        self.duration_s = duration_s
+        self.drain_s = drain_s
+        self.charged_restarts = 0
+        self.health_dir = None
+        self._on_start = on_start
+        self._alloc: Dict[str, int] = {}
+        self._start_t: Optional[float] = None
+        self._stop_t: Optional[float] = None  # whole-job drain lands
+        self._shrink: Optional[tuple] = None  # (new_np, land_t)
+        self._exit: Optional[int] = None
+
+    def start(self, alloc: Dict[str, int]) -> None:
+        self._alloc = dict(alloc)
+        self._start_t = self.kernel.now
+        if self._on_start is not None:
+            self._on_start(self)
+
+    def _land_shrink(self) -> None:
+        new_np, _land_t = self._shrink
+        self._shrink = None
+        # the drained gang leaves name-largest hosts first: a unique,
+        # replayable trim order
+        cur = sum(self._alloc.values())
+        for h in sorted(self._alloc, reverse=True):
+            if cur <= new_np:
+                break
+            drop = min(self._alloc[h], cur - new_np)
+            self._alloc[h] -= drop
+            cur -= drop
+            if self._alloc[h] <= 0:
+                del self._alloc[h]
+
+    def _advance(self) -> None:
+        now = self.kernel.now
+        if self._exit is not None:
+            return
+        if self._shrink is not None and now >= self._shrink[1]:
+            self._land_shrink()
+        if self._stop_t is not None:
+            if now >= self._stop_t:
+                self._exit = 0
+        elif (self._start_t is not None
+              and now >= self._start_t + self.duration_s):
+            self._exit = 0
+
+    def poll(self) -> Optional[int]:
+        self._advance()
+        return self._exit
+
+    def phase(self) -> str:
+        self._advance()
+        return "resizing" if self._shrink is not None else "running"
+
+    def target_np(self) -> Optional[int]:
+        return self._shrink[0] if self._shrink is not None else None
+
+    def current_np(self) -> int:
+        return sum(self._alloc.values())
+
+    def allocation(self) -> Dict[str, int]:
+        return dict(self._alloc)
+
+    def update_allocation(self, alloc: Dict[str, int]) -> None:
+        self._alloc = dict(alloc)
+
+    def request_shrink(self, new_np: int) -> bool:
+        if self._start_t is None or self._exit is not None:
+            return False
+        self._shrink = (new_np, self.kernel.now + self.drain_s)
+        return True
+
+    def escalate(self) -> int:
+        if self._shrink is None:
+            return 0
+        lag = max(0, self.current_np() - self._shrink[0])
+        self._land_shrink()
+        return lag
+
+    def stop(self) -> None:
+        if self._exit is None and self._stop_t is None:
+            self._stop_t = self.kernel.now + self.drain_s
+
+
+def fleet_service(ranks: int, seed: int = 0, *,
+                  n_jobs: Optional[int] = None,
+                  slots_per_host: int = 8, tick_s: float = 0.5,
+                  grace_s: float = 20.0, intake_budget: int = 256,
+                  queue_limit: Optional[int] = None,
+                  starvation_s: float = 60.0,
+                  aging_slack_s: float = 150.0,
+                  window_s: Optional[float] = None,
+                  restart_delay_s: float = 3.0) -> Dict:
+    """The production front door end to end: a seeded storm of mixed
+    tenants/tiers/sizes submitted through the REAL indexed journal
+    (``fleet/intake.py``) into the REAL arbiter, with per-tenant
+    quotas from a real ``tenants.json``, the weighted fair-share and
+    starvation-guard scheduling order, torus-aware placement, truthful
+    queue-full backpressure (clients retry after the advertised
+    delay), random cancels, and an injected arbiter crash that rolls
+    the intake cursor back several batches mid-storm.  Asserts
+    exactly-once intake across the crash (replays dedupe, nothing runs
+    twice, nothing is lost), a per-tick intake cost bounded by the
+    budget (O(new-entries), zero on quiet ticks), quota rejections
+    that name tenant and limit, a bounded post-aging wait for the
+    starved min-priority probe gang, and gang placements that never
+    overcommit a host."""
+    import shutil as _shutil
+    import tempfile
+
+    from ..fleet import (FleetArbiter, JobSpec, QueueFullError,
+                         SubmitJournal)
+
+    kernel, fabric = _fresh(ranks, seed)
+    n_hosts = max(1, (ranks + slots_per_host - 1) // slots_per_host)
+    hosts = {f"host{h:04d}": slots_per_host for h in range(n_hosts)}
+    pool_slots = n_hosts * slots_per_host
+    if n_jobs is None:
+        # ~2.5 jobs per pool slot keeps utilisation (and therefore
+        # contention) scale-invariant, capped at the 5000-submission
+        # storm the intake protocol is sized for
+        n_jobs = max(120, min(5000, pool_slots * 5 // 2))
+    if queue_limit is None:
+        queue_limit = max(64, n_jobs // 3)
+    if window_s is None:
+        # sized for ~0.85 pool utilisation at the mean job (3 slots x
+        # 30 virtual s), floored so small pools still see a real storm
+        window_s = max(240.0, n_jobs * 90.0 / (0.85 * pool_slots))
+    # the journal/cursor/state.json are REAL files with real fsyncs;
+    # tmpfs keeps the per-tick fsync from dominating the run (the
+    # protocol under test is unchanged — same checkpoint-storm trick)
+    fleet_dir = tempfile.mkdtemp(
+        prefix="hvtpu-fleet-service-",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+    with open(os.path.join(fleet_dir, "tenants.json"), "w") as f:
+        json.dump({
+            "prod": {"weight": 3.0},
+            "batch": {"weight": 1.0},
+            "guest": {"weight": 1.0,
+                      "max_ranks": max(8, pool_slots // 8),
+                      "max_queued": 4},
+            "*": {"weight": 1.0},
+        }, f)
+
+    # -- the seeded arrival plan (all randomness drawn up front) -------
+    r = kernel.rng("fleet-service")
+    tier_of = {"prod": 10, "guest": 5, "batch": 0}
+    storm_t = round(window_s * 0.4, 3)
+    n_storm = int(n_jobs * 0.4)
+    plan: List[tuple] = []  # (t, op, payload)
+    meta: Dict[str, dict] = {}  # name -> tenant/priority/duration
+    for i in range(n_jobs):
+        name = f"job{i:05d}"
+        tenant = r.choice(("prod", "prod", "batch", "batch", "batch",
+                           "guest"))
+        size = r.choice((1, 1, 2, 2, 2, 4, 4, 8))
+        elastic = r.random() < 0.3
+        dur = round(r.uniform(15.0, 45.0), 3)
+        t = (storm_t if i < n_storm
+             else round(r.uniform(0.0, window_s), 3))
+        spec = JobSpec(name, ["sim"], priority=tier_of[tenant],
+                       min_np=size,
+                       max_np=(2 * size if elastic else size),
+                       tenant=tenant).to_dict()
+        meta[name] = {"tenant": tenant, "priority": tier_of[tenant],
+                      "duration": dur}
+        plan.append((t, "submit", spec))
+        if r.random() < 0.06:
+            plan.append((round(t + r.uniform(0.05, 20.0), 3),
+                         "cancel", name))
+    # the starvation probe: a min-priority HALF-POOL gang submitted
+    # right into the storm — backfill keeps eating its capacity until
+    # the aging guard boosts it over every tier
+    probe_np = max(2, pool_slots // 2)
+    probe = JobSpec("probe-batch", ["sim"], priority=0,
+                    min_np=probe_np, tenant="batch").to_dict()
+    meta["probe-batch"] = {"tenant": "batch", "priority": 0,
+                           "duration": 30.0}
+    plan.append((storm_t, "submit", probe))
+    plan.sort(key=lambda e: e[0])
+
+    journal = SubmitJournal(fleet_dir)
+    submit_t: Dict[str, float] = {}
+    seq_name: Dict[int, str] = {}
+    intake_c = {"queue_full": 0, "max_attempts": 0}
+    submit_done: List[bool] = []
+    runners: Dict[str, _ServiceJobRunner] = {}
+    overcommit: List[str] = []
+    gang_spread: List[int] = []
+
+    def on_start(runner: _ServiceJobRunner) -> None:
+        usage: Dict[str, int] = {}
+        for rn in runners.values():
+            if rn._exit is None:
+                for h, n in rn._alloc.items():
+                    usage[h] = usage.get(h, 0) + n
+        for h, used in usage.items():
+            if used > hosts[h]:
+                overcommit.append(
+                    f"{h}: {used}/{hosts[h]} at {runner.name}")
+        gang_spread.append(len(runner._alloc))
+
+    def hash0(name: str) -> int:
+        # a tiny deterministic per-name hash (builtin hash() is
+        # salted per process and would break replay)
+        v = 0
+        for ch in name:
+            v = (v * 131 + ord(ch)) % 100003
+        return v
+
+    def make_runner(job):
+        rn = _ServiceJobRunner(
+            job, kernel,
+            duration_s=meta[job.spec.name]["duration"],
+            drain_s=round(2.0 + (hash0(job.spec.name) % 60) / 10.0, 1),
+            on_start=on_start)
+        runners[job.spec.name] = rn
+        return rn
+
+    def submitter():
+        for t, op, payload in plan:
+            if kernel.now < t:
+                kernel.sleep(t - kernel.now)
+            if op == "cancel":
+                journal.append_cancel(payload)
+                continue
+            attempts = 0
+            while True:
+                attempts += 1
+                try:
+                    seq = journal.append_submit(payload)
+                    break
+                except QueueFullError as e:
+                    # the advertised retry-after is truthful: wait it
+                    # out (plus one tick of margin) and try again
+                    intake_c["queue_full"] += 1
+                    kernel.sleep(e.retry_after_s + tick_s)
+            intake_c["max_attempts"] = max(intake_c["max_attempts"],
+                                           attempts)
+            submit_t[payload["name"]] = kernel.now
+            seq_name[seq] = payload["name"]
+        submit_done.append(True)
+        kernel.log("fleet_service.submitted", jobs=len(submit_t),
+                   queue_full=intake_c["queue_full"])
+
+    arbiters: List[FleetArbiter] = []
+    cursor_hist: List[bytes] = []
+    crashed: List[bool] = []
+    batch_sizes: List[int] = []
+    frag_samples: List[float] = []
+    crash_t = storm_t + 4 * tick_s
+
+    def make_arbiter() -> FleetArbiter:
+        return FleetArbiter(
+            _StaticDiscovery(hosts), fleet_dir=fleet_dir,
+            tick_s=tick_s, drain_grace_s=grace_s,
+            runner_factory=make_runner, event_fn=kernel.log,
+            register_debug=False)
+
+    def arbiter_task():
+        arb = make_arbiter()
+        arbiters.append(arb)
+        last_seq = 0
+        ticks = 0
+        while True:
+            if not crashed and kernel.now >= crash_t:
+                crashed.append(True)
+                # injected crash BETWEEN batch-apply and cursor
+                # commit: the dead incarnation's runners vanish with
+                # it and the cursor wakes up several batches stale —
+                # the replay must dedupe, not double-run
+                for rn in runners.values():
+                    if rn._exit is None:
+                        rn._exit = -1
+                if len(cursor_hist) >= 3:
+                    with open(journal.cursor_path, "wb") as f:
+                        f.write(cursor_hist[-3])
+                kernel.log("fleet_service.crash",
+                           live=sum(1 for j in arb.jobs.values()
+                                    if not j.terminal))
+                kernel.sleep(restart_delay_s)
+                arb = make_arbiter()
+                arbiters.append(arb)
+                n = arb.recover()
+                kernel.log("fleet_service.recover", jobs=n)
+                last_seq = int(
+                    journal.read_cursor().get("seq", 0) or 0)
+            arb.tick()
+            ticks += 1
+            cur_seq = int(journal.read_cursor().get("seq", 0) or 0)
+            batch_sizes.append(cur_seq - last_seq)
+            last_seq = cur_seq
+            try:
+                with open(journal.cursor_path, "rb") as f:
+                    cursor_hist.append(f.read())
+            except OSError:
+                cursor_hist.append(b"")
+            del cursor_hist[:-8]
+            if ticks % 16 == 0:
+                with arb._lock:
+                    frag_samples.append(arb._placement.fragmentation(
+                        arb._free_map(), arb.hosts.current))
+            if (submit_done and journal.depth() == 0
+                    and arb.all_terminal()):
+                break
+            kernel.sleep(tick_s)
+        kernel.log("fleet_service.arbiter_done", ticks=ticks,
+                   jobs=len(arb.jobs))
+
+    with _env(HVTPU_FLEET_INTAKE_BUDGET=str(intake_budget),
+              HVTPU_FLEET_QUEUE_LIMIT=str(queue_limit),
+              HVTPU_FLEET_STARVATION_SECONDS=str(starvation_s),
+              HVTPU_ELASTIC_STATE_DIR=None, HVTPU_FLEET_DIR=None):
+        kernel.spawn("submitter", submitter)
+        kernel.spawn("arbiter", arbiter_task)
+        try:
+            kernel.run(max_virtual_s=_DEF_BUDGET_S)
+        finally:
+            _shutil.rmtree(fleet_dir, ignore_errors=True)
+
+    # -- fold the event log --------------------------------------------
+    first_submit: Dict[str, float] = {}
+    waits: Dict[int, List[float]] = {0: [], 5: [], 10: []}
+    done_counts: Dict[str, int] = {}
+    rejected: Dict[str, List[str]] = {}
+    aged_t: Dict[str, float] = {}
+    start_t: Dict[str, float] = {}
+    quota_waited: set = set()
+    dup_replays = 0
+    preempts = 0
+    for ev in kernel.events:
+        kind = ev["kind"]
+        if kind == "fleet.submit":
+            first_submit.setdefault(ev["job"], ev["t"])
+        elif kind == "fleet.job_start":
+            start_t.setdefault(ev["job"], ev["t"])
+            m = meta.get(ev["job"])
+            if m is not None:
+                waits[m["priority"]].append(ev["queue_wait_s"])
+            # the aging guard's contract: NOTHING waits past the
+            # threshold without being boosted (a job_aged event)
+            if ev["queue_wait_s"] > starvation_s + 2 * tick_s:
+                assert ev["job"] in aged_t, (
+                    f"{ev['job']} waited {ev['queue_wait_s']:.1f}s "
+                    f"(> {starvation_s}s guard) without aging")
+        elif kind == "fleet.job_end":
+            if ev["state"] == "DONE":
+                done_counts[ev["job"]] = (
+                    done_counts.get(ev["job"], 0) + 1)
+        elif kind == "fleet.submit_rejected":
+            sq = ev["spool"]
+            if sq.startswith("journal-"):
+                nm = seq_name.get(int(sq[len("journal-"):]), sq)
+                rejected.setdefault(nm, []).append(ev["error"])
+        elif kind == "fleet.journal_duplicate":
+            dup_replays += 1
+        elif kind == "fleet.job_aged":
+            aged_t.setdefault(ev["job"], ev["t"])
+        elif kind == "fleet.quota_wait":
+            quota_waited.add(ev["job"])
+        elif kind == "fleet.preempt":
+            preempts += 1
+
+    # exactly-once across the crash: every accepted submission is
+    # terminal in exactly one incarnation's ledger, nothing ran twice,
+    # nothing was lost
+    arb1 = arbiters[0]
+    arb2 = arbiters[-1]
+    lost = []
+    for name in submit_t:
+        j = arb2.jobs.get(name) or arb1.jobs.get(name)
+        if j is not None and j.terminal:
+            continue
+        if name in rejected:
+            continue  # refused with a durable, named error
+        lost.append(name)
+    assert not lost, f"{len(lost)} submissions lost: {lost[:5]}"
+    twice = {n: c for n, c in done_counts.items() if c > 1}
+    assert not twice, f"jobs completed more than once: {twice}"
+    dup_rejects = [e for msgs in rejected.values() for e in msgs
+                   if "already exists" in e]
+    assert not dup_rejects, (
+        f"replay surfaced as duplicate-name rejection: "
+        f"{dup_rejects[:3]}")
+    assert len(arbiters) == 2 and crashed, "crash was never injected"
+    assert dup_replays >= 1, (
+        "the rolled-back cursor replayed no batch — the crash window "
+        "closed without exercising dedupe")
+    # intake is O(new-entries): every tick applies at most the budget,
+    # and quiet ticks touch zero records
+    assert batch_sizes and max(batch_sizes) <= intake_budget, (
+        f"a tick applied {max(batch_sizes)} records "
+        f"(budget {intake_budget})")
+    assert intake_c["queue_full"] >= 1, (
+        "the storm never hit the queue limit — backpressure untested")
+    # quota rejections are actionable: tenant and limit named
+    guest_rejects = [e for msgs in rejected.values() for e in msgs
+                     if "tenant 'guest'" in e and "max_queued" in e]
+    assert guest_rejects, "no quota rejection named tenant 'guest'"
+    # the starvation guard bounds the probe's post-aging wait: boosted
+    # over every tier, it starts within the aging slack + one drain
+    probe_j = (arb2.jobs.get("probe-batch")
+               or arb1.jobs.get("probe-batch"))
+    assert probe_j is not None and probe_j.state == "DONE", (
+        f"probe ended {probe_j and probe_j.state}")
+    assert ("probe-batch" in aged_t
+            or (probe_j.queue_wait_s or 0.0)
+            <= starvation_s + 2 * tick_s), (
+        f"probe waited {probe_j.queue_wait_s}s without aging")
+    if "probe-batch" in aged_t:
+        gap = start_t["probe-batch"] - aged_t["probe-batch"]
+        assert gap <= aging_slack_s + grace_s, (
+            f"aged probe waited {gap:.1f}s past the guard "
+            f"(slack {aging_slack_s}+{grace_s})")
+    assert not overcommit, f"host overcommit: {overcommit[:3]}"
+
+    lat = sorted(first_submit[n] - submit_t[n]
+                 for n in submit_t if n in first_submit)
+    aged_gaps = sorted(start_t[n] - aged_t[n] for n in aged_t
+                       if n in start_t and n not in quota_waited)
+    for w in waits.values():
+        w.sort()
+    frag_samples.sort()
+    n_cancelled = sum(
+        1 for n in submit_t
+        if ((arb2.jobs.get(n) or arb1.jobs.get(n)) is not None
+            and (arb2.jobs.get(n) or arb1.jobs.get(n)).cancelled))
+    stats = {"phases": {
+        "pool": {"hosts": n_hosts, "slots": pool_slots,
+                 "jobs": n_jobs, "storm": n_storm,
+                 "queue_limit": queue_limit},
+        "intake": {
+            "appended": len(submit_t),
+            "queue_full_rejections": intake_c["queue_full"],
+            "max_attempts": intake_c["max_attempts"],
+            "max_batch": max(batch_sizes),
+            "budget": intake_budget,
+            "idle_ticks": sum(1 for b in batch_sizes if b == 0),
+            "intake_p50_s": round(_pct(lat, 0.50), 6),
+            "intake_p99_s": round(_pct(lat, 0.99), 6),
+        },
+        "admission": {
+            "rejected": len(rejected),
+            "quota_waits": len(quota_waited),
+        },
+        "crash": {"incarnations": len(arbiters),
+                  "recovered": sum(
+                      1 for e in kernel.events
+                      if e["kind"] == "fleet.recover"),
+                  "replayed_duplicates": dup_replays},
+        "service": {
+            "queue_wait_p50_s": {
+                str(p): round(_pct(w, 0.50), 6)
+                for p, w in sorted(waits.items())},
+            "queue_wait_p99_s": {
+                str(p): round(_pct(w, 0.99), 6)
+                for p, w in sorted(waits.items())},
+            "preemptions": preempts,
+            "aged_jobs": len(aged_t),
+            "aged_gap_max_s": (round(aged_gaps[-1], 6)
+                               if aged_gaps else 0.0),
+            "probe_wait_s": round(
+                probe_j.queue_wait_s or 0.0, 6),
+        },
+        "placement": {
+            "frag_mean": (round(sum(frag_samples)
+                                / len(frag_samples), 6)
+                          if frag_samples else 0.0),
+            "frag_max": (round(frag_samples[-1], 6)
+                         if frag_samples else 0.0),
+            "single_host_gangs": (
+                round(sum(1 for g in gang_spread if g == 1)
+                      / len(gang_spread), 6) if gang_spread else 0.0),
+        },
+        "done": {
+            "done": sum(done_counts.values()),
+            "cancelled": n_cancelled,
+            "virtual_s": round(kernel.now, 6),
+        },
+    }, "kv_ops": dict(fabric.ops)}
+    return _result("fleet-service", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -2043,6 +2552,7 @@ SCENARIOS = {
     "anomaly-detection": anomaly_detection,
     "coordinator-loss": coordinator_loss,
     "partition-storm": partition_storm,
+    "fleet-service": fleet_service,
 }
 
 

@@ -42,10 +42,15 @@ def _ensure_cpu_devices():
 
 
 @pytest.fixture()
-def hvt():
-    """Fresh-initialized horovod_tpu for a test, shut down afterwards."""
+def hvt(tmp_path, monkeypatch):
+    """Fresh-initialized horovod_tpu for a test, shut down afterwards.
+
+    The flight recorder is pointed at a tmp dir so a test that trips a
+    fatal path (stall abort, audit abort) dumps its postmortem there
+    instead of littering the repo root."""
     import horovod_tpu as hvt_mod
 
+    monkeypatch.setenv("HVTPU_FLIGHT_DIR", str(tmp_path))
     hvt_mod.init()
     yield hvt_mod
     hvt_mod.shutdown()

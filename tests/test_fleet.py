@@ -27,8 +27,10 @@ sys.path.insert(0, _REPO)
 
 from horovod_tpu.fleet import (DONE, DRAINING, FAILED, FleetArbiter,
                                FleetSpecError, Job, JobSpec, PENDING,
+                               PlacementPolicy, QueueFullError,
                                RESIZING, RUNNING, Autoscaler,
-                               prefixed_client)
+                               SubmitJournal, TenantConfigError,
+                               TorusGrid, prefixed_client)
 from horovod_tpu.fleet.autoscale import FileSignal
 
 
@@ -900,19 +902,37 @@ class TestCLI:
         assert ei.value.code == 2
         assert "HVTPU_FLEET_DIR" in capsys.readouterr().err
 
-    def test_submit_spools_atomically(self, tmp_path, fleet_dir,
-                                      capsys):
+    def test_submit_appends_journal_record(self, tmp_path, fleet_dir,
+                                           capsys):
         spec = _write_spec(tmp_path, name="good", priority=4)
         rc = self._main("--fleet-dir", str(fleet_dir), "submit",
                         "--spec", spec)
         assert rc == 0
         assert "submitted 'good'" in capsys.readouterr().out
-        spooled = json.loads(
-            (fleet_dir / "submit" / "good.json").read_text())
-        assert spooled["priority"] == 4
-        # no half-written temp files left behind
-        assert [f for f in os.listdir(fleet_dir / "submit")
-                if f.endswith(".part")] == []
+        lines = (fleet_dir / "journal.jsonl").read_text().splitlines()
+        rec = json.loads(lines[-1])
+        assert rec["op"] == "submit" and rec["seq"] == 1
+        assert rec["spec"]["priority"] == 4
+        # nothing reaches the legacy spool dir any more
+        assert os.listdir(fleet_dir / "submit") == []
+
+    def test_submit_backpressured_when_queue_full(self, tmp_path,
+                                                  fleet_dir, capsys,
+                                                  monkeypatch):
+        monkeypatch.setenv("HVTPU_FLEET_QUEUE_LIMIT", "2")
+        for i in range(2):
+            spec = _write_spec(tmp_path, name=f"j{i}")
+            assert self._main("--fleet-dir", str(fleet_dir), "submit",
+                              "--spec", spec) == 0
+        spec = _write_spec(tmp_path, name="overflow")
+        rc = self._main("--fleet-dir", str(fleet_dir), "submit",
+                        "--spec", spec)
+        assert rc == 75  # EX_TEMPFAIL: retry later
+        err = capsys.readouterr().err
+        assert "queue full" in err and "retry after" in err
+        # the refused record never touched the journal
+        lines = (fleet_dir / "journal.jsonl").read_text().splitlines()
+        assert len(lines) == 2
 
     def test_list_without_server_exits_1(self, fleet_dir, capsys):
         rc = self._main("--fleet-dir", str(fleet_dir), "list")
@@ -937,10 +957,12 @@ class TestCLI:
         assert json.loads(capsys.readouterr().out)["jobs"][0][
             "name"] == "shown"
 
-    def test_cancel_drops_marker(self, fleet_dir, capsys):
+    def test_cancel_appends_journal_record(self, fleet_dir, capsys):
         rc = self._main("--fleet-dir", str(fleet_dir), "cancel", "byejob")
         assert rc == 0
-        assert (fleet_dir / "cancel" / "byejob").exists()
+        rec = json.loads(
+            (fleet_dir / "journal.jsonl").read_text().splitlines()[-1])
+        assert rec == {"op": "cancel", "name": "byejob", "seq": 1}
 
 
 # ---------------------------------------------------------------------------
@@ -1037,3 +1059,441 @@ def test_two_jobs_share_pool_preemption_drains_not_strikes(tmp_path):
     assert "preempt" in kinds and "resized" in kinds
     _assert_exactly_once(lo_log, lo_epochs, lo_samples, "lo")
     _assert_exactly_once(hi_log, hi_epochs, hi_samples, "hi")
+
+
+# ---------------------------------------------------------------------------
+# indexed intake: the journal protocol (PR 19 tentpole)
+# ---------------------------------------------------------------------------
+
+
+class TestIndexedIntake:
+    def _arbiter(self, fleet_dir, events=None):
+        def event_fn(kind, **fields):
+            if events is not None:
+                events.append((kind.replace("fleet.", "", 1), fields))
+
+        return FleetArbiter(_FakeDiscovery({"h1": 4, "h2": 4}),
+                            fleet_dir=str(fleet_dir), tick_s=0.5,
+                            runner_factory=_FakeRunner,
+                            event_fn=event_fn, register_debug=False)
+
+    def test_intake_bounded_by_budget_per_tick(self, fleet_dir,
+                                               fake_clock, monkeypatch):
+        monkeypatch.setenv("HVTPU_FLEET_INTAKE_BUDGET", "3")
+        arb = self._arbiter(fleet_dir)
+        jr = SubmitJournal(str(fleet_dir))
+        for i in range(8):
+            jr.append_submit(
+                _spec(f"j{i}", min_np=8, max_np=8).to_dict())
+        arb.tick()
+        assert len(arb.jobs) == 3  # one budget's worth, no more
+        arb.tick()
+        assert len(arb.jobs) == 6
+        arb.tick()
+        assert len(arb.jobs) == 8  # drained; cursor is caught up
+        assert jr.depth() == 0
+
+    def test_crash_between_apply_and_commit_is_exactly_once(
+            self, fleet_dir, fake_clock):
+        events = []
+        arb1 = self._arbiter(fleet_dir, events)
+        jr = SubmitJournal(str(fleet_dir))
+        for i in range(3):
+            jr.append_submit(_spec(f"j{i}").to_dict())
+        arb1.tick()
+        assert len(arb1.jobs) == 3
+        # the crash window: state.json has the jobs, but the cursor
+        # never committed — wipe it back to zero
+        (fleet_dir / "journal.cursor").unlink()
+        arb2 = self._arbiter(fleet_dir, events)
+        assert arb2.recover() == 3
+        arb2.tick()  # replays the whole journal against live jobs
+        assert sorted(arb2.jobs) == ["j0", "j1", "j2"]
+        kinds = [k for k, _ in events]
+        assert kinds.count("journal_duplicate") == 3
+        assert "submit_rejected" not in kinds  # never a dup-name error
+        assert not (fleet_dir / "rejected").exists()
+
+    def test_torn_tail_left_for_next_tick(self, fleet_dir, fake_clock):
+        arb = self._arbiter(fleet_dir)
+        jr = SubmitJournal(str(fleet_dir))
+        jr.append_submit(_spec("whole").to_dict())
+        torn = json.dumps({"op": "submit", "seq": 2,
+                           "spec": _spec("torn").to_dict()})
+        with open(fleet_dir / "journal.jsonl", "ab") as f:
+            f.write(torn[:20].encode())  # no newline: mid-append crash
+        arb.tick()
+        assert sorted(arb.jobs) == ["whole"]
+        # the writer completes the line: next tick picks it up
+        with open(fleet_dir / "journal.jsonl", "ab") as f:
+            f.write(torn[20:].encode() + b"\n")
+        arb.tick()
+        assert sorted(arb.jobs) == ["torn", "whole"]
+
+    def test_corrupt_line_surfaced_not_fatal(self, fleet_dir,
+                                             fake_clock):
+        events = []
+        arb = self._arbiter(fleet_dir, events)
+        jr = SubmitJournal(str(fleet_dir))
+        jr.append_submit(_spec("before").to_dict())
+        with open(fleet_dir / "journal.jsonl", "ab") as f:
+            f.write(b"{this is not json}\n")
+        jr.append_submit(_spec("after").to_dict())
+        arb.tick()
+        assert sorted(arb.jobs) == ["after", "before"]
+        kinds = [k for k, _ in events]
+        assert "journal_corrupt" in kinds
+
+    def test_backpressure_truthful_retry_after(self, fleet_dir,
+                                               fake_clock, monkeypatch):
+        monkeypatch.setenv("HVTPU_FLEET_QUEUE_LIMIT", "2")
+        arb = self._arbiter(fleet_dir)
+        jr = SubmitJournal(str(fleet_dir))
+        jr.append_submit(_spec("a").to_dict())
+        jr.append_submit(_spec("b").to_dict())
+        with pytest.raises(QueueFullError) as ei:
+            jr.append_submit(_spec("c").to_dict())
+        assert ei.value.depth == 2 and ei.value.limit == 2
+        assert ei.value.retry_after_s > 0
+        assert "retry after" in str(ei.value)
+        arb.tick()  # the arbiter drains the backlog...
+        jr.append_submit(_spec("c").to_dict())  # ...and the retry lands
+        arb.tick()
+        assert "c" in arb.jobs
+
+    def test_quiet_tick_reads_nothing(self, fleet_dir, fake_clock):
+        arb = self._arbiter(fleet_dir)
+        jr = SubmitJournal(str(fleet_dir))
+        jr.append_submit(_spec("only").to_dict())
+        arb.tick()
+        cursor = json.loads((fleet_dir / "journal.cursor").read_text())
+        batch = SubmitJournal(str(fleet_dir)).read_batch(256)
+        assert batch == []  # O(new-entries): nothing new, nothing read
+        assert cursor["seq"] == 1 and cursor["budget"] > 0
+
+
+# ---------------------------------------------------------------------------
+# cancel race: spooled-but-not-intaken jobs (PR 19 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestCancelRace:
+    def _arbiter(self, fleet_dir, events):
+        def event_fn(kind, **fields):
+            events.append((kind.replace("fleet.", "", 1), fields))
+
+        return FleetArbiter(_FakeDiscovery({"h1": 4}),
+                            fleet_dir=str(fleet_dir), tick_s=0.5,
+                            runner_factory=_FakeRunner,
+                            event_fn=event_fn, register_debug=False)
+
+    def test_journal_cancel_lands_before_schedule(self, fleet_dir,
+                                                  fake_clock):
+        # submit + cancel both in the backlog when the arbiter wakes:
+        # flock ordering guarantees cancel-after-submit, so the job
+        # must die in the SAME tick, never reaching the scheduler
+        events = []
+        arb = self._arbiter(fleet_dir, events)
+        jr = SubmitJournal(str(fleet_dir))
+        jr.append_submit(_spec("doomed").to_dict())
+        jr.append_cancel("doomed")
+        arb.tick()
+        j = arb.jobs["doomed"]
+        assert j.state == FAILED and j.reason == "cancelled"
+        assert j.handle is None  # never launched
+        kinds = [k for k, _ in events]
+        assert "job_start" not in kinds
+
+    def test_journal_cancel_tombstones_legacy_spool(self, fleet_dir,
+                                                    fake_clock):
+        # the PR 14 race: `hvtpufleet cancel` for a job whose spec
+        # still sits in the legacy spool dir.  The cancel record must
+        # tombstone the file so the job NEVER surfaces as PENDING.
+        events = []
+        arb = self._arbiter(fleet_dir, events)
+        _write_spec(fleet_dir / "submit", name="spooled")
+        SubmitJournal(str(fleet_dir)).append_cancel("spooled")
+        arb.tick()
+        assert "spooled" not in arb.jobs
+        assert not os.path.exists(fleet_dir / "submit" / "spooled.json")
+        kinds = [k for k, _ in events]
+        assert "cancel_spooled" in kinds and "job_start" not in kinds
+
+    def test_legacy_marker_tombstones_same_tick_spool(self, fleet_dir,
+                                                      fake_clock):
+        events = []
+        arb = self._arbiter(fleet_dir, events)
+        _write_spec(fleet_dir / "submit", name="racer")
+        (fleet_dir / "cancel" / "racer").write_text("cancel\n")
+        arb.tick()
+        # markers are processed FIRST: the same-tick spool file is
+        # consumed by the tombstone, not started
+        assert "racer" not in arb.jobs
+        assert "cancel_spooled" in [k for k, _ in events]
+
+    def test_cancel_for_unknown_job_reports_once(self, fleet_dir,
+                                                 fake_clock):
+        events = []
+        arb = self._arbiter(fleet_dir, events)
+        SubmitJournal(str(fleet_dir)).append_cancel("ghost")
+        arb.tick()
+        assert [k for k, _ in events].count("cancel_unknown") == 1
+
+
+# ---------------------------------------------------------------------------
+# admission control: tenant quota edge matrix (PR 19 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionQuotas:
+    def _arbiter(self, fleet_dir, events, hosts=None):
+        def event_fn(kind, **fields):
+            events.append((kind.replace("fleet.", "", 1), fields))
+
+        return FleetArbiter(_FakeDiscovery(hosts or {"h1": 4, "h2": 4}),
+                            fleet_dir=str(fleet_dir), tick_s=0.5,
+                            runner_factory=_FakeRunner,
+                            event_fn=event_fn, register_debug=False)
+
+    def _tenants(self, fleet_dir, table, mtime=None):
+        p = fleet_dir / "tenants.json"
+        p.write_text(json.dumps(table))
+        if mtime is not None:
+            os.utime(p, (mtime, mtime))
+
+    def test_queued_quota_rejects_naming_tenant_and_limit(
+            self, fleet_dir, fake_clock):
+        events = []
+        self._tenants(fleet_dir, {"acme": {"max_queued": 1}}, mtime=1)
+        arb = self._arbiter(fleet_dir, events, hosts={"h1": 1})
+        jr = SubmitJournal(str(fleet_dir))
+        for i in range(3):
+            jr.append_submit(
+                _spec(f"q{i}", min_np=1, tenant="acme").to_dict())
+        arb.tick()
+        # during one intake batch the first is queued, the rest are
+        # refused with the tenant and the limit named
+        rejects = [f for k, f in events if k == "submit_rejected"]
+        assert len(rejects) == 2
+        for f in rejects:
+            assert "tenant 'acme'" in f["error"]
+            assert "max_queued=1" in f["error"]
+        errs = sorted(os.listdir(fleet_dir / "rejected"))
+        assert errs == ["journal-2.error", "journal-3.error"]
+
+    def test_max_ranks_quota_defers_start_without_blocking_pool(
+            self, fleet_dir, fake_clock):
+        events = []
+        self._tenants(fleet_dir, {"acme": {"max_ranks": 4}}, mtime=1)
+        arb = self._arbiter(fleet_dir, events)
+        jr = SubmitJournal(str(fleet_dir))
+        jr.append_submit(_spec("a", min_np=4, tenant="acme").to_dict())
+        jr.append_submit(_spec("b", min_np=2, tenant="acme").to_dict())
+        jr.append_submit(_spec("c", min_np=2, tenant="other").to_dict())
+        arb.tick()
+        # quota exactly met: a (4 ranks) starts; b parks on policy; the
+        # OTHER tenant's job backfills freely past the parked one
+        assert arb.jobs["a"].state == RUNNING
+        assert arb.jobs["b"].state == PENDING
+        assert arb.jobs["c"].state == RUNNING
+        waits = [f for k, f in events if k == "quota_wait"]
+        assert len(waits) == 1 and waits[0]["job"] == "b"
+        assert "max_ranks=4" in waits[0]["detail"]
+
+    def test_quota_shrink_below_usage_never_kills_running(
+            self, fleet_dir, fake_clock):
+        events = []
+        self._tenants(fleet_dir, {"acme": {"max_ranks": 8}}, mtime=1)
+        arb = self._arbiter(fleet_dir, events)
+        jr = SubmitJournal(str(fleet_dir))
+        jr.append_submit(_spec("big", min_np=6, tenant="acme").to_dict())
+        arb.tick()
+        assert arb.jobs["big"].state == RUNNING
+        # the operator tightens the quota BELOW current usage: running
+        # jobs are never revoked, but new starts defer
+        self._tenants(fleet_dir, {"acme": {"max_ranks": 2}}, mtime=2)
+        jr.append_submit(_spec("next", min_np=1, tenant="acme").to_dict())
+        arb.tick()
+        assert arb.jobs["big"].state == RUNNING  # untouched
+        assert arb.jobs["next"].state == PENDING
+        assert "tenants_reload" in [k for k, _ in events]
+        # the quota frees up when big exits
+        arb.jobs["big"].handle.exit(0)
+        arb.tick()
+        assert arb.jobs["next"].state == RUNNING
+
+    def test_malformed_tenants_json_keeps_previous_table(
+            self, fleet_dir, fake_clock):
+        events = []
+        self._tenants(fleet_dir, {"acme": {"max_queued": 0}}, mtime=1)
+        arb = self._arbiter(fleet_dir, events)
+        jr = SubmitJournal(str(fleet_dir))
+        jr.append_submit(_spec("x", tenant="acme").to_dict())
+        arb.tick()  # max_queued=0: refused outright
+        assert "x" not in arb.jobs
+        # a broken rewrite must NOT drop the quota table
+        (fleet_dir / "tenants.json").write_text("{broken")
+        os.utime(fleet_dir / "tenants.json", (3, 3))
+        jr.append_submit(_spec("y", tenant="acme").to_dict())
+        arb.tick()
+        assert "y" not in arb.jobs  # previous table still enforced
+        bad = [f for k, f in events if k == "tenants_rejected"]
+        assert bad and "previous table kept" in bad[0]["error"]
+
+    def test_tenant_field_errors_are_named(self):
+        from horovod_tpu.fleet import AdmissionController
+
+        ac = AdmissionController(None)
+        with pytest.raises(TenantConfigError) as ei:
+            ac.load_dict({"acme": {"weight": -1}})
+        assert "tenant 'acme'" in str(ei.value)
+        assert "weight" in str(ei.value)
+        with pytest.raises(TenantConfigError, match="unknown field"):
+            ac.load_dict({"acme": {"max_rank": 4}})
+        with pytest.raises(TenantConfigError, match="must be an object"):
+            ac.load_dict({"acme": "not-an-object"})
+
+    def test_spec_tenant_validation(self):
+        with pytest.raises(FleetSpecError, match="tenant"):
+            JobSpec("j", ["cmd"], tenant="bad tenant!")
+        assert JobSpec("j", ["cmd"]).tenant_key == "default"
+        assert JobSpec("j", ["cmd"], tenant="acme").tenant_key == "acme"
+        d = JobSpec("j", ["cmd"], tenant="acme").to_dict()
+        assert d["tenant"] == "acme"
+        assert "tenant" not in JobSpec("j", ["cmd"]).to_dict()
+
+
+# ---------------------------------------------------------------------------
+# starvation guard: aging on the fake clock (PR 19 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestStarvationGuard:
+    def test_aged_job_boosts_over_every_tier(self, arbiter, fake_clock,
+                                             monkeypatch):
+        monkeypatch.setenv("HVTPU_FLEET_STARVATION_SECONDS", "100")
+        hog = arbiter.submit(_spec("hog", min_np=2, max_np=8,
+                                   priority=9))
+        arbiter.tick()
+        assert sum(hog.allocation.values()) == 8  # pool exhausted
+        lo = arbiter.submit(_spec("lo", min_np=4, priority=0))
+        arbiter.tick()
+        # below the threshold: a lower tier never preempts upward
+        assert hog.state == RUNNING and lo.state == PENDING
+        assert hog.handle.shrink_requests == []
+        fake_clock.t += 101.0
+        arbiter.tick()
+        # aged: boosted over the higher tier, the hog drains toward min
+        aged = [f for k, f in arbiter.events if k == "job_aged"]
+        assert len(aged) == 1 and aged[0]["job"] == "lo"
+        assert aged[0]["waited_s"] >= 100.0
+        assert hog.state == DRAINING
+        assert hog.handle.shrink_requests == [4]
+        pre = [f for k, f in arbiter.events if k == "preempt"]
+        assert pre and pre[0]["reason"] == "preempted for lo"
+        # the drain lands; the aged job's wait stays bounded
+        hog.handle.drain_lands()
+        hog.handle.relaunch()
+        arbiter.tick()
+        assert lo.state == RUNNING
+        assert lo.queue_wait_s <= 101.0 + 1.0
+
+    def test_aging_disabled_at_zero(self, arbiter, fake_clock,
+                                    monkeypatch):
+        monkeypatch.setenv("HVTPU_FLEET_STARVATION_SECONDS", "0")
+        hog = arbiter.submit(_spec("hog", min_np=2, max_np=8,
+                                   priority=9))
+        arbiter.tick()
+        lo = arbiter.submit(_spec("lo", min_np=4, priority=0))
+        fake_clock.t += 100000.0
+        arbiter.tick()
+        assert lo.state == PENDING and hog.state == RUNNING
+        assert "job_aged" not in [k for k, _ in arbiter.events]
+
+    def test_aged_event_fires_once(self, arbiter, fake_clock,
+                                   monkeypatch):
+        monkeypatch.setenv("HVTPU_FLEET_STARVATION_SECONDS", "50")
+        arbiter.submit(_spec("hog", min_np=2, max_np=8, priority=9))
+        arbiter.tick()
+        arbiter.submit(_spec("lo", min_np=8, priority=0))
+        fake_clock.t += 51.0
+        arbiter.tick()
+        arbiter.tick()
+        arbiter.tick()
+        aged = [k for k, _ in arbiter.events if k == "job_aged"]
+        assert len(aged) == 1
+
+
+# ---------------------------------------------------------------------------
+# topology-aware placement (PR 19 tentpole)
+# ---------------------------------------------------------------------------
+
+
+class TestPlacement:
+    def test_torus_grid_shape_and_distance(self):
+        g = TorusGrid(["h0", "h1", "h2", "h3", "h4", "h5"])
+        # 6 hosts fold onto a 2x3 (rows x cols) torus
+        assert g.cols == 3 and g.rows == 2
+        assert g.distance("h0", "h1") == 1
+        assert g.distance("h0", "h2") == 1  # row wrap: 2 -> 0
+        assert g.distance("h0", "h3") == 1  # column neighbour
+        assert set(g.neighbors("h0")) <= {"h1", "h2", "h3"}
+
+    def test_best_fit_picks_tightest_single_host(self):
+        p = PlacementPolicy()
+        pool = {"h1": 8, "h2": 8, "h3": 8}
+        free = {"h1": 4, "h2": 8, "h3": 3}
+        alloc = p.carve(free, 3, pool)
+        # h3 (3 free) is the TIGHTEST host that still fits the gang:
+        # taking it whole leaves the big holes intact
+        assert alloc == {"h3": 3}
+        assert free == {"h1": 4, "h2": 8, "h3": 0}  # mutated in place
+
+    def test_multi_host_gang_stays_contiguous(self):
+        p = PlacementPolicy()
+        hosts = [f"h{i:02d}" for i in range(16)]  # a 4x4 torus
+        pool = {h: 8 for h in hosts}
+        free = {h: 8 for h in hosts}
+        alloc = p.carve(free, 24, pool)  # 3 hosts' worth
+        g = p.grid_for(pool)
+        names = sorted(alloc)
+        # every member is within torus distance 1 of the anchor set
+        assert len(names) == 3
+        assert max(g.distance(names[0], h) for h in names) <= 2
+
+    def test_expansion_carves_near_existing_allocation(self):
+        p = PlacementPolicy()
+        hosts = [f"h{i:02d}" for i in range(9)]  # 3x3
+        pool = {h: 8 for h in hosts}
+        free = {h: 8 for h in hosts}
+        free.pop("h04")
+        grown = p.carve(free, 8, pool, near={"h04": 8})
+        (picked,) = grown
+        g = p.grid_for(pool)
+        assert g.distance("h04", picked) == 1  # a torus neighbour
+
+    def test_fragmentation_metric(self):
+        p = PlacementPolicy()
+        hosts = [f"h{i:02d}" for i in range(4)]  # 2x2
+        pool = {h: 4 for h in hosts}
+        # fully free: one component, zero fragmentation
+        assert p.fragmentation({h: 4 for h in hosts}, pool) == 0.0
+        # no free at all: defined as zero, not a division crash
+        assert p.fragmentation({}, pool) == 0.0
+        # on a 2x2 torus every pair is adjacent, so split the free
+        # space across a larger ring to isolate components
+        hosts6 = [f"g{i}" for i in range(6)]
+        pool6 = {h: 4 for h in hosts6}
+        g6 = p.grid_for(pool6)
+        a = hosts6[0]
+        far = max(hosts6, key=lambda h: g6.distance(a, h))
+        frag = p.fragmentation({a: 4, far: 4}, pool6)
+        assert 0.0 < frag <= 0.5  # two equal islands -> half stranded
+
+    def test_fragmentation_gauge_published_by_tick(self, arbiter):
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        arbiter.submit(_spec("j", min_np=3))
+        arbiter.tick()
+        g = obs_metrics.gauge("hvtpu_fleet_fragmentation")
+        assert 0.0 <= g.value() <= 1.0

@@ -149,6 +149,30 @@ class TestFastChaosMatrix:
         assert r["stats"]["phases"]["inject"]["lo_incarnations"] == [
             256, 256, 128]
 
+    def test_fleet_service_256(self):
+        # the scenario itself asserts the front-door contract
+        # (exactly-once intake across the injected crash,
+        # budget-bounded per-tick cost, named quota rejections, the
+        # starvation guard's bounded wait, no host overcommit); here
+        # we pin the external shape of the measured rows
+        r = run_scenario("fleet-service", 256, seed=7)
+        ph = r["stats"]["phases"]
+        assert ph["pool"]["jobs"] == 640
+        intake = ph["intake"]
+        assert 0 < intake["max_batch"] <= intake["budget"]
+        assert intake["queue_full_rejections"] >= 1
+        assert intake["idle_ticks"] > 0, (
+            "no quiet tick — the O(new-entries) claim is unobserved")
+        assert intake["intake_p99_s"] >= intake["intake_p50_s"] > 0
+        assert ph["crash"]["incarnations"] == 2
+        assert ph["crash"]["recovered"] > 0
+        assert ph["crash"]["replayed_duplicates"] >= 1
+        assert ph["admission"]["rejected"] > 0
+        assert ph["service"]["aged_jobs"] >= 1
+        assert ph["service"]["preemptions"] >= 1
+        assert 0.0 <= ph["placement"]["frag_mean"] <= 1.0
+        assert ph["done"]["done"] > 0.7 * 640
+
     def test_checkpoint_storm_256(self):
         # the scenario itself asserts the durable-plane contract
         # (torn commit never lands, bitflip rejected by hashes, one
@@ -223,7 +247,8 @@ class TestDeterminism:
     @pytest.mark.parametrize(
         "name", ["steady-drain", "kill-blacklist", "multi-job-arbiter",
                  "checkpoint-storm", "compression-negotiation",
-                 "coordinator-loss", "partition-storm"])
+                 "coordinator-loss", "partition-storm",
+                 "fleet-service"])
     def test_same_seed_byte_identical(self, name):
         a = _dump(run_scenario(name, 64, seed=7))
         b = _dump(run_scenario(name, 64, seed=7))
@@ -241,7 +266,7 @@ class TestDeterminism:
             "kill-blacklist", "kv-brownout", "straggler-tail",
             "stream-matrix", "multi-job-arbiter", "checkpoint-storm",
             "compression-negotiation", "anomaly-detection",
-            "coordinator-loss", "partition-storm"}
+            "coordinator-loss", "partition-storm", "fleet-service"}
         with pytest.raises(KeyError, match="steady-drain"):
             run_scenario("no-such-scenario", 8)
 
@@ -299,3 +324,24 @@ class TestScale:
     def test_thundering_rendezvous_4096(self):
         r = run_scenario("thundering-rendezvous", 4096, seed=7)
         assert r["stats"]["kv_ops"]["put"] == 4096
+
+    def test_fleet_service_4096(self):
+        # the 5000-submission storm: the full front door at fleet
+        # scale (intake stays budget-bounded, the crash replay
+        # dedupes, quotas reject by name)
+        r = run_scenario("fleet-service", 4096, seed=7)
+        ph = r["stats"]["phases"]
+        assert ph["pool"]["jobs"] == 5000
+        assert 0 < ph["intake"]["max_batch"] <= 256
+        assert ph["intake"]["queue_full_rejections"] >= 1
+        assert ph["crash"]["replayed_duplicates"] >= 1
+        assert ph["admission"]["rejected"] > 0
+
+    def test_fleet_service_16384(self):
+        r = run_scenario("fleet-service", 16384, seed=7)
+        ph = r["stats"]["phases"]
+        assert ph["pool"]["slots"] == 16384
+        assert ph["pool"]["jobs"] == 5000
+        assert 0 < ph["intake"]["max_batch"] <= 256
+        assert ph["crash"]["incarnations"] == 2
+        assert ph["done"]["done"] > 0.8 * 5000

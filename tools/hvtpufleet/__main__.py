@@ -6,7 +6,6 @@ import argparse
 import json
 import os
 import sys
-import tempfile
 
 
 def _fleet_dir(args) -> str:
@@ -54,23 +53,26 @@ def _cmd_serve(args) -> int:
 
 
 def _cmd_submit(args) -> int:
+    from horovod_tpu.fleet.intake import QueueFullError, SubmitJournal
     from horovod_tpu.fleet.job import FleetSpecError, JobSpec
 
-    # client-side validation: a malformed spec never reaches the spool
+    # client-side validation: a malformed spec never reaches the
+    # journal
     try:
         spec = JobSpec.load(args.spec)
     except FleetSpecError as e:
         print(f"hvtpufleet: --spec: {e}", file=sys.stderr)
         return 2
     d = _fleet_dir(args)
-    spool = os.path.join(d, "submit")
-    os.makedirs(spool, exist_ok=True)
-    # atomic drop: the arbiter must never read a half-written spec
-    fd, tmp = tempfile.mkstemp(dir=spool, suffix=".part")
-    with os.fdopen(fd, "w") as f:
-        json.dump(spec.to_dict(), f, sort_keys=True, indent=1)
-    os.replace(tmp, os.path.join(spool, f"{spec.name}.json"))
-    print(f"hvtpufleet: submitted {spec.name!r} "
+    journal = SubmitJournal(d)
+    try:
+        seq = journal.append_submit(spec.to_dict())
+    except QueueFullError as e:
+        # truthful backpressure: the arbiter's published drain rate
+        # says when the backlog will be below the limit again
+        print(f"hvtpufleet: {e}", file=sys.stderr)
+        return 75  # EX_TEMPFAIL: retry later, nothing was queued
+    print(f"hvtpufleet: submitted {spec.name!r} as journal #{seq} "
           f"(priority={spec.priority}, min_np={spec.min_np})")
     return 0
 
@@ -156,12 +158,15 @@ def _cmd_top(args) -> int:
 
 
 def _cmd_cancel(args) -> int:
+    from horovod_tpu.fleet.intake import SubmitJournal
+
     d = _fleet_dir(args)
-    spool = os.path.join(d, "cancel")
-    os.makedirs(spool, exist_ok=True)
-    with open(os.path.join(spool, args.name), "w") as f:
-        f.write("cancel\n")
-    print(f"hvtpufleet: cancel requested for {args.name!r}")
+    # journal, not a marker file: the cancel record is ordered AFTER
+    # the job's submit record, so a spooled-but-not-yet-intaken job is
+    # tombstoned before it can ever go PENDING
+    seq = SubmitJournal(d).append_cancel(args.name)
+    print(f"hvtpufleet: cancel requested for {args.name!r} "
+          f"(journal #{seq})")
     return 0
 
 
