@@ -230,7 +230,7 @@ class FleetArbiter:
     def tick(self) -> None:
         """One full arbiter pass: journal+spool intake → pool refresh
         → reap → fail-fast → gang schedule (+preempt) → autoscale →
-        publish."""
+        publish → journal-cursor commit (after state.json persists)."""
         with self._lock:
             # reload tenants BEFORE intake: queued-quota checks on the
             # first post-(re)start tick must see the current table, or
@@ -245,6 +245,9 @@ class FleetArbiter:
             self._autoscale_tick()
             self._poll_health()
             self._publish()
+            # cursor commit LAST: _publish wrote state.json, so the
+            # intaken batch is durable before its records are skipped
+            self._commit_journal()
 
     def _refresh_pool(self) -> None:  # hvtpulint: requires(_lock)
         try:
@@ -618,11 +621,15 @@ class FleetArbiter:
     # -- indexed intake (journal ↔ arbiter) ------------------------------
     def _intake_journal(self) -> None:  # hvtpulint: requires(_lock)
         """Apply at most ``intake_budget`` journal records in seq
-        order, then commit the cursor (crash between apply and commit
-        replays one batch; replayed submits dedupe against their live
-        job).  Cancels ordered after their submit in the journal can
-        also tombstone a record still sitting in the LEGACY spool dir,
-        so a cancelled job never surfaces as PENDING."""
+        order.  The cursor is NOT committed here: that happens in
+        :meth:`_commit_journal` at the end of the tick, after
+        ``state.json`` has persisted the admitted jobs — a crash
+        anywhere in between replays the batch (replayed submits dedupe
+        against their live job) instead of losing submissions the CLI
+        already acknowledged.  Cancels ordered after their submit in
+        the journal can also tombstone a record still sitting in the
+        LEGACY spool dir, so a cancelled job never surfaces as
+        PENDING."""
         jr = self._journal
         if jr is None:
             return
@@ -639,7 +646,18 @@ class FleetArbiter:
                 admission_mod.M_REJECTS.inc(reason="corrupt_record")
                 self._event("journal_corrupt",
                             seq=int(rec.get("seq") or 0))
-        jr.commit(budget=self._intake_budget, tick_s=self.tick_s)
+
+    def _commit_journal(self) -> None:  # hvtpulint: requires(_lock)
+        """Commit the journal cursor — only called AFTER the jobs
+        admitted this tick are durable in ``state.json``.  Ordering
+        matters: committing first would open a window where a crash
+        loses acknowledged submissions (advanced cursor skips their
+        records, state.json never saw them).  The reverse window —
+        state persisted, cursor not yet committed — merely replays the
+        batch, which ``_apply_journal_submit`` dedupes."""
+        if self._journal is not None:
+            self._journal.commit(budget=self._intake_budget,
+                                 tick_s=self.tick_s)
 
     def _apply_journal_submit(self, rec: dict) -> None:  # hvtpulint: requires(_lock)
         seq = int(rec.get("seq") or 0)

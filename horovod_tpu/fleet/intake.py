@@ -10,17 +10,23 @@ The journal replaces that with an indexed intake:
   ``submit`` / ``cancel`` is one JSON line appended under an exclusive
   ``flock``, so concurrent CLI clients serialize and records carry a
   strictly increasing ``seq``.  A crash mid-append leaves at most one
-  torn tail line, which readers detect (no trailing newline / JSON
-  error at EOF) and ignore until the writer completes it.
+  torn tail line: readers detect it (no trailing newline at EOF) and
+  skip it, and the NEXT writer repairs it under the lock — it
+  terminates the partial line with a newline before appending, so the
+  dead writer's fragment surfaces as one corrupt record instead of
+  silently merging with (and destroying) the new submission.
 - **Persisted cursor** (``<fleet_dir>/journal.cursor``): the arbiter
   remembers ``(offset, seq)`` of the last applied record, written
   crash-atomically through :func:`core.durable.atomic_write` AFTER the
-  batch is applied.  Each tick therefore seeks straight to the first
-  new record and reads at most ``budget`` lines: per-tick cost is
-  O(new-entries), never O(queue).  A crash between apply and cursor
-  commit replays at most one batch; the arbiter dedupes replayed
-  submits (same live name + same spec → consume silently), which makes
-  intake exactly-once at the job level.
+  batch is applied and the admitted jobs are durable in ``state.json``
+  (commit-last ordering: a crash anywhere before the commit replays
+  the batch; committing first would instead LOSE acknowledged
+  submissions whose records the advanced cursor skips).  Each tick
+  seeks straight to the first new record and reads at most ``budget``
+  lines: per-tick cost is O(new-entries), never O(queue).  A replayed
+  batch is deduped by the arbiter (same live name + same spec →
+  consume silently), which makes intake exactly-once at the job
+  level.
 - **Backpressure**: the cursor also publishes the arbiter's drain rate
   (``budget`` records per ``tick_s``).  When the un-applied backlog
   reaches ``HVTPU_FLEET_QUEUE_LIMIT``, :meth:`SubmitJournal.append_submit`
@@ -153,24 +159,36 @@ class SubmitJournal:
     # -- write side (CLI clients) ----------------------------------------
     def _tail_seq(self) -> int:
         """Seq of the last COMPLETE record (newline-terminated and
-        parseable); O(1) — reads only the journal tail."""
+        parseable).  Scans backwards from EOF in 64KB windows,
+        widening until a parseable line is found, so one oversized
+        record longer than a window cannot hide the tail and restart
+        seq numbering (duplicate seqs would break depth() and
+        cursor-based dedup).  O(tail) in the common case."""
         try:
             with open(self.path, "rb") as f:
                 f.seek(0, os.SEEK_END)
-                end = f.tell()
-                back = min(end, 65536)
-                f.seek(end - back)
-                chunk = f.read(back)
+                pos = f.tell()
+                buf = b""
+                while pos > 0:
+                    back = min(pos, 65536)
+                    pos -= back
+                    f.seek(pos)
+                    buf = f.read(back) + buf
+                    lines = buf.split(b"\n")
+                    # unless the window reached BOF, the first element
+                    # is a mid-line fragment — keep it for the next
+                    # widening pass instead of parsing it
+                    for line in reversed(lines[0 if pos == 0 else 1:]):
+                        if not line.strip():
+                            continue
+                        try:
+                            rec = json.loads(line)
+                            if isinstance(rec, dict):
+                                return int(rec.get("seq", 0) or 0)
+                        except (ValueError, TypeError):
+                            pass  # torn tail or corrupt line
         except OSError:
             return 0
-        for line in reversed(chunk.split(b"\n")):
-            if not line.strip():
-                continue
-            try:
-                rec = json.loads(line)
-                return int(rec.get("seq", 0))
-            except (ValueError, TypeError):
-                continue  # torn tail (or mid-chunk partial first line)
         return 0
 
     def depth(self) -> int:
@@ -180,8 +198,19 @@ class SubmitJournal:
 
     def _append(self, rec: dict) -> int:
         os.makedirs(self.fleet_dir, exist_ok=True)
-        with open(self.path, "ab") as f:
+        with open(self.path, "a+b") as f:
             _flock(f)  # released on close
+            # repair a torn tail left by a CRASHED writer: terminate
+            # the partial line so this record cannot merge into it
+            # (the fragment then surfaces as one corrupt record)
+            f.seek(0, os.SEEK_END)
+            end = f.tell()
+            if end:
+                f.seek(end - 1)
+                if f.read(1) != b"\n":
+                    f.seek(0, os.SEEK_END)
+                    f.write(b"\n")
+            f.seek(0, os.SEEK_END)
             seq = self._tail_seq() + 1
             rec = dict(rec, seq=seq)
             f.write(json.dumps(rec, sort_keys=True).encode() + b"\n")
@@ -223,13 +252,15 @@ class SubmitJournal:
         records so the caller can surface them, while a torn tail
         (no trailing newline) is left for the next tick."""
         cur = self.read_cursor()
-        offset = int(cur.get("offset", 0) or 0)
+        start = int(cur.get("offset", 0) or 0)
+        offset = start
         seq = int(cur.get("seq", 0) or 0)
         out: List[dict] = []
         try:
             f = open(self.path, "rb")
         except OSError:
             self._pending_offset = None
+            self._pending_seq = None
             return out
         with f:
             f.seek(offset)
@@ -250,7 +281,14 @@ class SubmitJournal:
                     continue
                 seq = int(rec.get("seq", seq + 1) or seq + 1)
                 out.append(rec)
-        self._pending_offset = offset
-        self._pending_seq = seq
+        if offset != start:
+            self._pending_offset = offset
+            self._pending_seq = seq
+        else:
+            # nothing consumed: leave no pending state so an idle
+            # tick's commit() is a no-op instead of an fsync'd
+            # rewrite of an unchanged cursor
+            self._pending_offset = None
+            self._pending_seq = None
         _M_INTAKE_LAG.set(max(0, self._tail_seq() - seq))
         return out

@@ -51,34 +51,60 @@ _M_FRAG = obs_metrics.gauge(
 
 class TorusGrid:
     """Sorted host names folded row-major onto a near-square 2-D
-    torus; distances are wrap-around Manhattan."""
+    torus; distances are wrap-around Manhattan.
+
+    Non-square pools leave the last row partial (``last_w`` cells
+    wide), so the torus is irregular: wraps fold within the VALID
+    extent of the row/column in question — the last row wraps at its
+    own width, a column over the missing tail wraps one row short —
+    rather than landing on missing cells.  :meth:`neighbors` and
+    :meth:`distance` use the same folded geometry, so every neighbor
+    is at distance 1 and connectivity (the fragmentation metric's
+    view) never disagrees with proximity (the carver's view)."""
 
     def __init__(self, hosts: Iterable[str]):
         self.names: List[str] = sorted(hosts)
         n = len(self.names)
         self.cols = max(1, int(math.ceil(math.sqrt(n))))
         self.rows = max(1, int(math.ceil(n / self.cols)))
+        # width of the (possibly partial) last row: == cols when the
+        # grid is a full rectangle
+        self.last_w = n - (self.rows - 1) * self.cols
         self.coord: Dict[str, Tuple[int, int]] = {
             h: (i // self.cols, i % self.cols)
             for i, h in enumerate(self.names)}
+
+    def _row_w(self, r: int) -> int:
+        return self.last_w if r == self.rows - 1 else self.cols
+
+    def _col_h(self, c: int) -> int:
+        return self.rows if c < self.last_w else self.rows - 1
 
     def distance(self, a: str, b: str) -> int:
         (ra, ca), (rb, cb) = self.coord[a], self.coord[b]
         dr = abs(ra - rb)
         dc = abs(ca - cb)
-        return (min(dr, self.rows - dr) + min(dc, self.cols - dc))
+        # wrap extents match neighbors(): a same-row pair wraps at
+        # that row's width, a same-column pair at that column's
+        # height; mixed pairs can route through full rows/columns
+        w = self._row_w(ra) if ra == rb else self.cols
+        hgt = self._col_h(ca) if ca == cb else self.rows
+        return min(dr, max(0, hgt - dr)) + min(dc, max(0, w - dc))
 
     def neighbors(self, h: str) -> List[str]:
         r, c = self.coord[h]
-        out = []
-        for nr, nc in ((r - 1, c), (r + 1, c), (r, c - 1), (r, c + 1)):
-            nr %= self.rows
-            nc %= self.cols
-            i = nr * self.cols + nc
-            if i < len(self.names):
-                n = self.names[i]
-                if n != h:
-                    out.append(n)
+        w = self._row_w(r)
+        hgt = self._col_h(c)
+        cand = []
+        if hgt > 1:
+            cand += [((r - 1) % hgt, c), ((r + 1) % hgt, c)]
+        if w > 1:
+            cand += [(r, (c - 1) % w), (r, (c + 1) % w)]
+        out: List[str] = []
+        for nr, nc in cand:
+            n = self.names[nr * self.cols + nc]
+            if n != h and n not in out:
+                out.append(n)
         return out
 
 

@@ -1171,6 +1171,73 @@ class TestIndexedIntake:
         assert batch == []  # O(new-entries): nothing new, nothing read
         assert cursor["seq"] == 1 and cursor["budget"] > 0
 
+    def test_idle_tick_does_not_rewrite_cursor(self, fleet_dir,
+                                               fake_clock):
+        arb = self._arbiter(fleet_dir)
+        jr = SubmitJournal(str(fleet_dir))
+        jr.append_submit(_spec("only").to_dict())
+        arb.tick()
+        st = os.stat(fleet_dir / "journal.cursor")
+        arb.tick()  # nothing new: no fsync'd rewrite of the cursor
+        st2 = os.stat(fleet_dir / "journal.cursor")
+        # atomic_write replaces via rename, so a rewrite would have
+        # produced a fresh inode
+        assert st2.st_ino == st.st_ino
+
+    def test_cursor_commits_only_after_state_persists(
+            self, fleet_dir, fake_clock, monkeypatch):
+        # the CLI acked these submissions (exit 0): a crash after the
+        # cursor commit but before state.json persists would lose them
+        # forever, so the cursor must not advance until the admitted
+        # jobs are durable
+        arb1 = self._arbiter(fleet_dir)
+        jr = SubmitJournal(str(fleet_dir))
+        for i in range(3):
+            jr.append_submit(_spec(f"j{i}").to_dict())
+
+        def crash():
+            raise RuntimeError("injected crash before state persist")
+
+        monkeypatch.setattr(arb1, "_write_state_json", crash)
+        with pytest.raises(RuntimeError):
+            arb1.tick()
+        # the batch was applied in memory but neither state.json nor
+        # the cursor landed — a fresh incarnation must re-intake it
+        cur = SubmitJournal(str(fleet_dir)).read_cursor()
+        assert cur["seq"] == 0
+        arb2 = self._arbiter(fleet_dir)
+        assert arb2.recover() == 0  # nothing persisted, nothing lost
+        arb2.tick()
+        assert sorted(arb2.jobs) == ["j0", "j1", "j2"]
+
+    def test_dead_writer_torn_tail_repaired_on_next_append(
+            self, fleet_dir, fake_clock):
+        events = []
+        arb = self._arbiter(fleet_dir, events)
+        jr = SubmitJournal(str(fleet_dir))
+        jr.append_submit(_spec("first").to_dict())
+        with open(fleet_dir / "journal.jsonl", "ab") as f:
+            # a writer crashed mid-append and is never coming back
+            f.write(b'{"op": "submit", "seq": 2, "spec"')
+        # the next writer must terminate the dead fragment before
+        # appending, or its (acked!) record merges into the torn line
+        # and both are dropped as one corrupt record
+        jr.append_submit(_spec("second").to_dict())
+        arb.tick()
+        assert sorted(arb.jobs) == ["first", "second"]
+        kinds = [k for k, _ in events]
+        assert "journal_corrupt" in kinds  # the fragment, surfaced
+
+    def test_tail_seq_survives_oversized_record(self, fleet_dir,
+                                                fake_clock):
+        jr = SubmitJournal(str(fleet_dir))
+        big = _spec("big", env={"BLOB": "x" * 200_000}).to_dict()
+        assert jr.append_submit(big) == 1  # one line > the 64KB window
+        # seq numbering must continue, not restart at 1 (duplicate
+        # seqs would break depth() and cursor-based dedup)
+        assert jr.append_submit(_spec("next").to_dict()) == 2
+        assert jr.depth() == 2
+
 
 # ---------------------------------------------------------------------------
 # cancel race: spooled-but-not-intaken jobs (PR 19 satellite)
@@ -1438,6 +1505,26 @@ class TestPlacement:
         assert g.distance("h0", "h2") == 1  # row wrap: 2 -> 0
         assert g.distance("h0", "h3") == 1  # column neighbour
         assert set(g.neighbors("h0")) <= {"h1", "h2", "h3"}
+
+    def test_partial_grid_neighbors_agree_with_distance(self):
+        # non-square pools leave the last torus row partial; wraps
+        # must fold within the valid extent instead of being dropped,
+        # so connectivity (fragmentation's view) and proximity (the
+        # carver's view) describe the same geometry
+        for n in (3, 5, 7, 10, 13, 32):
+            g = TorusGrid([f"h{i:02d}" for i in range(n)])
+            for h in g.names:
+                nbs = g.neighbors(h)
+                assert h not in nbs and len(nbs) == len(set(nbs))
+                if n > 1:
+                    assert nbs, f"n={n}: {h} isolated"
+                for nb in nbs:
+                    assert g.distance(h, nb) == 1, (n, h, nb)
+            # the fully-free pool is one connected region — hosts in
+            # the partial row included
+            p = PlacementPolicy()
+            free = {h: 1 for h in g.names}
+            assert p.fragmentation(free, g.names) == 0.0, n
 
     def test_best_fit_picks_tightest_single_host(self):
         p = PlacementPolicy()
