@@ -186,6 +186,16 @@ REQUIRED_METRIC_KEYS = (
     "hvtpu_fleet_intake_lag",
     "hvtpu_fleet_admission_rejections_total",
     "hvtpu_fleet_fragmentation",
+    # wire-plane fault tolerance (PR 20, comm/wirefault.py): retries
+    # and the consensus histogram are 0 on a healthy run — a nonzero
+    # count names a round where a collective attempt was agreed dead
+    # and reissued instead of restarting the job; link_health is the
+    # worst per-peer degradation score (0 = every link clean) and
+    # reroutes counts ring permutations taken around a sick link.
+    "hvtpu_collective_retries_total",
+    "hvtpu_collective_abort_consensus_seconds",
+    "hvtpu_link_health",
+    "hvtpu_ring_reroutes_total",
 )
 
 

@@ -76,6 +76,7 @@ from ..core.exceptions import HorovodInternalError
 from ..obs import flight
 from ..obs import tracing
 from ..obs import metrics as obs_metrics
+from . import wirefault
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -427,6 +428,13 @@ class AmortizedStallInspector:
         # rank -> (last beat number, when it last changed); touched
         # only from the heartbeat thread
         self._peer_seen: Dict[int, tuple] = {}
+        # per-peer wire-link health folded out of this same heartbeat
+        # stream (comm/wirefault.py): the beat thread writes arrival
+        # gaps / losses, the data plane and /debug read scores
+        self.link_health = wirefault.LinkHealth(
+            expect_s=max(heartbeat_s, 0.02))
+        # lazily-created abort-retry consensus (comm/wirefault.py)
+        self._wire_consensus = None
         self.gen = generation
         self._lock = threading.Lock()
         self._tracks: Dict[str, _SetTrack] = {}
@@ -503,10 +511,25 @@ class AmortizedStallInspector:
         ``(result, pending)`` where ``pending`` is True when the
         result was still in flight the moment ``fn`` returned
         (sampled on the executor thread, before handoff latency can
-        hide it) — the caller's async-dispatch proof."""
+        hide it) — the caller's async-dispatch proof.  Every error
+        path clears the in-flight marker: a failed attempt must never
+        trip a later healthy op into a false stall abort (a retry
+        re-arms the marker from the ring via ``_rearm``)."""
         with self._lock:
             if self.failure:
+                tr = self._tracks.get(str(set_id))
+                if tr is not None:
+                    tr.inflight = None
                 raise HorovodInternalError(self.failure)
+        # Fault site ``collective.exec``: the attempt dies on this
+        # rank's dispatching thread after bytes may already be in
+        # flight — transport-shaped, so the wire retry loop in the
+        # module-level ``dispatch`` classifies it as a mid-flight
+        # failure (never eligible for a late join).
+        if faults.ACTIVE and faults.inject("collective.exec"):
+            self._clear_inflight(set_id)
+            raise ConnectionError(
+                "Connection reset: injected collective.exec fault")
         if self._exec_thread is None or not self._exec_thread.is_alive():
             self._exec_thread = threading.Thread(
                 target=self._exec_loop, name="hvt-stall-dispatch",
@@ -525,6 +548,11 @@ class AmortizedStallInspector:
                 self._exec_thread = None
                 raise HorovodInternalError(self.failure)
         if box[2] is not None:
+            # the attempt failed on the executor thread: drop the
+            # in-flight marker before surfacing (leak here meant the
+            # NEXT healthy op inherited a stale marker and aged into
+            # a false stall abort)
+            self._clear_inflight(set_id)
             raise box[2]
         return box[1], box[3]
 
@@ -555,20 +583,25 @@ class AmortizedStallInspector:
         self._rearm(set_id, desc)
         sleep = 0.0
         waited = 0.0
-        while is_ready is not None and not is_ready():
-            if self.failure:
-                _latch_poison(self)
-                self._clear_inflight(set_id)
-                raise HorovodInternalError(self.failure)
-            # back off from a near-spin (small ops land in <1 ms)
-            # to a 0.5 ms poll, then to 5 ms once the op has clearly
-            # left the small-op regime — bounds both the overshoot
-            # (sub-1% of the op at every scale) and the poll rate
-            waited += sleep
-            cap = 5e-4 if waited < 0.02 else 5e-3
-            sleep = min(cap, sleep * 2 if sleep else 5e-5)
-            clock.sleep(sleep)
-        self._clear_inflight(set_id)
+        try:
+            while is_ready is not None and not is_ready():
+                if self.failure:
+                    _latch_poison(self)
+                    raise HorovodInternalError(self.failure)
+                # back off from a near-spin (small ops land in <1 ms)
+                # to a 0.5 ms poll, then to 5 ms once the op has
+                # clearly left the small-op regime — bounds both the
+                # overshoot (sub-1% of the op at every scale) and the
+                # poll rate
+                waited += sleep
+                cap = 5e-4 if waited < 0.02 else 5e-3
+                sleep = min(cap, sleep * 2 if sleep else 5e-5)
+                clock.sleep(sleep)
+        finally:
+            # also on error paths (``is_ready`` raising, the latch
+            # above): a stale marker would trip a later healthy op
+            # into a false stall abort
+            self._clear_inflight(set_id)
         if self.failure:
             # the collective completed but the job is already failed
             # (e.g. a peer diverged on another set) — surface it now
@@ -579,6 +612,31 @@ class AmortizedStallInspector:
             tr = self._tracks.get(str(set_id))
             if tr is not None:
                 tr.inflight = None
+
+    def op_info(self, set_id, desc: Optional[str] = None):
+        """``(seq, members, desc)`` of the newest op on this set — the
+        wire-consensus identity of a failed attempt (``desc`` falls
+        back to the in-flight marker, then the ring tail, because a
+        failed attempt already cleared the marker)."""
+        with self._lock:
+            tr = self._tracks.get(str(set_id))
+            if tr is None:
+                return 0, (), desc or ""
+            d = desc or tr.inflight
+            if d is None and tr.ring:
+                d = tr.ring[-1][1]
+            return tr.seq - 1, tr.members, d or ""
+
+    def wire_consensus(self):
+        """This rank's abort-retry consensus over the same fenced KV
+        and heartbeat namespace the watchdog already uses (lazy: jobs
+        with retries disabled never touch it)."""
+        c = self._wire_consensus
+        if c is None:
+            c = self._wire_consensus = wirefault.WireConsensus(
+                self._kv, self.rank, generation=self.gen,
+                hb_prefix=f"{_HB}/{self.gen}/")
+        return c
 
     def debug_state(self) -> dict:
         """/debug provider payload: per-peer heartbeat ages (seconds
@@ -598,6 +656,7 @@ class AmortizedStallInspector:
             "suspect_s": self.suspect_s,
             "partition_suspects": sorted(self._suspected),
             "peer_heartbeat_age_s": ages,
+            "link_health": self.link_health.snapshot(),
             "failure": self.failure,
         }
 
@@ -690,10 +749,25 @@ class AmortizedStallInspector:
             if r not in latest or b > latest[r][0]:
                 latest[r] = (b, v)
         now = clock.monotonic()
+        lh = self.link_health
         for r, (b, _v) in latest.items():
             prev = self._peer_seen.get(r)
-            if prev is None or b != prev[0]:
+            if prev is None:
                 self._peer_seen[r] = (b, now)
+            elif b != prev[0]:
+                # beat advanced: the per-beat arrival gap feeds the
+                # link latency EWMA; beats skipped in between count
+                # as losses (posted but never seen live)
+                lh.observe(r, gap_s=(now - prev[1]) / max(1, b - prev[0]))
+                for _ in range(min(3, b - prev[0] - 1)):
+                    lh.observe(r, lost=True)
+                self._peer_seen[r] = (b, now)
+            elif now - prev[1] > 2 * self.heartbeat_s:
+                # overdue with no new beat (a flapping link drops
+                # them outright): one loss observation per own beat
+                # until the peer recovers or goes stale
+                lh.observe(r, lost=True)
+        lh.publish()
         _M_HB_AGE.set(max(
             (now - t for _b, t in self._peer_seen.values()),
             default=0.0))
@@ -1017,6 +1091,51 @@ def _pending_leaf(out) -> bool:
     return False
 
 
+def _execute_once(insp, sid, fn, args, owner, desc):
+    """One collective attempt (amortized mode): the wire fault sites
+    wrap the real execution, and transport-shaped failures re-raise as
+    :class:`wirefault.AttemptFailed` so the retry loop in ``dispatch``
+    can run the abort consensus.  Everything else surfaces unchanged.
+    The in-flight marker is cleared on EVERY error path (a failed
+    attempt must never trip a later healthy op into a false stall
+    abort; a re-dispatch re-arms the marker from the ring)."""
+    try:
+        # Fault site ``wire.send``: the attempt dies BEFORE dispatch
+        # put any bytes on the wire — the only failure class eligible
+        # to late-join a still-pending attempt.
+        if faults.ACTIVE and faults.inject("wire.send"):
+            raise wirefault.AttemptFailed(True, ConnectionError(
+                "Connection reset: injected wire.send fault"))
+        try:
+            if getattr(owner, "_hvt_async_proven", False):
+                if insp.failure:
+                    raise HorovodInternalError(insp.failure)
+                out = fn(*args)
+            else:
+                out, pending = insp.dispatch(sid, fn, args, desc)
+                if pending:
+                    try:
+                        owner._hvt_async_proven = True
+                    except Exception:
+                        pass
+        except (HorovodInternalError, wirefault.AttemptFailed):
+            raise
+        except Exception as e:
+            if any(m in str(e) for m in _TRANSPORT_MARKERS):
+                raise wirefault.AttemptFailed(False, e) from e
+            raise
+        # Fault site ``wire.recv``: the result was torn off the wire
+        # after dispatch — mid-flight, so a retry is only granted when
+        # consensus proves no member completed the attempt.
+        if faults.ACTIVE and faults.inject("wire.recv"):
+            raise wirefault.AttemptFailed(False, ConnectionError(
+                "Connection reset: injected wire.recv fault"))
+        return out
+    except BaseException:
+        insp._clear_inflight(sid)
+        raise
+
+
 def dispatch(st, ps, fn, args, owner=None, set_id=None, desc=None):
     """The guarded execution hook (amortized mode).
 
@@ -1031,35 +1150,50 @@ def dispatch(st, ps, fn, args, owner=None, set_id=None, desc=None):
     main thread interruptible.  ``owner`` is the stable callable to
     carry the proof (defaults to ``fn``; pass it when ``fn`` is a
     per-call closure).  Direct call for strict/disabled modes and the
-    controller's bypass thread."""
+    controller's bypass thread.
+
+    With ``HVTPU_WIRE_RETRIES`` > 0 a transport-shaped attempt failure
+    is not immediately job-fatal: the rank votes the attempt dead over
+    the fenced KV and reissues it only once the member ranks agree
+    nobody holds its result (comm/wirefault.py — RETRY reissues the
+    next attempt, LATE_JOIN re-enters the same still-pending attempt,
+    ESCALATE falls through to the pre-existing
+    ``HorovodInternalError`` → elastic-reset path)."""
     insp = st.sync_stall
     if (not isinstance(insp, AmortizedStallInspector)
             or ps.size <= 1 or getattr(_tls, "bypass", False)):
         return fn(*args)
     owner = owner if owner is not None else fn
-    if getattr(owner, "_hvt_async_proven", False):
-        if insp.failure:
-            raise HorovodInternalError(insp.failure)
+    sid = ps.process_set_id if set_id is None else set_id
+    budget = wirefault.retry_limit()
+    attempt = 0
+    fails = 0
+    while True:
         try:
-            return fn(*args)
-        except HorovodInternalError:
-            raise
-        except Exception as e:
-            _map_backend_error(insp, e)
-    try:
-        out, pending = insp.dispatch(
-            ps.process_set_id if set_id is None else set_id, fn, args,
-            desc)
-    except HorovodInternalError:
-        raise
-    except Exception as e:
-        _map_backend_error(insp, e)
-    if pending:
-        try:
-            owner._hvt_async_proven = True
-        except Exception:
-            pass
-    return out
+            out = _execute_once(insp, sid, fn, args, owner, desc)
+            if fails:
+                seq, _members, _d = insp.op_info(sid, desc)
+                insp.wire_consensus().cleanup(sid, seq, attempt)
+            return out
+        except wirefault.AttemptFailed as af:
+            # late joins consume budget too, or a flapping link could
+            # re-enter the same attempt forever
+            fails += 1
+            if fails > budget or insp.failure:
+                _map_backend_error(insp, af.cause)
+            seq, members, d = insp.op_info(sid, desc)
+            decision = insp.wire_consensus().vote_and_decide(
+                sid, seq, attempt, members, d, af.predispatch)
+            if decision == wirefault.ESCALATE:
+                _map_backend_error(insp, af.cause)
+            wirefault.record_retry(insp.rank, sid, seq, attempt,
+                                   decision)
+            if decision == wirefault.RETRY:
+                # every member reissues the NEXT attempt (late joins
+                # re-enter the same one) — backoff scales with the
+                # attempt so a persistently lossy link drains fast
+                attempt += 1
+                clock.sleep(wirefault.retry_backoff_s() * attempt)
 
 
 def finish(st, ps, out, desc: Optional[str] = None):

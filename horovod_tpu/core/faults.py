@@ -16,9 +16,11 @@ Grammar (full reference in docs/robustness.md)::
     SITE   := kv.get | kv.put | heartbeat | collective.pre
             | collective.post | worker.step | data.next
             | ckpt.write | ckpt.fsync | ckpt.rename
+            | wire.send | wire.recv | collective.exec
     ACTION := drop | delay(MS) | error | kill | preempt
             | corrupt | corrupt(nan) | corrupt(bitflip)
             | torn | bitflip | partition(MS)
+            | slow(MS) | flap(MS)
     SEL    := rank=R[|R...] | pset=ID | count=N | prob=P | times=K
 
 Examples::
@@ -40,6 +42,22 @@ Examples::
                                          # dropped for 3 seconds (a
                                          # network partition, not a
                                          # single lost op)
+    wire.send:drop@rank=0,count=2        # rank 0's 2nd wire send is
+                                         # lost — the consensus abort-
+                                         # and-retry path (comm/
+                                         # wirefault.py) must recover
+                                         # the collective
+    wire.recv:slow(100)@rank=3           # rank 3's link serializes
+                                         # 100ms slower (a sick link
+                                         # the LinkHealth route-around
+                                         # should demote)
+    wire.send:flap(2000)@rank=1,count=5  # from rank 1's 5th send, its
+                                         # wire link goes DOWN for 2
+                                         # seconds: every wire.send/
+                                         # wire.recv/collective.exec
+                                         # in the window is dropped (a
+                                         # flapping link, not one lost
+                                         # packet)
 
 Selector semantics:
 
@@ -92,9 +110,21 @@ logger = logging.getLogger("horovod_tpu")
 #: survive.
 SITES = ("kv.get", "kv.put", "heartbeat", "collective.pre",
          "collective.post", "worker.step", "data.next",
-         "ckpt.write", "ckpt.fsync", "ckpt.rename")
+         "ckpt.write", "ckpt.fsync", "ckpt.rename",
+         "wire.send", "wire.recv", "collective.exec")
 
 _STORAGE_SITES = ("ckpt.write", "ckpt.fsync", "ckpt.rename")
+
+#: WIRE sites: the data plane's collective exchange itself
+#: (comm/stall.py dispatch for the real backend; the per-edge hop
+#: exchange in sim scenarios).  ``drop`` there loses one send/recv/
+#: execution (surfacing as a transport-shaped error the consensus
+#: abort-and-retry plane in comm/wirefault.py classifies as
+#: retryable), ``slow(MS)`` adds serialization delay on the sick link,
+#: and ``flap(MS)`` takes the WHOLE wire link down for a window —
+#: every wire-site operation on this rank inside the window is
+#: dropped, the link-level analog of ``partition(MS)``.
+_WIRE_SITES = ("wire.send", "wire.recv", "collective.exec")
 
 #: Coordination-plane sites a ``partition(MS)`` clause silences as a
 #: unit.  Unlike ``drop`` (one lost operation), a fired partition opens
@@ -106,7 +136,7 @@ _STORAGE_SITES = ("ckpt.write", "ckpt.fsync", "ckpt.rename")
 _PARTITION_SITES = ("kv.get", "kv.put", "heartbeat")
 
 ACTIONS = ("drop", "delay", "error", "kill", "preempt", "corrupt",
-           "torn", "bitflip", "partition")
+           "torn", "bitflip", "partition", "slow", "flap")
 
 #: Module-level fast path: False means ``inject`` is never entered.
 ACTIVE = False
@@ -147,25 +177,29 @@ class InjectedFault(RuntimeError):
 _DELAY_RE = re.compile(r"^delay\((\d+(?:\.\d+)?)\)$")
 _CORRUPT_RE = re.compile(r"^corrupt(?:\((nan|bitflip)\))?$")
 _PARTITION_RE = re.compile(r"^partition\((\d+(?:\.\d+)?)\)$")
+_SLOW_RE = re.compile(r"^slow\((\d+(?:\.\d+)?)\)$")
+_FLAP_RE = re.compile(r"^flap\((\d+(?:\.\d+)?)\)$")
 
 
 class FaultClause:
     """One parsed ``site:action[@selectors]`` clause."""
 
     __slots__ = ("site", "action", "delay_ms", "corrupt_mode",
-                 "partition_ms", "ranks", "pset", "count", "prob",
-                 "times", "index", "source", "_fired", "_seen", "_rng")
+                 "partition_ms", "flap_ms", "ranks", "pset", "count",
+                 "prob", "times", "index", "source", "_fired", "_seen",
+                 "_rng")
 
     def __init__(self, site: str, action: str, delay_ms: float,
                  ranks: Optional[frozenset], pset: Optional[int],
                  count: int, prob: Optional[float], times: int,
                  index: int, source: str, corrupt_mode: str = "nan",
-                 partition_ms: float = 0.0):
+                 partition_ms: float = 0.0, flap_ms: float = 0.0):
         self.site = site
         self.action = action
         self.delay_ms = delay_ms
         self.corrupt_mode = corrupt_mode
         self.partition_ms = partition_ms
+        self.flap_ms = flap_ms
         self.ranks = ranks          # None = all ranks
         self.pset = pset            # None = any process set
         self.count = count          # fire from the count-th match (1-based)
@@ -228,15 +262,22 @@ def parse_spec(spec: str) -> List[FaultClause]:
         delay_ms = 0.0
         corrupt_mode = "nan"
         partition_ms = 0.0
+        flap_ms = 0.0
         m = _DELAY_RE.match(action_s)
         mc = _CORRUPT_RE.match(action_s)
         mp = _PARTITION_RE.match(action_s)
+        ms = _SLOW_RE.match(action_s)
+        mf = _FLAP_RE.match(action_s)
         if m:
             action, delay_ms = "delay", float(m.group(1))
         elif mc:
             action, corrupt_mode = "corrupt", mc.group(1) or "nan"
         elif mp:
             action, partition_ms = "partition", float(mp.group(1))
+        elif ms:
+            action, delay_ms = "slow", float(ms.group(1))
+        elif mf:
+            action, flap_ms = "flap", float(mf.group(1))
         elif action_s in ("drop", "error", "kill", "preempt",
                           "torn", "bitflip"):
             action = action_s
@@ -244,21 +285,40 @@ def parse_spec(spec: str) -> List[FaultClause]:
             raise FaultSpecError(
                 f"fault clause {raw!r}: unknown action {action_s!r} "
                 "(known: drop, delay(MS), error, kill, preempt, "
-                "corrupt[(nan|bitflip)], torn, bitflip, partition(MS))")
+                "corrupt[(nan|bitflip)], torn, bitflip, partition(MS), "
+                "slow(MS), flap(MS))")
+        if action in ("torn", "bitflip") and site in _WIRE_SITES:
+            raise FaultSpecError(
+                f"fault clause {raw!r}: action {action!r} damages a "
+                f"STORED byte stream and only applies at storage sites "
+                f"({', '.join(_STORAGE_SITES)}); wire sites "
+                f"({', '.join(_WIRE_SITES)}) carry no durable bytes to "
+                f"tear — use drop, slow(MS) or flap(MS) there")
         if action in ("torn", "bitflip") and site not in _STORAGE_SITES:
             raise FaultSpecError(
                 f"fault clause {raw!r}: action {action!r} only applies "
                 f"at storage sites ({', '.join(_STORAGE_SITES)})")
+        if action == "corrupt" and site in _WIRE_SITES:
+            raise FaultSpecError(
+                f"fault clause {raw!r}: action 'corrupt' poisons tensor "
+                f"payloads and only applies at tensor sites "
+                f"(collective.pre, collective.post); wire sites carry "
+                f"no tensor to poison — use drop, slow(MS) or flap(MS)")
         if action == "partition" and site not in _PARTITION_SITES:
             raise FaultSpecError(
                 f"fault clause {raw!r}: action 'partition' only applies "
                 f"at coordination sites ({', '.join(_PARTITION_SITES)})")
+        if action in ("slow", "flap") and site not in _WIRE_SITES:
+            raise FaultSpecError(
+                f"fault clause {raw!r}: action {action!r} only applies "
+                f"at wire sites ({', '.join(_WIRE_SITES)})")
         ranks = pset = prob = None
         count = 1
         # one-shot by default: a rank dies (kill), departs (preempt),
-        # or loses the network (partition) at most once per job unless
-        # times= says otherwise
-        times = 1 if action in ("kill", "preempt", "partition") else 0
+        # loses the network (partition) or its wire link (flap) at
+        # most once per job unless times= says otherwise
+        times = 1 if action in ("kill", "preempt", "partition",
+                                "flap") else 0
         for sel in filter(None, (s.strip() for s in sel_s.split(","))):
             if "=" not in sel:
                 raise FaultSpecError(
@@ -295,7 +355,7 @@ def parse_spec(spec: str) -> List[FaultClause]:
         clauses.append(FaultClause(
             site, action, delay_ms, ranks, pset, count, prob, times,
             index=len(clauses), source=raw, corrupt_mode=corrupt_mode,
-            partition_ms=partition_ms))
+            partition_ms=partition_ms, flap_ms=flap_ms))
     return clauses
 
 
@@ -323,6 +383,10 @@ class FaultRegistry:
         # virtual) clock during which EVERY _PARTITION_SITES operation
         # on this registry is dropped — one clause, full silence
         self._partition_until = 0.0  # hvtpulint: guarded-by(_lock)
+        # a fired flap(MS) clause opens the same kind of window over
+        # the WIRE sites: the rank's data-plane link is down, every
+        # wire.send/wire.recv/collective.exec in the window is dropped
+        self._flap_until = 0.0  # hvtpulint: guarded-by(_lock)
         self._by_site: Dict[str, List[FaultClause]] = {}
         for c in clauses:
             c.bind(rank, seed, self._load_fired(c))
@@ -388,11 +452,21 @@ class FaultRegistry:
             "hvtpu fault injection: firing [%s] at site %s (rank %d%s)",
             fired.source, site, self.rank,
             f", op {detail}" if detail else "")
-        if fired.action == "delay":
+        if fired.action in ("delay", "slow"):
             clock.sleep(fired.delay_ms / 1000.0)
             return False
         if fired.action == "drop":
             return True
+        if fired.action == "flap":
+            until = clock.monotonic() + fired.flap_ms / 1000.0
+            with self._lock:
+                self._flap_until = max(self._flap_until, until)
+            from ..obs import flight as _flight
+
+            if _flight.ACTIVE:
+                _flight.note("link_flap_start", rank=self.rank,
+                             window_ms=fired.flap_ms, site=site)
+            return True  # the triggering op is the window's first loss
         if fired.action == "partition":
             until = clock.monotonic() + fired.partition_ms / 1000.0
             with self._lock:
@@ -438,6 +512,12 @@ class FaultRegistry:
             until = self._partition_until
         return max(0.0, until - clock.monotonic())
 
+    def flap_remaining(self) -> float:
+        """Seconds left in an open wire-flap window (0.0 when none)."""
+        with self._lock:
+            until = self._flap_until
+        return max(0.0, until - clock.monotonic())
+
     def inject(self, site: str, pset=None, detail: Optional[str] = None
                ) -> bool:
         # An open partition window silences every coordination site on
@@ -447,6 +527,13 @@ class FaultRegistry:
                 partitioned = (self._partition_until
                                and clock.monotonic() < self._partition_until)
             if partitioned:
+                return True
+        # Likewise a flapping wire link drops every wire-site op.
+        if site in _WIRE_SITES:
+            with self._lock:
+                flapping = (self._flap_until
+                            and clock.monotonic() < self._flap_until)
+            if flapping:
                 return True
         fired = self._select(site, pset, tensor_site=False)
         if fired is None:
@@ -630,3 +717,12 @@ def partition_remaining() -> float:
     if reg is None:
         return 0.0
     return reg.partition_remaining()
+
+
+def flap_remaining() -> float:
+    """Seconds left in the calling thread's open ``flap(MS)`` wire
+    window (0.0 when none is armed/open) — test and sim probe."""
+    reg = _current()
+    if reg is None:
+        return 0.0
+    return reg.flap_remaining()

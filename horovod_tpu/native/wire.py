@@ -127,6 +127,33 @@ def fnv1a64(data: bytes) -> int:
     return h
 
 
+# --- retry attempt tags ----------------------------------------------
+# The consensus abort-and-retry plane (comm/wirefault.py) reissues a
+# dead collective under ATTEMPT-TAGGED wire keys so a late packet from
+# an aborted attempt can never be mistaken for the live one.  The tag
+# rides INSIDE the existing variable-length name/key strings — entry
+# names, KV exchange keys — so the wire format itself is unchanged
+# (same WIRE_VERSION, byte-identical twins).  Attempt 0 is untagged:
+# the healthy path serializes exactly the bytes it always did.
+_ATTEMPT_SEP = "#a"
+
+
+def attempt_tag(name: str, attempt: int) -> str:
+    """Tag a wire key / tensor name with a retry attempt number
+    (attempt 0 → the name unchanged)."""
+    if attempt <= 0:
+        return name
+    return f"{name}{_ATTEMPT_SEP}{attempt}"
+
+
+def split_attempt(name: str) -> Tuple[str, int]:
+    """Inverse of :func:`attempt_tag`: ``(base_name, attempt)``."""
+    base, sep, tail = name.rpartition(_ATTEMPT_SEP)
+    if sep and tail.isdigit():
+        return base, int(tail)
+    return name, 0
+
+
 # Byte offset of the RequestList flags byte: magic u32 + version u32 +
 # rank i32 + joined u8 + shutdown u8.
 _FLAGS_OFFSET = 4 + 4 + 4 + 1 + 1

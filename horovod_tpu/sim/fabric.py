@@ -49,7 +49,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .kernel import SimKernel, WaitToken
 
-__all__ = ["LinkModel", "SimFabric"]
+__all__ = ["LinkModel", "EdgeModel", "SimFabric"]
 
 
 def _env_float(name: str, default: float) -> float:
@@ -83,6 +83,57 @@ class LinkModel:
         return base * (1.0 + self.jitter_frac * self._rng.random())
 
 
+class EdgeModel:
+    """One directed DATA-plane edge ``src → dst`` between ranks.
+
+    Where :class:`LinkModel` shapes a rank's path to the coordination
+    service, an edge shapes the peer-to-peer wire the ring collectives
+    ride (the ``lossy-link`` scenario's hop model).  On top of the
+    latency/bandwidth/jitter triple it carries a seeded per-send loss
+    probability and an optional periodic FLAP window during which the
+    edge drops everything — the two failure shapes "Demystifying NCCL"
+    reports from production fabrics."""
+
+    __slots__ = ("latency_s", "bandwidth_bps", "jitter_frac",
+                 "loss_prob", "flap_period_s", "flap_down_s",
+                 "flap_start_s", "_rng")
+
+    def __init__(self, latency_s: float, bandwidth_bps: float,
+                 jitter_frac: float, rng, loss_prob: float = 0.0,
+                 flap_period_s: float = 0.0, flap_down_s: float = 0.0,
+                 flap_start_s: float = 0.0):
+        self.latency_s = max(0.0, float(latency_s))
+        self.bandwidth_bps = max(1.0, float(bandwidth_bps))
+        self.jitter_frac = max(0.0, float(jitter_frac))
+        self.loss_prob = min(1.0, max(0.0, float(loss_prob)))
+        self.flap_period_s = max(0.0, float(flap_period_s))
+        self.flap_down_s = max(0.0, float(flap_down_s))
+        self.flap_start_s = max(0.0, float(flap_start_s))
+        self._rng = rng
+
+    def delay(self, nbytes: int) -> float:
+        base = self.latency_s + nbytes / self.bandwidth_bps
+        if not self.jitter_frac:
+            return base
+        return base * (1.0 + self.jitter_frac * self._rng.random())
+
+    def up(self, now: float) -> bool:
+        """False while inside a flap's down window (the first
+        ``flap_down_s`` of each period, starting at ``flap_start_s``)."""
+        if self.flap_period_s <= 0.0 or now < self.flap_start_s:
+            return True
+        phase = (now - self.flap_start_s) % self.flap_period_s
+        return phase >= self.flap_down_s
+
+    def lost(self, now: float) -> bool:
+        """One send's fate at virtual instant ``now``: dropped by the
+        flap window, or by the seeded per-send loss draw."""
+        if not self.up(now):
+            return True
+        return bool(self.loss_prob
+                    and self._rng.random() < self.loss_prob)
+
+
 class SimFabric:
     """The simulated coordination service: one store, per-rank links,
     park-and-notify blocking gets, and operation counters."""
@@ -104,6 +155,7 @@ class SimFabric:
         self._store: Dict[str, object] = {}
         self._waiters: Dict[str, List[WaitToken]] = {}
         self._links: Dict[int, LinkModel] = {}
+        self._edges: Dict[Tuple[int, int], EdgeModel] = {}
         self._down = False
         self.ops = collections.Counter()
 
@@ -149,6 +201,63 @@ class SimFabric:
             base.jitter_frac if jitter_frac is None else jitter_frac,
             self.kernel.rng(f"link/{rank}"))
         return self._links[rank]
+
+    # -- data-plane edges ----------------------------------------------
+    def edge(self, src: int, dst: int) -> EdgeModel:
+        model = self._edges.get((src, dst))
+        if model is None:
+            model = EdgeModel(
+                self._latency_s, self._bandwidth_bps, self._jitter_frac,
+                self.kernel.rng(f"edge/{src}/{dst}"))
+            self._edges[(src, dst)] = model
+        return model
+
+    def set_edge(self, src: int, dst: int, *,
+                 latency_s: Optional[float] = None,
+                 bandwidth_bps: Optional[float] = None,
+                 jitter_frac: Optional[float] = None,
+                 loss_prob: Optional[float] = None) -> EdgeModel:
+        """Override one directed edge (sick-link shaping); unset
+        fields keep the edge's current values."""
+        base = self.edge(src, dst)
+        model = EdgeModel(
+            base.latency_s if latency_s is None else latency_s,
+            base.bandwidth_bps if bandwidth_bps is None
+            else bandwidth_bps,
+            base.jitter_frac if jitter_frac is None else jitter_frac,
+            self.kernel.rng(f"edge/{src}/{dst}"),
+            loss_prob=base.loss_prob if loss_prob is None else loss_prob,
+            flap_period_s=base.flap_period_s,
+            flap_down_s=base.flap_down_s,
+            flap_start_s=base.flap_start_s)
+        self._edges[(src, dst)] = model
+        return model
+
+    def flap(self, src: int, dst: int, *, period_s: float,
+             down_s: float, start_s: float = 0.0) -> EdgeModel:
+        """Make the edge flap: down for the first ``down_s`` of every
+        ``period_s`` window, beginning at virtual time ``start_s``."""
+        base = self.edge(src, dst)
+        base.flap_period_s = max(0.0, float(period_s))
+        base.flap_down_s = max(0.0, float(down_s))
+        base.flap_start_s = max(0.0, float(start_s))
+        return base
+
+    def edge_up(self, src: int, dst: int) -> bool:
+        return self.edge(src, dst).up(self.kernel.now)
+
+    def edge_lost(self, src: int, dst: int) -> bool:
+        """Decide one send's fate on the edge NOW (counts toward the
+        fabric's op counters so scenarios can audit loss volume)."""
+        lost = self.edge(src, dst).lost(self.kernel.now)
+        if lost:
+            self.ops["edge_lost"] += 1
+        else:
+            self.ops["edge_send"] += 1
+        return lost
+
+    def edge_delay(self, src: int, dst: int, nbytes: int) -> float:
+        return self.edge(src, dst).delay(nbytes)
 
     # -- client facades -------------------------------------------------
     def client(self, rank: int, caps: str = "bytes"):

@@ -92,6 +92,17 @@ fleet-service             the production front door: a seeded
                           exactly-once intake, budget-bounded per-tick
                           cost, named quota rejections, and a bounded
                           post-aging wait for the starved probe gang.
+lossy-link                the wire plane under seeded per-send loss,
+                          deterministic ``wire.send:drop`` faults and
+                          a link flap window: every failed ring
+                          collective runs the REAL consensus
+                          abort-and-retry (comm/wirefault.py) under
+                          attempt-tagged wire keys, and the link-
+                          health map reroutes the ring around the
+                          flapping rank.  Asserts zero restarts, zero
+                          torn collectives, and bitwise-clean retried
+                          results; ``baseline=True`` disables retries
+                          and must poison the job instead.
 compression-negotiation   mixed-precision negotiation through the
                           real controller: a dense fp32 allreduce
                           plus an int8-compressed sidecar per cycle.
@@ -2535,6 +2546,283 @@ def fleet_service(ranks: int, seed: int = 0, *,
 
 
 # ---------------------------------------------------------------------------
+# lossy-link: wire-plane consensus abort-and-retry + route-around
+# ---------------------------------------------------------------------------
+
+def lossy_link(ranks: int, seed: int = 0, *, steps: int = 10,
+               retries: int = 3, loss_prob: float = 0.2,
+               flap_down_s: float = 3.0, hop_timeout_s: float = 2.0,
+               consensus_s: float = 30.0, baseline: bool = False) -> Dict:
+    """The wire plane under a lossy fabric: each step is one ring
+    exchange (every rank sends a deterministic payload to its ring
+    successor over a :class:`~.fabric.EdgeModel` data edge and blocks
+    on its predecessor's, then a done-gather + commit barrier seals the
+    step).  Three victims shape the chaos: ``va`` gets two
+    deterministic ``wire.send:drop`` fault firings (core/faults.py,
+    the new parser-gated wire site), ``vb``'s outgoing edge FLAPS for
+    ``flap_down_s`` mid-run (``SimFabric.flap``), and ``vc``'s
+    outgoing edge drops each send with seeded probability
+    ``loss_prob``.
+
+    Every failure runs the REAL :class:`~..comm.wirefault.WireConsensus`
+    over the fabric KV — all member ranks vote attempt *k* dead before
+    anyone reissues attempt *k+1* under attempt-tagged keys
+    (``native/wire.py::attempt_tag``) — and rank 0 folds per-hop loss
+    reports into the REAL :class:`~..comm.wirefault.LinkHealth` map,
+    re-ordering the ring to demote a degraded rank to the tail.
+    Asserts: zero restarts, zero torn steps (every rank delivers the
+    SAME attempt), every delivered value bitwise-equal to the clean
+    result for the ring in effect, ≥2 consensus retries and ≥1
+    reroute.  ``baseline=True`` disables retries: the same seed must
+    then poison the job, and the result records the steps lost to the
+    restart-the-world recovery."""
+    from ..comm import wirefault
+    from ..core import faults as core_faults
+    from ..native.wire import attempt_tag
+    from ..obs import metrics as obs_metrics
+
+    assert ranks >= 8, "lossy-link needs >= 8 ranks for distinct victims"
+    va, vb, vc = ranks // 4, ranks // 2, (3 * ranks) // 4
+    budget = 0 if baseline else max(0, retries)
+    drop_step, flap_step = 1, max(3, steps // 2)
+    kernel, fabric = _fresh(ranks, seed)
+    fabric.set_edge(vc, (vc + 1) % ranks, loss_prob=loss_prob)
+    # rank 0's view of link health, fed from the steps' loss reports
+    lh = wirefault.LinkHealth(expect_s=0.5, alpha=0.3)
+
+    retries_before = obs_metrics.counter(
+        "hvtpu_collective_retries_total").value()
+    reroutes_before = obs_metrics.counter(
+        "hvtpu_ring_reroutes_total").value()
+
+    members = list(range(ranks))
+    delivered: Dict[int, Dict[int, tuple]] = {}  # rank -> step -> (att, v)
+    orders: List[List[int]] = []                 # ring in effect per step
+    retry_rounds: Dict[int, int] = {}            # step -> consensus rounds
+    cons_lat: List[float] = []
+    poison_box: List[dict] = []
+    completed: Dict[int, int] = {}
+
+    def value(r: int, s: int) -> int:
+        # attempt-independent: a retried delivery is bitwise-equal to
+        # the clean one by construction, so equality PROVES the job
+        # never consumed bytes from an aborted attempt
+        return (r * 1315423911 + s * 2654435761) % (2 ** 31)
+
+    class _Lost(Exception):
+        def __init__(self, why: str, frm: Optional[int] = None):
+            super().__init__(why)
+            self.frm = frm  # rank whose link dropped it, when known
+
+    def make(rank: int):
+        spec = (f"wire.send:drop@rank={va},count={drop_step + 1},times=2"
+                if rank == va else "")
+
+        def body():
+            ctx = RankContext(kernel, rank, ranks, fault_spec=spec,
+                              generation=0)
+            client = fabric.client(rank, caps="dir")
+            wc = wirefault.WireConsensus(
+                client, rank, generation=0, deadline_s=consensus_s)
+            delivered[rank] = {}
+            completed[rank] = 0
+
+            def ring_hop(step: int, attempt: int, order: List[int]):
+                i = order.index(rank)
+                succ = order[(i + 1) % ranks]
+                pred = order[(i - 1) % ranks]
+                if core_faults.ACTIVE and core_faults.inject("wire.send"):
+                    raise _Lost("wire.send dropped", frm=rank)
+                if fabric.edge_lost(rank, succ):
+                    raise _Lost("edge dropped the send", frm=rank)
+                kernel.sleep(fabric.edge_delay(rank, succ, 64))
+                client.key_value_set(
+                    attempt_tag(f"ll/{step}/{rank}", attempt),
+                    str(value(rank, step)))
+                try:
+                    got = client.blocking_key_value_get(
+                        attempt_tag(f"ll/{step}/{pred}", attempt),
+                        int(hop_timeout_s * 1000))
+                except TimeoutError:
+                    raise _Lost("recv timed out", frm=pred) from None
+                if core_faults.ACTIVE and core_faults.inject("wire.recv"):
+                    raise _Lost("wire.recv dropped", frm=pred)
+                return int(got)
+
+            def commit(step: int, attempt: int, order: List[int],
+                       got: int, lost_from: List[int]) -> None:
+                client.key_value_set(
+                    attempt_tag(f"ll/done/{step}", attempt) + f"/{rank}",
+                    json.dumps({"v": got, "lost": lost_from}))
+                if rank != 0:
+                    try:
+                        client.blocking_key_value_get(
+                            attempt_tag(f"ll/commit/{step}", attempt),
+                            int((hop_timeout_s + 2.0) * 1000))
+                    except TimeoutError:
+                        raise _Lost("commit timed out") from None
+                    return
+                prefix = attempt_tag(f"ll/done/{step}", attempt) + "/"
+                deadline = kernel.now + hop_timeout_s + 1.0
+                while True:
+                    entries = client.key_value_dir_get(prefix)
+                    if len(entries) >= ranks:
+                        break
+                    if kernel.now >= deadline:
+                        raise _Lost("done gather timed out")
+                    kernel.sleep(0.05)
+                # fold the step's loss reports into the health map; a
+                # demoted (>= threshold) rank gets no healthy decay —
+                # its sick edge is unused, so nothing proves it healed
+                for _k, v in entries:
+                    for frm in json.loads(v).get("lost", []):
+                        lh.observe(frm, lost=True)
+                for r2 in order:
+                    if lh.score(r2) < lh.degraded_score:
+                        lh.observe(r2, gap_s=0.5)
+                client.key_value_set(
+                    attempt_tag(f"ll/commit/{step}", attempt), "ok")
+                if step + 1 < steps:
+                    client.key_value_set(
+                        f"ll/order/{step + 1}",
+                        json.dumps(lh.ring_order(order)))
+
+            def poison(step: int, why: str) -> None:
+                if not poison_box:
+                    poison_box.append(
+                        {"rank": rank, "step": step, "why": why})
+                kernel.log("wire_poison", rank=rank, step=step)
+
+            with ctx.activate():
+                for step in range(steps):
+                    if poison_box:
+                        break
+                    if step == 0:
+                        order = list(members)
+                    else:
+                        order = json.loads(client.blocking_key_value_get(
+                            f"ll/order/{step}", 60_000))
+                    if rank == 0:
+                        orders.append(list(order))
+                        if step == flap_step:
+                            i0 = order.index(vb)
+                            fabric.flap(vb, order[(i0 + 1) % ranks],
+                                        period_s=1e9, down_s=flap_down_s,
+                                        start_s=kernel.now)
+                            kernel.log("flap_window_open", rank=vb)
+                    attempt, fails = 0, 0
+                    lost_from: List[int] = []
+                    while True:
+                        try:
+                            got = ring_hop(step, attempt, order)
+                            commit(step, attempt, order, got, lost_from)
+                        except _Lost as lost:
+                            if lost.frm is not None:
+                                lost_from.append(lost.frm)
+                            fails += 1
+                            if fails > budget:
+                                poison(step, str(lost))
+                                break
+                            t0 = kernel.now
+                            decision = wc.vote_and_decide(
+                                "ll", step, attempt, members,
+                                f"ring:{step}", False)
+                            if rank == 0:
+                                cons_lat.append(kernel.now - t0)
+                            if decision != wirefault.RETRY:
+                                poison(step, f"consensus={decision}")
+                                break
+                            if rank == 0:
+                                wirefault.record_retry(
+                                    rank, "ll", step, attempt, decision)
+                                retry_rounds[step] = (
+                                    retry_rounds.get(step, 0) + 1)
+                            attempt += 1
+                            continue
+                        delivered[rank][step] = (attempt, got)
+                        completed[rank] = step + 1
+                        wc.cleanup("ll", step, attempt)
+                        for a in range(attempt + 1):
+                            client.key_value_delete(
+                                attempt_tag(f"ll/{step}/{rank}", a))
+                            client.key_value_delete(
+                                attempt_tag(f"ll/done/{step}", a)
+                                + f"/{rank}")
+                        break
+        return body
+
+    with _env(HVTPU_AUDIT_EVERY="0"):
+        for r in range(ranks):
+            kernel.spawn(f"rank{r}", make(r))
+        kernel.run(max_virtual_s=_DEF_BUDGET_S)
+
+    retries_total = int(obs_metrics.counter(
+        "hvtpu_collective_retries_total").value() - retries_before)
+    reroutes = int(obs_metrics.counter(
+        "hvtpu_ring_reroutes_total").value() - reroutes_before)
+
+    if baseline:
+        assert poison_box, (
+            "retries disabled: the first wire loss must poison the job")
+        first_lost = min(completed.values())
+        steps_lost = steps - first_lost
+        assert steps_lost > 0
+        stats = {"phases": {"lossy_link": {
+            "mode": "baseline",
+            "steps": steps,
+            "restarts": 1,
+            "steps_completed": first_lost,
+            "steps_lost": steps_lost,
+            "retry_rounds": 0,
+            "reroutes": reroutes,
+            "torn": 0,
+            "virtual_s": round(kernel.now, 6),
+        }}, "kv_ops": dict(fabric.ops)}
+        return _result("lossy-link", ranks, seed, kernel, stats)
+
+    assert not poison_box, (
+        f"job poisoned despite retry budget {budget}: {poison_box}")
+    for r in range(ranks):
+        assert completed.get(r) == steps, (
+            f"rank {r} finished {completed.get(r)}/{steps} steps")
+    torn = 0
+    for s in range(steps):
+        if len({delivered[r][s][0] for r in range(ranks)}) != 1:
+            torn += 1
+    assert torn == 0, f"{torn} steps delivered a torn mix of attempts"
+    # bitwise equality with the clean run: values depend only on
+    # (predecessor, step) for the deterministic ring in effect
+    assert len(orders) == steps
+    for s in range(steps):
+        order = orders[s]
+        for i, r in enumerate(order):
+            expect = value(order[(i - 1) % ranks], s)
+            assert delivered[r][s][1] == expect, (
+                f"rank {r} step {s}: delivered {delivered[r][s][1]}, "
+                f"clean result is {expect}")
+    assert retries_total >= 2, (
+        f"expected >= 2 consensus retries, saw {retries_total}")
+    assert reroutes >= 1, "the flapping rank was never rerouted around"
+    cons_sorted = sorted(cons_lat)
+    stats = {"phases": {"lossy_link": {
+        "mode": "retries",
+        "steps": steps,
+        "restarts": 0,
+        "steps_lost": 0,
+        "recovered_collectives": len(retry_rounds),
+        "retry_rounds": retries_total,
+        "consensus_p50_s": round(_pct(cons_sorted, 0.50), 6),
+        "consensus_max_s": (round(cons_sorted[-1], 6)
+                            if cons_sorted else 0.0),
+        "reroutes": reroutes,
+        "torn": torn,
+        "edge_losses": int(fabric.ops.get("edge_lost", 0)),
+        "virtual_s": round(kernel.now, 6),
+    }}, "kv_ops": dict(fabric.ops)}
+    return _result("lossy-link", ranks, seed, kernel, stats)
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -2553,6 +2841,7 @@ SCENARIOS = {
     "coordinator-loss": coordinator_loss,
     "partition-storm": partition_storm,
     "fleet-service": fleet_service,
+    "lossy-link": lossy_link,
 }
 
 

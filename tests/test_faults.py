@@ -103,6 +103,56 @@ class TestParse:
         with pytest.raises(faults.FaultSpecError):
             faults.parse_spec(bad)
 
+    def test_wire_grammar(self):
+        cs = faults.parse_spec(
+            "wire.send:drop@rank=1,count=2,times=2; "
+            "wire.recv:slow(250)@prob=0.5; "
+            "collective.exec:flap(1500)")
+        assert [c.site for c in cs] == [
+            "wire.send", "wire.recv", "collective.exec"]
+        assert cs[0].action == "drop" and cs[0].times == 2
+        assert cs[0].ranks == frozenset({1}) and cs[0].count == 2
+        assert cs[1].action == "slow" and cs[1].delay_ms == 250.0
+        assert cs[1].prob == 0.5
+        assert cs[2].action == "flap" and cs[2].flap_ms == 1500.0
+        assert cs[2].times == 1  # flap: 1-shot by default
+
+    @pytest.mark.parametrize("bad,msg", [
+        ("wire.send:torn", "no durable bytes to tear"),
+        ("wire.recv:bitflip", "no durable bytes to tear"),
+        ("collective.exec:torn@rank=1", "no durable bytes to tear"),
+        ("wire.send:corrupt", "no tensor to poison"),
+        ("wire.recv:corrupt(bitflip)", "no tensor to poison"),
+        ("wire.send:partition(100)", "coordination sites"),
+    ])
+    def test_wire_sites_reject_foreign_damage(self, bad, msg):
+        """The wire sites carry no durable bytes and no tensor: the
+        parser must name WHY the action is wrong and what to use."""
+        with pytest.raises(faults.FaultSpecError, match=msg):
+            faults.parse_spec(bad)
+
+    @pytest.mark.parametrize("bad", [
+        "kv.put:slow(100)",
+        "worker.step:flap(500)",
+        "ckpt.write:slow(10)",
+        "heartbeat:flap(100)",
+        "collective.pre:slow(50)",
+    ])
+    def test_slow_flap_limited_to_wire_sites(self, bad):
+        with pytest.raises(faults.FaultSpecError,
+                           match="only applies at wire sites"):
+            faults.parse_spec(bad)
+
+    @pytest.mark.parametrize("bad", [
+        "wire.send:slow()",
+        "wire.send:slow(abc)",
+        "wire.recv:flap()",
+        "wire.recv:flap(-5)",
+    ])
+    def test_malformed_wire_windows_fail_loudly(self, bad):
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_spec(bad)
+
     def test_empty_spec_yields_nothing(self):
         assert faults.parse_spec("") == []
         assert faults.parse_spec(" ; ; ") == []
@@ -271,6 +321,77 @@ class TestPartitionWindow:
         faults.install("kv.put:partition(1000)@rank=1", rank=0)
         assert faults.inject("kv.put") is False
         assert faults.partition_remaining() == 0.0
+
+
+class TestFlapWindow:
+    """A fired ``flap(MS)`` clause takes the WHOLE wire link down for a
+    window: every wire-site operation on this rank drops until it
+    expires — the link-level analog of ``partition(MS)``."""
+
+    @pytest.fixture()
+    def tick(self):
+        from horovod_tpu.core import clock as core_clock
+
+        class _TickClock(core_clock.Clock):
+            def __init__(self):
+                self.t = 100.0
+
+            def monotonic(self):
+                return self.t
+
+            def wall(self):
+                return self.t
+
+            def sleep(self, seconds):
+                self.t += max(0.0, seconds)
+
+            def call_later(self, seconds, fn):
+                fn()
+
+        fake = _TickClock()
+        core_clock.install(fake)
+        yield fake
+        core_clock.install(None)
+
+    def test_window_drops_every_wire_site(self, tick):
+        faults.install("wire.send:flap(1500)", rank=0)
+        assert faults.flap_remaining() == 0.0
+        assert faults.inject("wire.recv") is False  # window not open
+        assert faults.inject("wire.send") is True   # trigger: opens it
+        assert faults.inject("wire.recv") is True
+        assert faults.inject("collective.exec") is True
+        assert 0.0 < faults.flap_remaining() <= 1.5
+
+    def test_window_spares_other_planes(self, tick):
+        faults.install("wire.send:flap(1500)", rank=0)
+        assert faults.inject("wire.send") is True
+        # coordination/compute/storage keep flowing: the LINK is down,
+        # not the rank
+        assert faults.inject("kv.put") is False
+        assert faults.inject("heartbeat") is False
+        assert faults.inject("worker.step") is False
+        assert faults.inject_storage("ckpt.write") is None
+
+    def test_window_expires_on_clock(self, tick):
+        faults.install("collective.exec:flap(500)", rank=0)
+        assert faults.inject("collective.exec") is True
+        tick.t += 0.4
+        assert faults.inject("wire.send") is True   # inside the window
+        tick.t += 0.2                               # past 500ms total
+        assert faults.flap_remaining() == 0.0
+        assert faults.inject("wire.send") is False
+        assert faults.inject("collective.exec") is False  # times=1 spent
+
+    def test_slow_adds_latency_without_dropping(self, tick):
+        faults.install("wire.recv:slow(80)", rank=0)
+        t0 = tick.t
+        assert faults.inject("wire.recv") is False  # delivered, late
+        assert tick.t - t0 >= 0.079
+
+    def test_rank_selector_scopes_window(self, tick):
+        faults.install("wire.send:flap(1000)@rank=1", rank=0)
+        assert faults.inject("wire.send") is False
+        assert faults.flap_remaining() == 0.0
 
 
 def test_inactive_guard_is_zero_overhead():

@@ -567,11 +567,18 @@ def test_worker_kill_leaves_postmortems_both_ranks_2proc(tmp_path):
     fuses them into one two-rank timeline."""
 
     def body():
+        import jax.numpy as jnp
+
         import horovod_tpu as hvt
         from horovod_tpu.elastic import worker as _worker
 
         hvt.init()
-        for _ in range(4):
+        for i in range(4):
+            # lockstep barrier: without it a lagging rank can still be
+            # steps behind when the other rank's kill tears down the
+            # coordination service, dying collaterally BEFORE its own
+            # kill site dumps the postmortem this test asserts on
+            hvt.allreduce(jnp.ones(()), name=f"step-{i}")
             _worker.note_step()
         hvt.shutdown()
         return "survived"  # unreachable: the kill fires at step 2

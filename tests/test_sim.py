@@ -223,6 +223,36 @@ class TestFastChaosMatrix:
         assert 0 < storm["detect_p50_s"] <= storm["detect_max_s"]
         assert storm["fence_latency_s"] > 0
 
+    def test_lossy_link_8(self):
+        # the scenario itself asserts the wire-plane contract (zero
+        # restarts, zero torn steps, every delivered value bitwise-
+        # equal to the clean ring result, >= 2 consensus retries and
+        # >= 1 reroute around the flapping link); here we pin the
+        # external shape of the recovery rows the bench embeds.  8
+        # ranks keeps the tier-1 smoke sub-second; 64/1024 run below.
+        r = run_scenario("lossy-link", 8, seed=3)
+        ll = r["stats"]["phases"]["lossy_link"]
+        assert ll["mode"] == "retries"
+        assert ll["restarts"] == 0 and ll["steps_lost"] == 0
+        assert ll["torn"] == 0
+        assert ll["retry_rounds"] >= 2
+        assert ll["recovered_collectives"] >= 1
+        assert ll["reroutes"] >= 1
+        assert 0 < ll["consensus_p50_s"] <= ll["consensus_max_s"]
+        assert ll["edge_losses"] >= 1
+
+    def test_lossy_link_baseline_restart_cost_8(self):
+        # same seed, retries disabled: the FIRST wire loss poisons the
+        # job (the pre-PR-20 fail-stop behavior) and the steps after it
+        # are lost to the restart — the recovery-vs-restart comparison
+        # the BENCH_SCALING rows quantify
+        r = run_scenario("lossy-link", 8, seed=3, baseline=True)
+        ll = r["stats"]["phases"]["lossy_link"]
+        assert ll["mode"] == "baseline"
+        assert ll["restarts"] == 1
+        assert ll["steps_lost"] > 0
+        assert ll["retry_rounds"] == 0
+
     def test_stream_matrix_64(self):
         # split-burst + forced mispredict + membership-change-free
         # shutdown interleavings on the streamed plane; 256-rank and
@@ -248,7 +278,7 @@ class TestDeterminism:
         "name", ["steady-drain", "kill-blacklist", "multi-job-arbiter",
                  "checkpoint-storm", "compression-negotiation",
                  "coordinator-loss", "partition-storm",
-                 "fleet-service"])
+                 "fleet-service", "lossy-link"])
     def test_same_seed_byte_identical(self, name):
         a = _dump(run_scenario(name, 64, seed=7))
         b = _dump(run_scenario(name, 64, seed=7))
@@ -266,7 +296,8 @@ class TestDeterminism:
             "kill-blacklist", "kv-brownout", "straggler-tail",
             "stream-matrix", "multi-job-arbiter", "checkpoint-storm",
             "compression-negotiation", "anomaly-detection",
-            "coordinator-loss", "partition-storm", "fleet-service"}
+            "coordinator-loss", "partition-storm", "fleet-service",
+            "lossy-link"}
         with pytest.raises(KeyError, match="steady-drain"):
             run_scenario("no-such-scenario", 8)
 
@@ -320,6 +351,18 @@ class TestScale:
         storm = r["stats"]["phases"]["partition_storm"]
         assert storm["recovered"] == len(storm["victims"]) - 1
         assert storm["detect_max_s"] > 0
+
+    def test_lossy_link_1024_acceptance(self):
+        # the PR-20 acceptance command: python -m tools.hvtpusim run
+        # lossy-link --ranks 1024 --seed 7 — seeded drops + a flap
+        # window at 1024 virtual ranks completes with ZERO restarts
+        # and ZERO torn collectives (asserted inside the scenario,
+        # with every retried delivery bitwise-equal to the clean run)
+        r = run_scenario("lossy-link", 1024, seed=7)
+        ll = r["stats"]["phases"]["lossy_link"]
+        assert ll["restarts"] == 0 and ll["torn"] == 0
+        assert ll["retry_rounds"] >= 2
+        assert ll["reroutes"] >= 1
 
     def test_thundering_rendezvous_4096(self):
         r = run_scenario("thundering-rendezvous", 4096, seed=7)

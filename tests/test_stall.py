@@ -765,3 +765,369 @@ class TestPoisonLatch:
         # ...but a completed recovery still wins: clean exit
         monkeypatch.setattr(st, "init_generation", insp.gen + 1)
         assert stall.poison_exit_status() == 0
+
+
+class TestInflightLeakRegression:
+    """PR-20 satellite: an exception inside ``dispatch``/``wait_ready``
+    must CLEAR the in-flight marker.  The leak left ``_SetTrack.
+    inflight`` armed with the dead op's start time, so the marker aged
+    across later healthy ops and the heartbeat eventually diagnosed a
+    false stall abort on a perfectly live job."""
+
+    def _make(self, kv, rank, warn_s=0.05, abort_s=0.0, hb=0.03):
+        return AmortizedStallInspector(
+            kv, rank, warn_s=warn_s, abort_s=abort_s,
+            heartbeat_s=hb, generation=1)
+
+    def test_dispatch_error_clears_inflight(self):
+        insp = self._make(FakeKV(), 0, warn_s=60, hb=30.0)
+        try:
+            insp.pre_op(0, [0, 1], "allreduce:x")
+
+            def boom():
+                raise ValueError("backend exploded")
+
+            with pytest.raises(ValueError, match="exploded"):
+                insp.dispatch(0, boom, ())
+            assert insp._tracks["0"].inflight is None
+        finally:
+            insp.stop()
+
+    def test_wait_ready_error_clears_inflight(self):
+        insp = self._make(FakeKV(), 0, warn_s=60, hb=30.0)
+        try:
+            insp.pre_op(0, [0, 1], "allreduce:y")
+
+            class _Explodes:
+                def is_ready(self):
+                    raise RuntimeError("torn result")
+
+            with pytest.raises(RuntimeError, match="torn result"):
+                insp.wait_ready(0, _Explodes())
+            assert insp._tracks["0"].inflight is None
+        finally:
+            insp.stop()
+
+    def test_failed_attempt_never_becomes_false_stall_abort(self):
+        """The observable symptom: after a failed dispatch, an idle-but-
+        healthy job must NOT age the stale marker into a stall abort
+        naming the innocent peer."""
+        kv = FakeKV()
+        a = self._make(kv, 0, warn_s=0.05, abort_s=0.25)
+        b = self._make(kv, 1, warn_s=0.05, abort_s=0.25)
+        try:
+            a.pre_op(0, [0, 1], "allreduce:z")
+
+            def boom():
+                raise ValueError("attempt died")
+
+            with pytest.raises(ValueError):
+                a.dispatch(0, boom, ())
+            # well past warn + abort: the cleared marker means no op is
+            # in flight, so nothing may latch
+            time.sleep(0.6)
+            assert a.failure is None, a.failure
+        finally:
+            a.stop(); b.stop()
+
+
+class TestWireConsensusUnit:
+    """comm/wirefault.py: the abort-and-retry agreement over a fake KV
+    — every decision path, plus the no-torn-attempt property."""
+
+    def _wc(self, kv, rank=0, deadline_s=5.0):
+        from horovod_tpu.comm import wirefault
+
+        return wirefault.WireConsensus(
+            kv, rank, generation=1, hb_prefix="hvtstallhb/1/",
+            deadline_s=deadline_s)
+
+    def _hb(self, kv, rank, seq, inflight, beat=0, bye=False, fail=None):
+        import json
+
+        kv.key_value_set(
+            f"hvtstallhb/1/{rank}/{beat}",
+            json.dumps({"bye": bye, "fail": fail,
+                        "sets": {"0": {"seq": seq,
+                                       "inflight": inflight}}}))
+
+    def test_all_voted_means_retry(self, kv):
+        import json
+
+        from horovod_tpu.comm import wirefault
+
+        for r in (1, 2):
+            kv.key_value_set(f"hvtwire/1/0/5/0/{r}",
+                             json.dumps({"st": "mid", "d": "allreduce:x"}))
+        wc = self._wc(kv)
+        got = wc.vote_and_decide("0", 5, 0, [0, 1, 2], "allreduce:x",
+                                 predispatch=False)
+        assert got == wirefault.RETRY
+        # own vote rode the KV for the peers' agreement
+        assert "hvtwire/1/0/5/0/0" in kv.d
+
+    def test_completed_peer_escalates(self):
+        import json
+
+        from horovod_tpu.comm import wirefault
+
+        kv = FakeKV()
+        kv.key_value_set("hvtwire/1/0/5/0/1",
+                         json.dumps({"st": "pre", "d": "allreduce:x"}))
+        # rank 2 never votes: its heartbeat shows it COMPLETED op 5
+        # and moved on (seq advanced past) — a retry would deliver a
+        # second, different attempt on rank 2
+        self._hb(kv, 2, seq=7, inflight=None)
+        wc = self._wc(kv)
+        got = wc.vote_and_decide("0", 5, 0, [0, 1, 2], "allreduce:x",
+                                 predispatch=True)
+        assert got == wirefault.ESCALATE
+
+    def test_exited_peer_escalates(self):
+        import json
+
+        from horovod_tpu.comm import wirefault
+
+        kv = FakeKV()
+        kv.key_value_set("hvtwire/1/0/5/0/1",
+                         json.dumps({"st": "pre", "d": "allreduce:x"}))
+        self._hb(kv, 2, seq=6, inflight="allreduce:x", bye=True)
+        wc = self._wc(kv)
+        got = wc.vote_and_decide("0", 5, 0, [0, 1, 2], "allreduce:x",
+                                 predispatch=True)
+        assert got == wirefault.ESCALATE
+
+    def test_wedged_peers_late_join_retracts_vote(self):
+        """Every voter failed PRE-dispatch and the non-voters are
+        observably parked inside attempt 0: re-enter it (LATE_JOIN) —
+        and the failure vote must flip to ``rejoin`` BEFORE re-entry,
+        so a peer failing later can never read a completed vote set."""
+        import json
+
+        from horovod_tpu.comm import wirefault
+
+        kv = FakeKV()
+        kv.key_value_set("hvtwire/1/0/5/0/1",
+                         json.dumps({"st": "pre", "d": "allreduce:x"}))
+        self._hb(kv, 2, seq=6, inflight="allreduce:x")
+        wc = self._wc(kv)
+        got = wc.vote_and_decide("0", 5, 0, [0, 1, 2], "allreduce:x",
+                                 predispatch=True)
+        assert got == wirefault.LATE_JOIN
+        assert json.loads(kv.d["hvtwire/1/0/5/0/0"])["st"] == "rejoin"
+
+    def test_midflight_failure_never_late_joins(self):
+        """A failure AFTER bytes hit the wire can only RETRY (all voted)
+        or ESCALATE — here the wedged peer never votes, so the deadline
+        escalates rather than tearing into the pending attempt."""
+        from horovod_tpu.comm import wirefault
+
+        kv = FakeKV()
+        self._hb(kv, 2, seq=6, inflight="allreduce:x")
+        wc = self._wc(kv, deadline_s=0.3)
+        t0 = time.monotonic()
+        got = wc.vote_and_decide("0", 5, 0, [0, 2], "allreduce:x",
+                                 predispatch=False)
+        assert got == wirefault.ESCALATE
+        assert time.monotonic() - t0 < 5.0  # bounded by the deadline
+
+    def test_deadline_escalates_on_silent_peer(self):
+        from horovod_tpu.comm import wirefault
+
+        kv = FakeKV()  # rank 1: no vote, no heartbeat — nothing to read
+        wc = self._wc(kv, deadline_s=0.2)
+        got = wc.vote_and_decide("0", 5, 0, [0, 1], "allreduce:x",
+                                 predispatch=True)
+        assert got == wirefault.ESCALATE
+
+    def test_rejoin_vote_never_licenses_next_attempt(self):
+        """The no-torn-result property: with a late-joiner back INSIDE
+        attempt 0 (rejoin vote), a subsequently-failing peer must never
+        decide RETRY — the late-joiner would wedge in attempt 0 while
+        others tear off into attempt 1."""
+        import json
+
+        from horovod_tpu.comm import wirefault
+
+        kv = FakeKV()
+        kv.key_value_set("hvtwire/1/0/5/0/1",
+                         json.dumps({"st": "rejoin", "d": "allreduce:x"}))
+        wc = self._wc(kv, deadline_s=0.3)
+        # pre-dispatch failure: join the pending attempt instead
+        assert wc.vote_and_decide(
+            "0", 5, 0, [0, 1], "allreduce:x",
+            predispatch=True) == wirefault.LATE_JOIN
+        # mid-flight failure: cannot join — escalate, never RETRY
+        assert wc.vote_and_decide(
+            "0", 5, 0, [0, 1], "allreduce:x",
+            predispatch=False) == wirefault.ESCALATE
+
+    def test_cleanup_deletes_only_own_votes(self):
+        import json
+
+        kv = FakeKV()
+        kv.key_value_set("hvtwire/1/0/5/0/1", json.dumps({"st": "mid"}))
+        wc = self._wc(kv)
+        wc.vote_and_decide("0", 5, 0, [0, 1], "op", predispatch=False)
+        wc.cleanup("0", 5, attempts=1)
+        assert "hvtwire/1/0/5/0/0" not in kv.d
+        assert "hvtwire/1/0/5/0/1" in kv.d  # the peer deletes its own
+
+    def test_attempt_tag_namespaces_are_disjoint(self):
+        from horovod_tpu.native.wire import attempt_tag, split_attempt
+
+        assert attempt_tag("hvt/allreduce/x", 0) == "hvt/allreduce/x"
+        tagged = attempt_tag("hvt/allreduce/x", 3)
+        assert tagged != "hvt/allreduce/x"
+        assert split_attempt(tagged) == ("hvt/allreduce/x", 3)
+        assert split_attempt("hvt/allreduce/x") == ("hvt/allreduce/x", 0)
+        # attempts never collide with each other or with attempt 0
+        assert len({attempt_tag("k", a) for a in range(5)}) == 5
+
+
+class TestWireRetryLoop:
+    """The module-level ``dispatch`` retry loop end-to-end in-process:
+    an injected ``wire.send`` drop, a real consensus round over the
+    fake KV, and the reissued attempt delivering the result."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_faults(self):
+        from horovod_tpu.core import faults
+
+        yield
+        faults.uninstall()
+
+    def _harness(self, kv, members=(0,)):
+        from types import SimpleNamespace
+
+        insp = AmortizedStallInspector(
+            kv, 0, warn_s=60, abort_s=0, heartbeat_s=0.05, generation=1)
+        st = SimpleNamespace(sync_stall=insp)
+        ps = SimpleNamespace(size=2, process_set_id=0)
+        insp.pre_op(0, list(members), "allreduce:r:(2,):float32")
+        return insp, st, ps
+
+    def test_consensus_retry_delivers_result(self, monkeypatch):
+        from horovod_tpu.comm import stall as stall_mod
+        from horovod_tpu.core import faults
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        monkeypatch.setenv("HVTPU_WIRE_RETRIES", "2")
+        monkeypatch.setenv("HVTPU_WIRE_RETRY_BACKOFF_S", "0.01")
+        faults.install("wire.send:drop@times=1", rank=0)
+        kv = FakeKV()
+        insp, st, ps = self._harness(kv)
+        before = obs_metrics.counter(
+            "hvtpu_collective_retries_total").value()
+        try:
+            out = stall_mod.dispatch(st, ps, lambda: 42, (),
+                                     desc="allreduce:r:(2,):float32")
+            assert out == 42
+            assert obs_metrics.counter(
+                "hvtpu_collective_retries_total").value() == before + 1
+            # delivered: the rank's own votes were cleaned up
+            assert not [k for k in kv.d if k.startswith("hvtwire/")]
+            # and the completion wait leaves no stale marker behind
+            insp.wait_ready(0, out)
+            assert insp._tracks["0"].inflight is None
+        finally:
+            insp.stop()
+
+    def test_retries_disabled_is_failfast(self, monkeypatch):
+        """Default budget (0): the injected wire fault surfaces as the
+        pre-existing HorovodInternalError with zero consensus traffic
+        — the opt-out path is byte-for-byte the old behavior."""
+        from horovod_tpu.comm import stall as stall_mod
+        from horovod_tpu.core import faults
+
+        monkeypatch.delenv("HVTPU_WIRE_RETRIES", raising=False)
+        faults.install("wire.send:drop@times=1", rank=0)
+        kv = FakeKV()
+        insp, st, ps = self._harness(kv)
+        try:
+            with pytest.raises(HorovodInternalError,
+                               match="transport failure"):
+                stall_mod.dispatch(st, ps, lambda: 42, ())
+            assert not [k for k in kv.d if k.startswith("hvtwire/")]
+        finally:
+            insp.stop()
+
+    def test_budget_exhaustion_escalates(self, monkeypatch):
+        from horovod_tpu.comm import stall as stall_mod
+        from horovod_tpu.core import faults
+
+        monkeypatch.setenv("HVTPU_WIRE_RETRIES", "2")
+        monkeypatch.setenv("HVTPU_WIRE_RETRY_BACKOFF_S", "0.01")
+        faults.install("wire.send:drop", rank=0)  # unlimited drops
+        insp, st, ps = self._harness(FakeKV())
+        try:
+            with pytest.raises(HorovodInternalError,
+                               match="transport failure"):
+                stall_mod.dispatch(st, ps, lambda: 42, ())
+        finally:
+            insp.stop()
+
+    def test_non_transport_error_is_not_retried(self, monkeypatch):
+        from horovod_tpu.comm import stall as stall_mod
+
+        monkeypatch.setenv("HVTPU_WIRE_RETRIES", "3")
+
+        def boom():
+            raise ValueError("a real bug, not the wire")
+
+        insp, st, ps = self._harness(FakeKV())
+        try:
+            with pytest.raises(ValueError, match="real bug"):
+                stall_mod.dispatch(st, ps, boom, ())
+        finally:
+            insp.stop()
+
+
+@pytest.mark.multiprocess
+def test_wire_drop_retry_bitwise_identical_2proc():
+    """PR-20 acceptance: rank 0's allreduce dies on an injected
+    ``wire.send`` drop with retries armed.  The abort consensus sees
+    rank 1 parked inside the pending attempt (late join), the reissued
+    dispatch completes it, and the delivered tensor is BITWISE-equal to
+    the clean run on both ranks — the job never restarts and never
+    consumes bytes from the aborted attempt."""
+
+    def body():
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvt
+        from horovod_tpu.core import faults
+        from horovod_tpu.obs import metrics as obs_metrics
+
+        hvt.init()
+        r = hvt.rank()
+        x = jnp.arange(8, dtype=jnp.float32) * (r + 1) + 0.125
+        clean = np.asarray(hvt.allreduce(x, op=hvt.Sum, name="clean"))
+        before = obs_metrics.counter(
+            "hvtpu_collective_retries_total").value()
+        # only rank 0's next send dies; rank 1 dispatches and wedges
+        # inside the pending collective until the late join lands
+        faults.install("wire.send:drop@rank=0,times=1", rank=r)
+        faulted = np.asarray(hvt.allreduce(x, op=hvt.Sum, name="clean"))
+        faults.uninstall()
+        retries = obs_metrics.counter(
+            "hvtpu_collective_retries_total").value() - before
+        # the job is still healthy: one more collective completes
+        ok = float(hvt.allreduce(jnp.ones(()), op=hvt.Sum))
+        return (clean.tolist(), faulted.tolist(), retries, ok)
+
+    results = run(
+        body, np=2, cpu_devices=1, env={
+            **_ENV,
+            "HVTPU_WIRE_RETRIES": "2",
+            "HVTPU_WIRE_CONSENSUS_S": "30",
+            "HVTPU_STALL_HEARTBEAT_SECONDS": "0.2",
+            "HVTPU_STALL_CHECK_TIME_SECONDS": "5",
+            "HVTPU_STALL_SHUTDOWN_TIME_SECONDS": "60",
+        }, start_timeout=300.0, timeout=600.0)
+    for clean, faulted, retries, ok in results:
+        assert faulted == clean, (faulted, clean)  # bitwise identical
+        assert ok == 2.0
+    # the faulted rank's reissue was consensus-approved and counted
+    assert results[0][2] >= 1, results
